@@ -1,0 +1,64 @@
+"""sparkdl_tpu — TPU-native Deep Learning Pipelines.
+
+A brand-new framework with the capabilities of Deep Learning Pipelines for
+Spark (reference: ``phi-dbq/spark-deep-learning`` / ``sparkdl``,
+``python/sparkdl/__init__.py::__all__``), re-designed TPU-first on
+JAX/XLA: partitioned Arrow columns instead of Spark DataFrames, serialized
+StableHLO instead of frozen TF GraphDefs, jit/pjit on TPU meshes instead of
+TensorFrames' JNI-embedded TF sessions.
+
+Public API surface mirrors the reference's eight user-facing names plus
+``readImages`` (reference ``python/sparkdl/__init__.py``). Exports resolve
+lazily so importing the package doesn't pull jax/keras until a symbol is
+touched.
+"""
+
+__version__ = "0.1.0"
+
+_EXPORTS = {
+    "imageSchema": ("sparkdl_tpu.image.imageIO", "imageSchema"),
+    "readImages": ("sparkdl_tpu.image.imageIO", "readImages"),
+    "DeepImageFeaturizer": ("sparkdl_tpu.transformers.named_image",
+                            "DeepImageFeaturizer"),
+    "DeepImagePredictor": ("sparkdl_tpu.transformers.named_image",
+                           "DeepImagePredictor"),
+    "ImageTransformer": ("sparkdl_tpu.transformers.image_transform",
+                         "ImageTransformer"),
+    "TensorTransformer": ("sparkdl_tpu.transformers.tensor_transform",
+                          "TensorTransformer"),
+    # Reference-era aliases (TFImageTransformer / TFTransformer).
+    "TFImageTransformer": ("sparkdl_tpu.transformers.image_transform",
+                           "ImageTransformer"),
+    "TFTransformer": ("sparkdl_tpu.transformers.tensor_transform",
+                      "TensorTransformer"),
+    "KerasImageFileTransformer": ("sparkdl_tpu.transformers.keras_image",
+                                  "KerasImageFileTransformer"),
+    "KerasTransformer": ("sparkdl_tpu.transformers.keras_tensor",
+                         "KerasTransformer"),
+    "KerasImageFileEstimator": (
+        "sparkdl_tpu.estimators.keras_image_file_estimator",
+        "KerasImageFileEstimator"),
+    "registerKerasImageUDF": ("sparkdl_tpu.udf.keras_image_model",
+                              "registerKerasImageUDF"),
+    "DataFrame": ("sparkdl_tpu.data.frame", "DataFrame"),
+    "Pipeline": ("sparkdl_tpu.params.pipeline", "Pipeline"),
+    "CrossValidator": ("sparkdl_tpu.params.tuning", "CrossValidator"),
+    "ParamGridBuilder": ("sparkdl_tpu.params.tuning", "ParamGridBuilder"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module 'sparkdl_tpu' has no attribute {name!r}")
+    import importlib
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

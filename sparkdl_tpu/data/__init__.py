@@ -1,0 +1,30 @@
+"""Columnar data substrate: partitioned Arrow DataFrames + local engine.
+
+The reference delegated partitioning/scheduling to Apache Spark (its L0)
+and block execution to TensorFrames (L1). This package is the TPU build's
+engine seam: an Arrow-record-batch DataFrame with a lazy per-partition
+transform plan, executed by a thread-pool :class:`LocalEngine` whose
+host stages run in parallel on CPU threads and whose device stages feed
+the TPU serially. A Spark binding (mapInArrow) can be dropped in behind
+the same DataFrame API where pyspark exists.
+"""
+
+from sparkdl_tpu.data.frame import DataFrame, Row  # noqa: F401
+from sparkdl_tpu.data.engine import LocalEngine, default_engine  # noqa: F401
+from sparkdl_tpu.data.tensors import (  # noqa: F401
+    arrow_to_tensor,
+    tensor_field,
+    tensor_shape_of,
+    tensor_to_arrow,
+)
+
+__all__ = [
+    "DataFrame",
+    "Row",
+    "LocalEngine",
+    "default_engine",
+    "tensor_to_arrow",
+    "arrow_to_tensor",
+    "tensor_field",
+    "tensor_shape_of",
+]

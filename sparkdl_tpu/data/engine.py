@@ -1,0 +1,96 @@
+"""Local partition-parallel execution engine.
+
+The seam where Spark executors + TensorFrames sat in the reference
+(SURVEY §1 L0/L1). Host stages (decode, resize, Arrow shuffling) run
+concurrently in a thread pool — the analogue of Spark python workers —
+while device stages (jitted TPU applies) are serialized behind a lock so
+a single accelerator sees one batch stream and HBM isn't oversubscribed
+by concurrent partitions. Results stream back in partition order.
+
+A Spark/mapInArrow binding can replace this class behind the same
+``execute(sources, plan)`` contract when pyspark is available.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+
+class LocalEngine:
+    """Thread-pool engine with ordered streaming and bounded in-flight
+    partitions (backpressure keeps memory flat on large frames)."""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 max_inflight: Optional[int] = None):
+        self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
+        # Enough in-flight partitions to keep workers busy while the
+        # consumer drains in order.
+        self.max_inflight = max_inflight or self.num_workers * 2
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.num_workers,
+            thread_name_prefix="sparkdl-tpu-host")
+        self._device_lock = threading.Lock()
+
+    def _run_partition(self, source, plan) -> pa.RecordBatch:
+        batch = source.load()
+        for stage in plan:
+            if stage.kind == "device":
+                with self._device_lock:
+                    batch = stage.fn(batch)
+            else:
+                batch = stage.fn(batch)
+        return batch
+
+    def execute(self, sources: Sequence, plan: Sequence) -> Iterator[pa.RecordBatch]:
+        """Yield transformed partition batches in partition order, running
+        at most ``max_inflight`` partitions concurrently."""
+        if not sources:
+            return iter(())
+
+        def _gen() -> Iterator[pa.RecordBatch]:
+            pending: dict[int, Future] = {}
+            next_to_submit = 0
+            next_to_yield = 0
+            n = len(sources)
+            try:
+                while next_to_yield < n:
+                    while (next_to_submit < n
+                           and len(pending) < self.max_inflight):
+                        fut = self._pool.submit(
+                            self._run_partition, sources[next_to_submit], plan)
+                        pending[next_to_submit] = fut
+                        next_to_submit += 1
+                    fut = pending.pop(next_to_yield)
+                    yield fut.result()
+                    next_to_yield += 1
+            finally:
+                for fut in pending.values():
+                    fut.cancel()
+
+        return _gen()
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+_default: Optional[LocalEngine] = None
+_default_lock = threading.Lock()
+
+
+def default_engine() -> LocalEngine:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = LocalEngine()
+        return _default
+
+
+def set_default_engine(engine: LocalEngine):
+    global _default
+    with _default_lock:
+        _default = engine

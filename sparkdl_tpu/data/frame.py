@@ -1,0 +1,236 @@
+"""Partitioned, lazily-transformed Arrow DataFrame.
+
+Plays the role Spark DataFrames played for the reference: rows live in
+partitions (one ``pyarrow.RecordBatch`` each), transformations are
+recorded as a per-partition plan of batch functions and only run when the
+frame is materialized (``collect``/``stream``/``count``). Host stages run
+in parallel across CPU threads; device stages (jitted TPU applies) are
+serialized by the engine so the chip sees an orderly batch stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+Row = dict  # a collected row is a plain dict, keyed by column name
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One plan step: RecordBatch → RecordBatch."""
+    fn: Callable[[pa.RecordBatch], pa.RecordBatch]
+    kind: str = "host"            # "host" (thread-parallel) | "device" (serial)
+    name: str = "stage"
+    row_preserving: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Source:
+    """One partition source. ``load`` materializes the partition's batch;
+    ``num_rows`` is a hint for count() fast-path (None = unknown)."""
+    load: Callable[[], pa.RecordBatch]
+    num_rows: Optional[int] = None
+
+
+class DataFrame:
+    """Immutable partitioned frame; transforms return new frames."""
+
+    def __init__(self, sources: Sequence[Source], plan: Sequence[Stage] = (),
+                 engine=None):
+        from sparkdl_tpu.data.engine import default_engine
+        self._sources: List[Source] = list(sources)
+        self._plan: List[Stage] = list(plan)
+        self._engine = engine or default_engine()
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_table(table: pa.Table, num_partitions: int = 8,
+                   engine=None) -> "DataFrame":
+        table = table.combine_chunks()
+        n = table.num_rows
+        num_partitions = max(1, min(num_partitions, n) if n else 1)
+        bounds = np.linspace(0, n, num_partitions + 1).astype(int)
+        sources = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            lo_i, hi_i = int(lo), int(hi)
+            sub = table.slice(lo_i, hi_i - lo_i)
+
+            def _load(sub=sub) -> pa.RecordBatch:
+                batches = sub.combine_chunks().to_batches()
+                if not batches:
+                    return pa.RecordBatch.from_pylist([], schema=sub.schema)
+                if len(batches) == 1:
+                    return batches[0]
+                return pa.Table.from_batches(batches).combine_chunks() \
+                    .to_batches()[0]
+
+            sources.append(Source(_load, hi_i - lo_i))
+        return DataFrame(sources, engine=engine)
+
+    @staticmethod
+    def from_pandas(df, num_partitions: int = 8, engine=None) -> "DataFrame":
+        return DataFrame.from_table(pa.Table.from_pandas(df),
+                                    num_partitions, engine)
+
+    @staticmethod
+    def from_pylist(rows: List[dict], num_partitions: int = 8,
+                    engine=None) -> "DataFrame":
+        return DataFrame.from_table(pa.Table.from_pylist(rows),
+                                    num_partitions, engine)
+
+    @staticmethod
+    def from_batches(batches: Sequence[pa.RecordBatch],
+                     engine=None) -> "DataFrame":
+        sources = [Source((lambda b=b: b), b.num_rows) for b in batches]
+        return DataFrame(sources, engine=engine)
+
+    # -- plan building ------------------------------------------------------
+
+    def map_batches(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
+                    kind: str = "host", name: str = "map_batches",
+                    row_preserving: bool = True) -> "DataFrame":
+        return DataFrame(self._sources,
+                         self._plan + [Stage(fn, kind, name, row_preserving)],
+                         self._engine)
+
+    def with_column(self, name: str,
+                    fn: Callable[[pa.RecordBatch], pa.Array],
+                    kind: str = "host") -> "DataFrame":
+        """Append a column computed per batch. ``fn`` may return an Arrow
+        array or a numpy array (auto-converted to a tensor column)."""
+        from sparkdl_tpu.data.tensors import append_tensor_column
+
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            col = fn(batch)
+            if isinstance(col, np.ndarray):
+                return append_tensor_column(batch, name, col)
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            return batch.append_column(name, col)
+
+        return self.map_batches(_stage, kind=kind, name=f"with_column({name})")
+
+    def select(self, *cols: str) -> "DataFrame":
+        cols = list(cols)
+
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            return batch.select(cols)
+
+        return self.map_batches(_stage, name=f"select({','.join(cols)})")
+
+    def drop(self, *cols: str) -> "DataFrame":
+        to_drop = set(cols)
+
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            keep = [n for n in batch.schema.names if n not in to_drop]
+            return batch.select(keep)
+
+        return self.map_batches(_stage, name=f"drop({','.join(cols)})")
+
+    def rename(self, mapping: dict) -> "DataFrame":
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            names = [mapping.get(n, n) for n in batch.schema.names]
+            return batch.rename_columns(names)
+
+        return self.map_batches(_stage, name="rename")
+
+    def filter(self, predicate: Callable[[pa.RecordBatch], "pa.Array | np.ndarray"]
+               ) -> "DataFrame":
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            mask = predicate(batch)
+            if isinstance(mask, np.ndarray):
+                mask = pa.array(mask)
+            return batch.filter(mask)
+
+        return self.map_batches(_stage, name="filter", row_preserving=False)
+
+    def repartition(self, num_partitions: int) -> "DataFrame":
+        """Materializes, then re-slices. Row order is preserved."""
+        return DataFrame.from_table(self.collect(), num_partitions,
+                                    self._engine)
+
+    def filter_rows(self, mask: np.ndarray) -> "DataFrame":
+        """Keep rows where the GLOBAL boolean mask is true (mask indexed in
+        collected row order). Used by CrossValidator k-fold splits."""
+        table = self.collect()
+        if len(mask) != table.num_rows:
+            raise ValueError(f"mask length {len(mask)} != rows "
+                             f"{table.num_rows}")
+        kept = table.filter(pa.array(np.asarray(mask, dtype=bool)))
+        return DataFrame.from_table(kept, max(1, len(self._sources)),
+                                    self._engine)
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._sources)
+
+    @property
+    def schema(self) -> pa.Schema:
+        """Schema after the plan, computed on the first partition's batch
+        sliced to zero rows (stages must tolerate empty batches)."""
+        if not self._sources:
+            return pa.schema([])
+        proto = self._sources[0].load().slice(0, 0)
+        for stage in self._plan:
+            proto = stage.fn(proto)
+        return proto.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.schema.names)
+
+    # -- materialization ----------------------------------------------------
+
+    def stream(self) -> Iterator[pa.RecordBatch]:
+        """Ordered iterator of fully-transformed partition batches."""
+        return self._engine.execute(self._sources, self._plan)
+
+    def collect(self) -> pa.Table:
+        batches = list(self.stream())
+        if not batches:
+            return pa.table({})
+        return pa.Table.from_batches(batches)
+
+    def collect_rows(self) -> List[Row]:
+        return self.collect().to_pylist()
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        if all(st.row_preserving for st in self._plan) and \
+                all(s.num_rows is not None for s in self._sources):
+            return sum(s.num_rows for s in self._sources)
+        return sum(b.num_rows for b in self.stream())
+
+    def take(self, n: int) -> List[Row]:
+        out: List[Row] = []
+        for batch in self.stream():
+            out.extend(batch.to_pylist())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def first(self) -> Optional[Row]:
+        rows = self.take(1)
+        return rows[0] if rows else None
+
+    def tensor(self, col: str) -> np.ndarray:
+        """Collect one tensor column as a stacked ndarray [N, *shape]."""
+        from sparkdl_tpu.data.tensors import arrow_to_tensor
+        table = self.collect()
+        idx = table.schema.get_field_index(col)
+        return arrow_to_tensor(table.column(idx), table.schema.field(idx))
+
+    def __repr__(self) -> str:
+        names = ",".join(self.columns) if self._sources else ""
+        return (f"DataFrame[{names}] "
+                f"({len(self._sources)} partitions, {len(self._plan)} stages)")

@@ -1,0 +1,98 @@
+"""Tensor columns over Arrow.
+
+Numeric tensors ride in Arrow as FixedSizeList<float32/...> columns with
+the row shape recorded in field metadata (key ``tensor_shape``). This is
+the TPU build's replacement for the reference's Spark ``ml.linalg.Vector``
+output columns and TensorFrames' row-block tensor conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+TENSOR_SHAPE_KEY = b"tensor_shape"
+
+_PA_BY_NP = {
+    np.dtype(np.float32): pa.float32(),
+    np.dtype(np.float64): pa.float64(),
+    np.dtype(np.int32): pa.int32(),
+    np.dtype(np.int64): pa.int64(),
+    np.dtype(np.uint8): pa.uint8(),
+    np.dtype(np.bool_): pa.bool_(),
+}
+
+
+def _shape_to_meta(shape: Sequence[int]) -> bytes:
+    return ",".join(str(int(d)) for d in shape).encode()
+
+
+def _meta_to_shape(meta: bytes) -> Tuple[int, ...]:
+    if not meta:
+        return ()
+    return tuple(int(d) for d in meta.decode().split(","))
+
+
+def tensor_field(name: str, shape: Sequence[int],
+                 dtype=np.float32) -> pa.Field:
+    """Arrow field for a tensor column of per-row ``shape``."""
+    pa_type = _PA_BY_NP[np.dtype(dtype)]
+    size = int(np.prod(shape)) if len(shape) else 1
+    return pa.field(name, pa.list_(pa_type, size),
+                    metadata={TENSOR_SHAPE_KEY: _shape_to_meta(shape)})
+
+
+def tensor_to_arrow(array: np.ndarray) -> Tuple[pa.Array, bytes]:
+    """[N, *shape] ndarray → (FixedSizeListArray, shape-metadata bytes)."""
+    array = np.ascontiguousarray(array)
+    n = array.shape[0]
+    row_shape = array.shape[1:]
+    size = int(np.prod(row_shape)) if row_shape else 1
+    pa_type = _PA_BY_NP[array.dtype]
+    flat = pa.array(array.reshape(-1), type=pa_type)
+    fsl = pa.FixedSizeListArray.from_arrays(flat, size)
+    return fsl, _shape_to_meta(row_shape)
+
+
+def append_tensor_column(batch: pa.RecordBatch, name: str,
+                         array: np.ndarray) -> pa.RecordBatch:
+    """Append ndarray [N, *shape] as a tensor column to a record batch."""
+    fsl, meta = tensor_to_arrow(array)
+    field = pa.field(name, fsl.type, metadata={TENSOR_SHAPE_KEY: meta})
+    return batch.append_column(field, fsl)
+
+
+def tensor_shape_of(field: pa.Field) -> Optional[Tuple[int, ...]]:
+    """Row shape recorded on the field, if any."""
+    md = field.metadata or {}
+    if TENSOR_SHAPE_KEY in md:
+        return _meta_to_shape(md[TENSOR_SHAPE_KEY])
+    if pa.types.is_fixed_size_list(field.type):
+        return (field.type.list_size,)
+    return None
+
+
+def arrow_to_tensor(column, field: Optional[pa.Field] = None) -> np.ndarray:
+    """Tensor / numeric column → ndarray [N, *shape].
+
+    Accepts FixedSizeList (tensor), variable List (ragged rows must agree
+    in length), or plain numeric columns (→ [N]).
+    """
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    typ = column.type
+    if pa.types.is_fixed_size_list(typ):
+        size = typ.list_size
+        values = column.flatten()
+        np_vals = values.to_numpy(zero_copy_only=False)
+        out = np_vals.reshape(len(column), size)
+        shape = tensor_shape_of(field) if field is not None else None
+        if shape:
+            out = out.reshape((len(column),) + tuple(shape))
+        return out
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        rows = column.to_pylist()
+        return np.asarray(rows)
+    return column.to_numpy(zero_copy_only=False)

@@ -1,0 +1,19 @@
+"""Image I/O and schema (reference L3: ``python/sparkdl/image/``)."""
+
+from sparkdl_tpu.image.imageIO import (  # noqa: F401
+    imageArrayToStruct,
+    imageSchema,
+    imageStructToArray,
+    imageType,
+    ocvTypes,
+    readImages,
+)
+
+__all__ = [
+    "imageSchema",
+    "imageType",
+    "ocvTypes",
+    "readImages",
+    "imageArrayToStruct",
+    "imageStructToArray",
+]

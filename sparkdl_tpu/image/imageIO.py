@@ -1,0 +1,288 @@
+"""Image file reading, codecs, and the image struct schema.
+
+TPU-native re-design of the reference's
+``python/sparkdl/image/imageIO.py`` (``imageSchema``, ``imageType``,
+``readImages``, ``filesToDF``, ``_decodeImage``, ``imageArrayToStruct``,
+``imageStructToArray``, resize-UDF helper). Differences by design:
+
+* Rows live in Arrow record batches, not Spark Rows; the image struct is
+  an Arrow struct column ``{origin, height, width, nChannels, mode, data}``
+  binary-compatible in spirit with Spark 2.3's ImageSchema.
+* Decode/resize runs on host CPU threads of the local engine (the analogue
+  of Spark python workers), producing contiguous uint8 buffers ready for
+  TPU infeed; channel data is kept RGB (the reference's Spark-era structs
+  were BGR for OpenCV compat — ``ocvTypes`` is provided for conversion).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+from PIL import Image
+
+from sparkdl_tpu.data.frame import DataFrame, Source
+
+# OpenCV type codes, for compatibility with Spark ImageSchema consumers
+# (reference imageIO exposed the same notion via its image `mode`).
+ocvTypes = {
+    "Undefined": -1,
+    "CV_8U": 0, "CV_8UC1": 0,
+    "CV_8UC3": 16,
+    "CV_8UC4": 24,
+}
+
+_MODE_BY_CHANNELS = {1: ocvTypes["CV_8UC1"], 3: ocvTypes["CV_8UC3"],
+                     4: ocvTypes["CV_8UC4"]}
+_PIL_MODE_BY_CHANNELS = {1: "L", 3: "RGB", 4: "RGBA"}
+
+# Arrow schema of one image struct (field order mirrors Spark ImageSchema).
+imageFields = [
+    pa.field("origin", pa.string()),
+    pa.field("height", pa.int32()),
+    pa.field("width", pa.int32()),
+    pa.field("nChannels", pa.int32()),
+    pa.field("mode", pa.int32()),
+    pa.field("data", pa.binary()),
+]
+imageType = pa.struct(imageFields)
+imageSchema = pa.schema([pa.field("image", imageType)])
+
+_SUPPORTED_EXTENSIONS = (".jpg", ".jpeg", ".png", ".gif", ".bmp", ".ppm",
+                         ".tif", ".tiff", ".webp")
+
+
+# ---------------------------------------------------------------------------
+# codecs: ndarray <-> struct dict  (reference imageArrayToStruct/StructToArray)
+# ---------------------------------------------------------------------------
+
+def imageArrayToStruct(imgArray: np.ndarray, origin: str = "") -> dict:
+    """HWC uint8 ndarray → image struct dict."""
+    arr = np.asarray(imgArray)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.ndim != 3:
+        raise ValueError(f"expected HWC array, got shape {arr.shape}")
+    if arr.dtype != np.uint8:
+        if np.issubdtype(arr.dtype, np.floating) and arr.max() <= 1.0 + 1e-6:
+            arr = (arr * 255).round()
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    h, w, c = arr.shape
+    if c not in _MODE_BY_CHANNELS:
+        raise ValueError(f"unsupported channel count {c}")
+    return {
+        "origin": origin,
+        "height": int(h),
+        "width": int(w),
+        "nChannels": int(c),
+        "mode": _MODE_BY_CHANNELS[c],
+        "data": np.ascontiguousarray(arr).tobytes(),
+    }
+
+
+def imageStructToArray(imageRow: dict) -> np.ndarray:
+    """Image struct dict → HWC uint8 ndarray."""
+    h, w, c = imageRow["height"], imageRow["width"], imageRow["nChannels"]
+    data = imageRow["data"]
+    arr = np.frombuffer(data, dtype=np.uint8)
+    if arr.size != h * w * c:
+        raise ValueError(
+            f"data size {arr.size} != h*w*c = {h}*{w}*{c}")
+    return arr.reshape(h, w, c)
+
+
+def imageStructToPIL(imageRow: dict) -> Image.Image:
+    arr = imageStructToArray(imageRow)
+    c = arr.shape[2]
+    mode = _PIL_MODE_BY_CHANNELS[c]
+    return Image.fromarray(arr.squeeze(-1) if c == 1 else arr, mode=mode)
+
+
+def _decodeImage(imageData: bytes, origin: str = "") -> Optional[dict]:
+    """Decode compressed bytes with PIL → image struct (None on failure) —
+    reference ``imageIO._decodeImage``."""
+    try:
+        img = Image.open(io.BytesIO(imageData))
+        if img.mode not in ("L", "RGB", "RGBA"):
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+    except Exception:
+        return None
+    return imageArrayToStruct(arr, origin=origin)
+
+
+# ---------------------------------------------------------------------------
+# Arrow batch helpers
+# ---------------------------------------------------------------------------
+
+def structsToBatch(structs: Sequence[Optional[dict]],
+                   extra_columns: Optional[dict] = None) -> pa.RecordBatch:
+    """List of image-struct dicts (None → null row) → record batch with an
+    ``image`` struct column (+ optional extra columns)."""
+    arr = pa.array(list(structs), type=imageType)
+    cols = {"image": arr}
+    if extra_columns:
+        cols.update(extra_columns)
+    return pa.RecordBatch.from_pydict(cols)
+
+
+def batchToStructs(column) -> List[Optional[dict]]:
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    return column.to_pylist()
+
+
+def imageColumnToNHWC(column, height: int, width: int,
+                      nChannels: int = 3) -> np.ndarray:
+    """Image struct column (all rows already h×w×c) → contiguous
+    [N,H,W,C] uint8 array, zero rows for nulls. The fast path the runner
+    feeds to the TPU."""
+    structs = batchToStructs(column)
+    out = np.zeros((len(structs), height, width, nChannels), dtype=np.uint8)
+    for i, s in enumerate(structs):
+        if s is None:
+            continue
+        if s["height"] != height or s["width"] != width \
+                or s["nChannels"] != nChannels:
+            raise ValueError(
+                f"row {i}: image is {s['height']}x{s['width']}x"
+                f"{s['nChannels']}, expected {height}x{width}x{nChannels}; "
+                "resize first")
+        out[i] = imageStructToArray(s)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# resize  (reference createResizeImageUDF / Scala ImageUtils.resizeImage)
+# ---------------------------------------------------------------------------
+
+def resizeImageArray(arr: np.ndarray, height: int, width: int,
+                     nChannels: Optional[int] = None) -> np.ndarray:
+    """Bilinear resize via PIL (native C++ resize shim replaces this on the
+    hot path when built — see sparkdl_tpu/native)."""
+    c = arr.shape[2]
+    if nChannels is not None and nChannels != c:
+        if c == 1 and nChannels == 3:
+            arr = np.repeat(arr, 3, axis=2)
+        elif c == 4 and nChannels == 3:
+            arr = arr[:, :, :3]
+        elif c == 3 and nChannels == 1:
+            pil = Image.fromarray(arr, "RGB").convert("L")
+            arr = np.asarray(pil)[:, :, None]
+        else:
+            raise ValueError(f"cannot convert {c} channels to {nChannels}")
+        c = nChannels
+    if arr.shape[0] == height and arr.shape[1] == width:
+        return arr
+    pil = Image.fromarray(arr.squeeze(-1) if c == 1 else arr,
+                          _PIL_MODE_BY_CHANNELS[c])
+    pil = pil.resize((width, height), Image.BILINEAR)
+    out = np.asarray(pil)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
+                         ) -> Callable[[pa.RecordBatch], pa.Array]:
+    """Batch function resizing the ``image`` column to (height, width) —
+    usable with ``DataFrame.with_column``."""
+    height, width = int(size[0]), int(size[1])
+
+    def _resize(batch: pa.RecordBatch) -> pa.Array:
+        idx = batch.schema.get_field_index("image")
+        structs = batchToStructs(batch.column(idx))
+        out = []
+        for s in structs:
+            if s is None:
+                out.append(None)
+                continue
+            arr = imageStructToArray(s)
+            arr = resizeImageArray(arr, height, width, nChannels)
+            out.append(imageArrayToStruct(arr, origin=s["origin"]))
+        return pa.array(out, type=imageType)
+
+    return _resize
+
+
+# ---------------------------------------------------------------------------
+# readImages  (reference readImages/_readImages/filesToDF)
+# ---------------------------------------------------------------------------
+
+def listImageFiles(path: str, recursive: bool = True) -> List[str]:
+    """Expand a file, directory, or glob pattern into image file paths."""
+    import glob as _glob
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        pattern = os.path.join(path, "**" if recursive else "*")
+        files = _glob.glob(pattern, recursive=recursive)
+    else:
+        files = _glob.glob(path, recursive=recursive)
+    out = [f for f in sorted(files)
+           if os.path.isfile(f)
+           and f.lower().endswith(_SUPPORTED_EXTENSIONS)]
+    return out
+
+
+def filesToDF(paths: Sequence[str], numPartitions: int = 8,
+              engine=None) -> DataFrame:
+    """File paths → DataFrame[filePath: string, fileData: binary], read
+    lazily per partition on engine host threads (reference ``filesToDF``
+    over ``sc.binaryFiles``)."""
+    paths = list(paths)
+    numPartitions = max(1, min(numPartitions, max(1, len(paths))))
+    chunks = np.array_split(np.asarray(paths, dtype=object), numPartitions)
+
+    def _make_load(chunk):
+        def _load() -> pa.RecordBatch:
+            datas = []
+            for p in chunk:
+                with open(p, "rb") as f:
+                    datas.append(f.read())
+            return pa.RecordBatch.from_pydict({
+                "filePath": pa.array([str(p) for p in chunk],
+                                     type=pa.string()),
+                "fileData": pa.array(datas, type=pa.binary()),
+            })
+        return _load
+
+    sources = [Source(_make_load(c), len(c)) for c in chunks if len(c)]
+    if not sources:
+        empty = pa.RecordBatch.from_pydict({
+            "filePath": pa.array([], type=pa.string()),
+            "fileData": pa.array([], type=pa.binary())})
+        sources = [Source(lambda: empty, 0)]
+    return DataFrame(sources, engine=engine)
+
+
+def readImages(imageDirectory: str, numPartitions: int = 8,
+               dropImageFailures: bool = True, engine=None) -> DataFrame:
+    """Read images under a directory/glob into
+    DataFrame[filePath, image-struct] (reference ``readImages``).
+
+    Decode happens lazily, per partition, on engine host threads.
+    """
+    paths = listImageFiles(imageDirectory)
+    df = filesToDF(paths, numPartitions=numPartitions, engine=engine)
+
+    def _decode(batch: pa.RecordBatch) -> pa.RecordBatch:
+        fp = batch.column(0).to_pylist()
+        data = batch.column(1).to_pylist()
+        structs = [_decodeImage(d, origin=p) for p, d in zip(fp, data)]
+        out = pa.RecordBatch.from_pydict({
+            "filePath": pa.array(fp, type=pa.string()),
+            "image": pa.array(structs, type=imageType),
+        })
+        return out
+
+    df = df.map_batches(_decode, name="decodeImage")
+    if dropImageFailures:
+        def _valid(batch: pa.RecordBatch) -> pa.Array:
+            return pa.compute.is_valid(
+                batch.column(batch.schema.get_field_index("image")))
+        df = df.filter(_valid)
+    return df

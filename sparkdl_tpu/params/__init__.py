@@ -1,0 +1,74 @@
+"""Typed parameter system and ML-pipeline base classes.
+
+TPU-native re-design of the reference's config layer
+(``python/sparkdl/param/__init__.py::SparkDLTypeConverters`` and the
+``Has*`` mixins), which itself sat on ``pyspark.ml.param.Params``. Since
+this framework is Spark-free, the pipeline substrate (``Params``,
+``Transformer``, ``Estimator``, ``Pipeline``, ``CrossValidator``) is
+implemented in-tree with the same composition semantics, so param maps and
+CrossValidator-style sweeps work the way reference users expect.
+"""
+
+from sparkdl_tpu.params.base import (  # noqa: F401
+    Param,
+    Params,
+    TypeConverters,
+    keyword_only,
+)
+from sparkdl_tpu.params.pipeline import (  # noqa: F401
+    Estimator,
+    Evaluator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+from sparkdl_tpu.params.tuning import (  # noqa: F401
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+)
+from sparkdl_tpu.params.shared import (  # noqa: F401
+    HasBatchSize,
+    HasInputCol,
+    HasInputMapping,
+    HasKerasLoss,
+    HasKerasModel,
+    HasKerasOptimizer,
+    HasLabelCol,
+    HasModelFunction,
+    HasOutputCol,
+    HasOutputMapping,
+    HasOutputMode,
+)
+from sparkdl_tpu.params.image import CanLoadImage  # noqa: F401
+
+__all__ = [
+    "Param",
+    "Params",
+    "TypeConverters",
+    "keyword_only",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "Evaluator",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "HasInputCol",
+    "HasOutputCol",
+    "HasLabelCol",
+    "HasOutputMode",
+    "HasBatchSize",
+    "HasKerasModel",
+    "HasKerasOptimizer",
+    "HasKerasLoss",
+    "HasInputMapping",
+    "HasOutputMapping",
+    "HasModelFunction",
+    "CanLoadImage",
+]

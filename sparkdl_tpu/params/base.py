@@ -1,0 +1,293 @@
+"""Core Param/Params machinery.
+
+Re-designed equivalent of pyspark's ``Params`` plus the reference's
+``python/sparkdl/param/__init__.py::SparkDLTypeConverters`` and
+``keyword_only`` decorator. Params are typed, copy-on-write, and support
+param maps (dict[Param, value]) so grid search / CrossValidator semantics
+match what reference users expect.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import inspect
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+class Param:
+    """A typed parameter slot attached to a ``Params`` owner class.
+
+    Unlike pyspark, the canonical identity of a Param is
+    ``(owner class qualname, name)`` so Params survive instance copies.
+    """
+
+    __slots__ = ("parent", "name", "doc", "typeConverter")
+
+    def __init__(self, parent: str, name: str, doc: str,
+                 typeConverter: Optional[Callable[[Any], Any]] = None):
+        self.parent = parent
+        self.name = name
+        self.doc = doc
+        self.typeConverter = typeConverter or (lambda x: x)
+
+    def __repr__(self) -> str:
+        return f"Param({self.parent}.{self.name})"
+
+    def __hash__(self) -> int:
+        return hash((self.parent, self.name))
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, Param)
+                and self.parent == other.parent and self.name == other.name)
+
+
+def keyword_only(func):
+    """Decorator forcing keyword-only construction and capturing kwargs.
+
+    Mirror of the reference's ``keyword_only`` (upstream
+    ``python/sparkdl/param/__init__.py``): the wrapped method sees its
+    keyword arguments in ``self._input_kwargs``.
+    """
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        if args:
+            raise TypeError(
+                f"{func.__name__}() only accepts keyword arguments; "
+                f"got {len(args)} positional")
+        self._input_kwargs = kwargs
+        return func(self, **kwargs)
+
+    wrapper._keyword_only = True
+    return wrapper
+
+
+class Params:
+    """Base class for anything carrying typed params.
+
+    Semantics follow pyspark: a class-level ``Param`` descriptor registry,
+    per-instance ``_paramMap`` (explicitly set) over ``_defaultParamMap``.
+    """
+
+    def __init__(self):
+        self._paramMap: Dict[Param, Any] = {}
+        self._defaultParamMap: Dict[Param, Any] = {}
+        self._params_lock = threading.RLock()
+        uid_cls = type(self).__name__
+        self.uid = f"{uid_cls}_{id(self):x}"
+
+    # -- registry -----------------------------------------------------------
+
+    @property
+    def params(self) -> list:
+        """All Params declared on the class hierarchy, name-sorted."""
+        seen = {}
+        for klass in reversed(type(self).__mro__):
+            for name, attr in vars(klass).items():
+                if isinstance(attr, Param):
+                    seen[attr.name] = attr
+        return [seen[k] for k in sorted(seen)]
+
+    def hasParam(self, paramName: str) -> bool:
+        return any(p.name == paramName for p in self.params)
+
+    def getParam(self, paramName: str) -> Param:
+        for p in self.params:
+            if p.name == paramName:
+                return p
+        raise AttributeError(
+            f"{type(self).__name__} has no param '{paramName}'")
+
+    def _resolveParam(self, param) -> Param:
+        if isinstance(param, Param):
+            return self.getParam(param.name)
+        if isinstance(param, str):
+            return self.getParam(param)
+        raise TypeError(f"cannot resolve param from {param!r}")
+
+    # -- get/set ------------------------------------------------------------
+
+    def isSet(self, param) -> bool:
+        return self._resolveParam(param) in self._paramMap
+
+    def isDefined(self, param) -> bool:
+        p = self._resolveParam(param)
+        return p in self._paramMap or p in self._defaultParamMap
+
+    def getOrDefault(self, param):
+        p = self._resolveParam(param)
+        if p in self._paramMap:
+            return self._paramMap[p]
+        if p in self._defaultParamMap:
+            return self._defaultParamMap[p]
+        raise KeyError(f"param {p.name} is not set and has no default")
+
+    def set(self, param, value) -> "Params":
+        p = self._resolveParam(param)
+        self._paramMap[p] = p.typeConverter(value)
+        return self
+
+    def _set(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            if value is None:
+                continue
+            p = self.getParam(name)
+            self._paramMap[p] = p.typeConverter(value)
+        return self
+
+    def _setDefault(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            p = self.getParam(name)
+            self._defaultParamMap[p] = value
+        return self
+
+    def clear(self, param) -> "Params":
+        self._paramMap.pop(self._resolveParam(param), None)
+        return self
+
+    def extractParamMap(self, extra: Optional[dict] = None) -> dict:
+        pm = dict(self._defaultParamMap)
+        pm.update(self._paramMap)
+        if extra:
+            pm.update(extra)
+        return pm
+
+    def explainParams(self) -> str:
+        lines = []
+        for p in self.params:
+            cur = (repr(self.getOrDefault(p))
+                   if self.isDefined(p) else "undefined")
+            lines.append(f"{p.name}: {p.doc} (current: {cur})")
+        return "\n".join(lines)
+
+    # -- copy ---------------------------------------------------------------
+
+    def copy(self, extra: Optional[dict] = None) -> "Params":
+        that = copy.copy(self)
+        that._paramMap = dict(self._paramMap)
+        that._defaultParamMap = dict(self._defaultParamMap)
+        if extra:
+            for p, v in extra.items():
+                rp = that._resolveParam(p)
+                that._paramMap[rp] = rp.typeConverter(v)
+        return that
+
+    def _copyValues(self, to: "Params", extra: Optional[dict] = None):
+        pm = self.extractParamMap(extra)
+        for p, v in pm.items():
+            if to.hasParam(p.name):
+                to._set(**{p.name: v})
+        return to
+
+
+class TypeConverters:
+    """Typed converters for Param values.
+
+    Re-design of the reference's
+    ``python/sparkdl/param/__init__.py::SparkDLTypeConverters`` — the
+    TF-specific converters (``toTFGraph``, ``toStringOrTFTensor``) become
+    their TPU-era counterparts (model functions, tensor-name strings).
+    """
+
+    @staticmethod
+    def toString(value) -> str:
+        if isinstance(value, str):
+            return value
+        raise TypeError(f"expected str, got {type(value).__name__}")
+
+    @staticmethod
+    def toInt(value) -> int:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise TypeError(f"expected int, got {type(value).__name__}")
+        if int(value) != value:
+            raise TypeError(f"expected integral value, got {value}")
+        return int(value)
+
+    @staticmethod
+    def toFloat(value) -> float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise TypeError(f"expected float, got {type(value).__name__}")
+        return float(value)
+
+    @staticmethod
+    def toBoolean(value) -> bool:
+        if not isinstance(value, bool):
+            raise TypeError(f"expected bool, got {type(value).__name__}")
+        return value
+
+    @staticmethod
+    def toList(value) -> list:
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        raise TypeError(f"expected list, got {type(value).__name__}")
+
+    @staticmethod
+    def toListString(value) -> list:
+        value = TypeConverters.toList(value)
+        if not all(isinstance(v, str) for v in value):
+            raise TypeError("expected list of str")
+        return value
+
+    @staticmethod
+    def toCallable(value):
+        if callable(value):
+            return value
+        raise TypeError(f"expected callable, got {type(value).__name__}")
+
+    @staticmethod
+    def toStringDict(value) -> dict:
+        """{str: str} mapping — column↔tensor maps, reference's
+        column-to-tensor-name converters in SparkDLTypeConverters."""
+        if isinstance(value, dict):
+            items = value.items()
+        elif isinstance(value, (list, tuple)):
+            items = list(value)
+        else:
+            raise TypeError(
+                f"expected dict or pair-list, got {type(value).__name__}")
+        out = {}
+        for k, v in items:
+            if not isinstance(k, str) or not isinstance(v, str):
+                raise TypeError("mapping keys and values must be str")
+            out[k] = v
+        return out
+
+    @staticmethod
+    def toModelFunction(value):
+        """Accepts a ModelFunction (the XlaFunction/StableHLO bundle) —
+        TPU-era replacement of ``toTFGraph``/``toTFInputGraph``."""
+        from sparkdl_tpu.graph.function import ModelFunction
+        if isinstance(value, ModelFunction):
+            return value
+        raise TypeError(
+            f"expected ModelFunction, got {type(value).__name__}")
+
+    @staticmethod
+    def toOptimizer(value):
+        """Accepts an optax GradientTransformation or its factory name
+        (reference: ``toKerasOptimizer``)."""
+        import optax
+        if isinstance(value, str):
+            if not hasattr(optax, value):
+                raise TypeError(f"unknown optax optimizer '{value}'")
+            return value
+        if isinstance(value, optax.GradientTransformation):
+            return value
+        raise TypeError(
+            f"expected optimizer name or optax transform, got {value!r}")
+
+    @staticmethod
+    def toLoss(value):
+        """Accepts a loss callable or an optax loss name
+        (reference: ``toKerasLoss``)."""
+        import optax
+        if isinstance(value, str):
+            if not hasattr(optax, value) and value not in (
+                    "categorical_crossentropy", "binary_crossentropy", "mse"):
+                raise TypeError(f"unknown loss '{value}'")
+            return value
+        if callable(value):
+            return value
+        raise TypeError(f"expected loss name or callable, got {value!r}")

@@ -1,0 +1,48 @@
+"""Image-loading param mixin.
+
+Re-design of the reference's ``python/sparkdl/param/image_params.py``
+(``CanLoadImage``): stages that consume a column of image URIs and a
+user-supplied ``imageLoader(uri) -> ndarray`` callable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparkdl_tpu.params.base import Param, Params, TypeConverters
+
+
+class CanLoadImage(Params):
+    """Mixin for stages taking an image-URI column plus a user loader.
+
+    ``imageLoader`` maps a URI string to a float/uint8 ndarray of the
+    model's expected HWC input shape — exactly the reference's contract
+    (``image_params.py::CanLoadImage``), decoded on host CPU threads here
+    rather than in Spark python workers.
+    """
+
+    imageLoader = Param("CanLoadImage", "imageLoader",
+                        "callable(uri: str) -> np.ndarray (HWC)",
+                        TypeConverters.toCallable)
+
+    def setImageLoader(self, value):
+        return self._set(imageLoader=value)
+
+    def getImageLoader(self):
+        return self.getOrDefault("imageLoader")
+
+    def loadImagesInternal(self, dataframe, uri_col: str, out_col: str):
+        """Append a decoded-tensor column by mapping the loader over the
+        URI column on host threads (the reference built a hidden
+        image-loading column the same way)."""
+        loader = self.getImageLoader()
+
+        def _load(batch):
+            uris = batch.column(batch.schema.get_field_index(uri_col)) \
+                .to_pylist()
+            arrs = [np.asarray(loader(u), dtype=np.float32) for u in uris]
+            if not arrs:
+                return np.zeros((0, 1), dtype=np.float32)
+            return np.stack(arrs)
+
+        return dataframe.with_column(out_col, _load)

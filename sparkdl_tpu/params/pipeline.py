@@ -1,0 +1,123 @@
+"""Transformer / Estimator / Pipeline base classes.
+
+The reference rode on Spark ML's abstractions
+(``pyspark.ml.Transformer``/``Estimator``/``Pipeline``); here they are
+implemented natively over the Arrow-backed :class:`sparkdl_tpu.data.DataFrame`
+with the same composition semantics (``Pipeline(stages=[...]).fit(df)``,
+``model.transform(df)``, param-map overrides on both).
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Iterable, List, Optional, Sequence
+
+from sparkdl_tpu.params.base import Param, Params, TypeConverters, keyword_only
+
+
+class Transformer(Params):
+    """A pipeline stage mapping DataFrame → DataFrame."""
+
+    def transform(self, dataset, params: Optional[dict] = None):
+        if params:
+            return self.copy(params)._transform(dataset)
+        return self._transform(dataset)
+
+    @abstractmethod
+    def _transform(self, dataset):
+        raise NotImplementedError
+
+
+class Estimator(Params):
+    """A pipeline stage fit(DataFrame) → Model."""
+
+    def fit(self, dataset, params=None):
+        if params is None:
+            return self._fit(dataset)
+        if isinstance(params, dict):
+            return self.copy(params)._fit(dataset)
+        if isinstance(params, (list, tuple)):
+            return [m for _, m in self.fitMultiple(dataset, list(params))]
+        raise TypeError(f"params must be dict or list of dicts, got {params!r}")
+
+    def fitMultiple(self, dataset, paramMaps: Sequence[dict]):
+        """Yield ``(index, model)`` for each param map. Subclasses with a
+        parallel path (the Keras estimator) override this; the default fits
+        sequentially."""
+        for i, pm in enumerate(paramMaps):
+            yield i, self.copy(pm)._fit(dataset)
+
+    @abstractmethod
+    def _fit(self, dataset):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A Transformer produced by an Estimator."""
+
+
+class PipelineModel(Model):
+    """Sequentially applies fitted stages."""
+
+    def __init__(self, stages: List[Transformer]):
+        super().__init__()
+        self.stages = list(stages)
+
+    def _transform(self, dataset):
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset
+
+    def copy(self, extra: Optional[dict] = None) -> "PipelineModel":
+        that = PipelineModel([s.copy(extra) for s in self.stages])
+        return that
+
+
+class Pipeline(Estimator):
+    """Chain of Transformers/Estimators, fitted front-to-back."""
+
+    stages = Param("Pipeline", "stages", "pipeline stages",
+                   TypeConverters.toList)
+
+    @keyword_only
+    def __init__(self, *, stages: Optional[List[Params]] = None):
+        super().__init__()
+        self._set(stages=stages or [])
+
+    def setStages(self, stages: List[Params]) -> "Pipeline":
+        return self._set(stages=stages)
+
+    def getStages(self) -> List[Params]:
+        return self.getOrDefault("stages")
+
+    def _fit(self, dataset) -> PipelineModel:
+        stages = self.getStages()
+        for s in stages:
+            if not isinstance(s, (Transformer, Estimator)):
+                raise TypeError(f"pipeline stage {s!r} is neither Transformer "
+                                "nor Estimator")
+        fitted: List[Transformer] = []
+        last_est = max((i for i, s in enumerate(stages)
+                        if isinstance(s, Estimator)), default=-1)
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(dataset)
+                fitted.append(model)
+                if i < last_est:
+                    dataset = model.transform(dataset)
+            else:
+                fitted.append(stage)
+                if i < last_est:
+                    dataset = stage.transform(dataset)
+        return PipelineModel(fitted)
+
+
+class Evaluator(Params):
+    """Scores a transformed DataFrame; used by CrossValidator."""
+
+    @abstractmethod
+    def evaluate(self, dataset) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True

@@ -1,0 +1,173 @@
+"""Shared param mixins.
+
+Re-design of the reference's ``python/sparkdl/param/shared_params.py``
+(``HasInputCol``/``HasOutputCol``/``HasLabelCol``, ``HasKerasModel``,
+``HasKerasOptimizer``, ``HasKerasLoss``, ``HasOutputMode``,
+``HasInputMapping``/``HasOutputMapping``, ``HasTFInputGraph``). TF-graph
+params become ModelFunction params; Keras params keep their names because
+Keras 3 (JAX backend) is the supported user-model format.
+"""
+
+from __future__ import annotations
+
+from sparkdl_tpu.params.base import Param, Params, TypeConverters
+
+
+class HasInputCol(Params):
+    inputCol = Param("HasInputCol", "inputCol", "input column name",
+                     TypeConverters.toString)
+
+    def setInputCol(self, value: str):
+        return self._set(inputCol=value)
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault("inputCol")
+
+
+class HasOutputCol(Params):
+    outputCol = Param("HasOutputCol", "outputCol", "output column name",
+                      TypeConverters.toString)
+
+    def setOutputCol(self, value: str):
+        return self._set(outputCol=value)
+
+    def getOutputCol(self) -> str:
+        return self.getOrDefault("outputCol")
+
+
+class HasLabelCol(Params):
+    labelCol = Param("HasLabelCol", "labelCol", "label column name",
+                     TypeConverters.toString)
+
+    def setLabelCol(self, value: str):
+        return self._set(labelCol=value)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault("labelCol")
+
+
+class HasOutputMode(Params):
+    """'vector' → flat float features column; 'image' → image struct column
+    (reference ``transformers/tf_image.py`` outputMode)."""
+
+    outputMode = Param("HasOutputMode", "outputMode",
+                       "output mode: 'vector' or 'image'",
+                       TypeConverters.toString)
+
+    def setOutputMode(self, value: str):
+        if value not in ("vector", "image"):
+            raise ValueError(f"outputMode must be 'vector' or 'image', "
+                             f"got {value!r}")
+        return self._set(outputMode=value)
+
+    def getOutputMode(self) -> str:
+        return self.getOrDefault("outputMode")
+
+
+class HasBatchSize(Params):
+    """Device batch size for the partition runner (TPU-era addition: static
+    shapes are required for XLA; batches are padded to this size)."""
+
+    batchSize = Param("HasBatchSize", "batchSize",
+                      "device batch size (batches padded to this for XLA "
+                      "static shapes)", TypeConverters.toInt)
+
+    def setBatchSize(self, value: int):
+        return self._set(batchSize=value)
+
+    def getBatchSize(self) -> int:
+        return self.getOrDefault("batchSize")
+
+
+class HasKerasModel(Params):
+    """Path to a user Keras model file (.h5 / .keras), loaded with the JAX
+    backend (reference ``HasKerasModel.modelFile`` + ``kerasFitParams``)."""
+
+    modelFile = Param("HasKerasModel", "modelFile",
+                      "path to Keras model file (.h5 or .keras)",
+                      TypeConverters.toString)
+    kerasFitParams = Param("HasKerasModel", "kerasFitParams",
+                           "kwargs dict for the training loop "
+                           "(epochs, batch_size, ...)")
+
+    def setModelFile(self, value: str):
+        return self._set(modelFile=value)
+
+    def getModelFile(self) -> str:
+        return self.getOrDefault("modelFile")
+
+    def setKerasFitParams(self, value: dict):
+        return self._set(kerasFitParams=dict(value))
+
+    def getKerasFitParams(self) -> dict:
+        return dict(self.getOrDefault("kerasFitParams"))
+
+
+class HasKerasOptimizer(Params):
+    kerasOptimizer = Param("HasKerasOptimizer", "kerasOptimizer",
+                           "optax optimizer name or GradientTransformation",
+                           TypeConverters.toOptimizer)
+
+    def setKerasOptimizer(self, value):
+        return self._set(kerasOptimizer=value)
+
+    def getKerasOptimizer(self):
+        return self.getOrDefault("kerasOptimizer")
+
+
+class HasKerasLoss(Params):
+    kerasLoss = Param("HasKerasLoss", "kerasLoss",
+                      "loss name or callable(params_out, labels) -> scalar",
+                      TypeConverters.toLoss)
+
+    def setKerasLoss(self, value):
+        return self._set(kerasLoss=value)
+
+    def getKerasLoss(self):
+        return self.getOrDefault("kerasLoss")
+
+
+class HasInputMapping(Params):
+    """DataFrame column → model input name (reference
+    ``TFTransformer.inputMapping``)."""
+
+    inputMapping = Param("HasInputMapping", "inputMapping",
+                         "dict: input column name -> model input name",
+                         TypeConverters.toStringDict)
+
+    def setInputMapping(self, value):
+        return self._set(inputMapping=value)
+
+    def getInputMapping(self) -> dict:
+        return self.getOrDefault("inputMapping")
+
+
+class HasOutputMapping(Params):
+    """Model output name → DataFrame column (reference
+    ``TFTransformer.outputMapping``)."""
+
+    outputMapping = Param("HasOutputMapping", "outputMapping",
+                          "dict: model output name -> output column name",
+                          TypeConverters.toStringDict)
+
+    def setOutputMapping(self, value):
+        return self._set(outputMapping=value)
+
+    def getOutputMapping(self) -> dict:
+        return self.getOrDefault("outputMapping")
+
+
+class HasModelFunction(Params):
+    """The compiled-model param — TPU-era successor of the reference's
+    ``HasTFInputGraph`` (a frozen TF GraphDef bundle becomes a
+    :class:`sparkdl_tpu.graph.function.ModelFunction`)."""
+
+    modelFunction = Param("HasModelFunction", "modelFunction",
+                          "ModelFunction (jittable fn + params + signature)",
+                          TypeConverters.toModelFunction)
+
+    def setModelFunction(self, value):
+        return self._set(modelFunction=value)
+
+    def getModelFunction(self):
+        return self.getOrDefault("modelFunction")

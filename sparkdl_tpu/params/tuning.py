@@ -1,0 +1,150 @@
+"""Hyperparameter tuning: ParamGridBuilder, CrossValidator.
+
+The reference composed ``KerasImageFileEstimator`` with
+``pyspark.ml.tuning.CrossValidator`` (reference
+``estimators/keras_image_file_estimator.py`` docs and tests). This module
+provides the same tuning surface natively: k-fold splits over partitioned
+Arrow data, fitMultiple-driven parallel trial execution.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.params.base import Param, Params, TypeConverters, keyword_only
+from sparkdl_tpu.params.pipeline import Estimator, Evaluator, Model
+
+
+class ParamGridBuilder:
+    """Cartesian-product grid of param maps (pyspark-compatible API)."""
+
+    def __init__(self):
+        self._grid: Dict[Param, Sequence] = {}
+
+    def addGrid(self, param: Param, values: Sequence) -> "ParamGridBuilder":
+        self._grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        if len(args) == 1 and isinstance(args[0], dict):
+            args = list(args[0].items())
+        for param, value in args:
+            self._grid[param] = [value]
+        return self
+
+    def build(self) -> List[dict]:
+        keys = list(self._grid)
+        if not keys:
+            return [{}]
+        out = []
+        for combo in itertools.product(*(self._grid[k] for k in keys)):
+            out.append(dict(zip(keys, combo)))
+        return out
+
+
+class CrossValidatorModel(Model):
+    def __init__(self, bestModel: Model, avgMetrics: List[float]):
+        super().__init__()
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics
+
+    def _transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class CrossValidator(Estimator):
+    """k-fold cross validation over an estimator + param grid."""
+
+    estimator = Param("CrossValidator", "estimator", "estimator to tune")
+    estimatorParamMaps = Param("CrossValidator", "estimatorParamMaps",
+                               "param grid", TypeConverters.toList)
+    evaluator = Param("CrossValidator", "evaluator", "metric evaluator")
+    numFolds = Param("CrossValidator", "numFolds", "number of folds",
+                     TypeConverters.toInt)
+    seed = Param("CrossValidator", "seed", "random seed",
+                 TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, estimator=None, estimatorParamMaps=None,
+                 evaluator=None, numFolds=3, seed=42):
+        super().__init__()
+        self._setDefault(numFolds=3, seed=42)
+        self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
+                  evaluator=evaluator, numFolds=numFolds, seed=seed)
+
+    def _kfold(self, dataset):
+        """Split rows into k (train, validation) DataFrame pairs."""
+        k = self.getOrDefault("numFolds")
+        n = dataset.count()
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        fold_of_row = rng.integers(0, k, size=n)
+        for fold in range(k):
+            train = dataset.filter_rows(fold_of_row != fold)
+            valid = dataset.filter_rows(fold_of_row == fold)
+            yield train, valid
+
+    def _fit(self, dataset) -> CrossValidatorModel:
+        est: Estimator = self.getOrDefault("estimator")
+        maps: List[dict] = self.getOrDefault("estimatorParamMaps")
+        ev: Evaluator = self.getOrDefault("evaluator")
+        metrics = np.zeros(len(maps))
+        nfolds = self.getOrDefault("numFolds")
+        for train, valid in self._kfold(dataset):
+            for idx, model in est.fitMultiple(train, maps):
+                metrics[idx] += ev.evaluate(model.transform(valid)) / nfolds
+        best = int(np.argmax(metrics) if ev.isLargerBetter()
+                   else np.argmin(metrics))
+        bestModel = est.fit(dataset, maps[best])
+        return CrossValidatorModel(bestModel, list(metrics))
+
+
+class TrainValidationSplitModel(Model):
+    def __init__(self, bestModel: Model, validationMetrics: List[float]):
+        super().__init__()
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics
+
+    def _transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit(Estimator):
+    """Single random train/validation split over a param grid."""
+
+    estimator = Param("TrainValidationSplit", "estimator", "estimator to tune")
+    estimatorParamMaps = Param("TrainValidationSplit", "estimatorParamMaps",
+                               "param grid", TypeConverters.toList)
+    evaluator = Param("TrainValidationSplit", "evaluator", "metric evaluator")
+    trainRatio = Param("TrainValidationSplit", "trainRatio",
+                       "fraction of rows used for training",
+                       TypeConverters.toFloat)
+    seed = Param("TrainValidationSplit", "seed", "random seed",
+                 TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, estimator=None, estimatorParamMaps=None,
+                 evaluator=None, trainRatio=0.75, seed=42):
+        super().__init__()
+        self._setDefault(trainRatio=0.75, seed=42)
+        self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
+                  evaluator=evaluator, trainRatio=trainRatio, seed=seed)
+
+    def _fit(self, dataset) -> TrainValidationSplitModel:
+        est: Estimator = self.getOrDefault("estimator")
+        maps: List[dict] = self.getOrDefault("estimatorParamMaps")
+        ev: Evaluator = self.getOrDefault("evaluator")
+        n = dataset.count()
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        is_train = rng.random(n) < self.getOrDefault("trainRatio")
+        train = dataset.filter_rows(is_train)
+        valid = dataset.filter_rows(~is_train)
+        metrics = [0.0] * len(maps)
+        for idx, model in est.fitMultiple(train, maps):
+            metrics[idx] = ev.evaluate(model.transform(valid))
+        best = int(np.argmax(metrics) if ev.isLargerBetter()
+                   else np.argmin(metrics))
+        bestModel = est.fit(dataset, maps[best])
+        return TrainValidationSplitModel(bestModel, metrics)

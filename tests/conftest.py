@@ -1,0 +1,47 @@
+"""Test harness config.
+
+Mirrors the reference's test substrate choice (local-mode Spark ≈ SURVEY
+§4.1): all "distributed" behavior is tested on a single host with 8
+virtual CPU devices via XLA_FLAGS, so multi-chip sharding code paths run
+anywhere. Must run before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("KERAS_BACKEND", "jax")
+# Keep TF (used only for reading TF-era artifacts) quiet and off any GPU.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def image_dir(tmp_path_factory, rng):
+    """A directory of small real image files (the reference committed
+    tests/resources/images/*.jpg; we synthesize equivalents)."""
+    from PIL import Image
+    d = tmp_path_factory.mktemp("images")
+    sizes = [(32, 48), (64, 64), (21, 33), (128, 96)]
+    for i, (h, w) in enumerate(sizes):
+        arr = rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+        Image.fromarray(arr, "RGB").save(d / f"img_{i}.png")
+    # one jpeg and one grayscale png
+    arr = rng.integers(0, 255, size=(40, 40, 3), dtype=np.uint8)
+    Image.fromarray(arr, "RGB").save(d / "img_jpg.jpg", quality=95)
+    arr = rng.integers(0, 255, size=(16, 16), dtype=np.uint8)
+    Image.fromarray(arr, "L").save(d / "img_gray.png")
+    # one non-image file that must be ignored
+    (d / "notes.txt").write_text("not an image")
+    return str(d)

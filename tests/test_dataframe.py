@@ -1,0 +1,141 @@
+"""DataFrame/engine tests (the engine seam that replaces Spark local-mode
+in the reference's test harness, SURVEY §4.1)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data import DataFrame, LocalEngine, arrow_to_tensor
+from sparkdl_tpu.data.tensors import append_tensor_column, tensor_shape_of
+
+
+def _df(n=100, parts=7):
+    return DataFrame.from_table(
+        pa.table({"x": np.arange(n, dtype=np.float64),
+                  "s": [f"r{i}" for i in range(n)]}), parts)
+
+
+class TestConstruction:
+    def test_partition_count(self):
+        assert _df(100, 7).num_partitions == 7
+        assert _df(3, 8).num_partitions == 3  # capped at rows
+
+    def test_order_preserved(self):
+        tab = _df(100, 7).collect()
+        np.testing.assert_array_equal(tab.column("x").to_numpy(),
+                                      np.arange(100))
+
+    def test_from_pylist(self):
+        df = DataFrame.from_pylist([{"a": 1}, {"a": 2}], 2)
+        assert df.count() == 2
+
+    def test_schema_and_columns(self):
+        df = _df()
+        assert df.columns == ["x", "s"]
+
+
+class TestOps:
+    def test_with_column_numpy_tensor(self):
+        df = _df(10, 2).with_column(
+            "t", lambda b: np.ones((b.num_rows, 2, 3), np.float32))
+        t = df.tensor("t")
+        assert t.shape == (10, 2, 3)
+
+    def test_tensor_shape_metadata(self):
+        batch = pa.RecordBatch.from_pydict({"x": pa.array([1.0, 2.0])})
+        batch = append_tensor_column(batch, "t",
+                                     np.zeros((2, 4, 5), np.float32))
+        assert tensor_shape_of(batch.schema.field("t")) == (4, 5)
+        back = arrow_to_tensor(batch.column(1), batch.schema.field("t"))
+        assert back.shape == (2, 4, 5)
+
+    def test_select_drop_rename(self):
+        df = _df()
+        assert df.select("x").columns == ["x"]
+        assert df.drop("s").columns == ["x"]
+        assert df.rename({"x": "y"}).columns == ["y", "s"]
+
+    def test_filter(self):
+        df = _df(100, 5).filter(
+            lambda b: b.column(0).to_numpy(zero_copy_only=False) < 10)
+        assert df.count() == 10
+
+    def test_filter_rows_global_mask(self):
+        mask = np.zeros(100, dtype=bool)
+        mask[::2] = True
+        df = _df(100, 5).filter_rows(mask)
+        assert df.count() == 50
+        np.testing.assert_array_equal(
+            df.collect().column("x").to_numpy(), np.arange(0, 100, 2))
+
+    def test_count_fast_path_and_slow_path(self):
+        df = _df(100, 5)
+        assert df.count() == 100
+        assert df.filter(lambda b: b.column(0).to_numpy(
+            zero_copy_only=False) >= 0).count() == 100
+
+    def test_take_first(self):
+        df = _df(100, 5)
+        assert df.first()["x"] == 0.0
+        assert [r["x"] for r in df.take(3)] == [0.0, 1.0, 2.0]
+
+    def test_chained_lazy_plan(self):
+        calls = []
+
+        def stage(b):
+            calls.append(1)
+            return b
+
+        df = _df(10, 2).map_batches(stage)
+        assert not calls  # lazy until materialized
+        df.collect()
+        assert len(calls) == 2  # once per partition
+
+
+class TestEngine:
+    def test_host_stages_parallel(self):
+        """Host stages run on multiple threads."""
+        seen = set()
+
+        def stage(b):
+            seen.add(threading.current_thread().name)
+            return b
+
+        engine = LocalEngine(num_workers=4)
+        df = DataFrame.from_table(
+            pa.table({"x": np.arange(64.0)}), 16, engine) \
+            .map_batches(stage)
+        df.collect()
+        assert len(seen) >= 2
+
+    def test_device_stage_serialized(self):
+        """Device stages never overlap."""
+        active = [0]
+        max_active = [0]
+        lock = threading.Lock()
+
+        def dev_stage(b):
+            with lock:
+                active[0] += 1
+                max_active[0] = max(max_active[0], active[0])
+            import time
+            time.sleep(0.005)
+            with lock:
+                active[0] -= 1
+            return b
+
+        engine = LocalEngine(num_workers=8)
+        df = DataFrame.from_table(
+            pa.table({"x": np.arange(64.0)}), 16, engine) \
+            .map_batches(dev_stage, kind="device")
+        df.collect()
+        assert max_active[0] == 1
+
+    def test_stream_order(self):
+        df = _df(50, 10)
+        batches = list(df.stream())
+        xs = np.concatenate(
+            [b.column(0).to_numpy(zero_copy_only=False) for b in batches])
+        np.testing.assert_array_equal(xs, np.arange(50))

@@ -1,0 +1,185 @@
+"""Params/pipeline system tests (reference pattern: pyspark Params
+semantics exercised by every transformer test; SURVEY §2.1 param system)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.params import (
+    CrossValidator,
+    Estimator,
+    Evaluator,
+    HasInputCol,
+    HasOutputCol,
+    Model,
+    Param,
+    ParamGridBuilder,
+    Pipeline,
+    Transformer,
+    TypeConverters,
+    keyword_only,
+)
+
+
+class AddConst(Transformer, HasInputCol, HasOutputCol):
+    value = Param("AddConst", "value", "constant to add",
+                  TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, value=1.0):
+        super().__init__()
+        self._setDefault(value=1.0)
+        self._set(inputCol=inputCol, outputCol=outputCol, value=value)
+
+    def _transform(self, dataset):
+        incol = self.getInputCol()
+        v = self.getOrDefault("value")
+
+        def _fn(batch):
+            x = batch.column(batch.schema.get_field_index(incol)) \
+                .to_numpy(zero_copy_only=False)
+            return pa.array(x + v)
+
+        return dataset.with_column(self.getOutputCol(), _fn)
+
+
+class MeanModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, mean, inputCol, outputCol):
+        super().__init__()
+        self.mean = mean
+        self._set(inputCol=inputCol, outputCol=outputCol)
+
+    def _transform(self, dataset):
+        m = self.mean
+
+        def _fn(batch):
+            n = batch.num_rows
+            return pa.array(np.full(n, m))
+
+        return dataset.with_column(self.getOutputCol(), _fn)
+
+
+class MeanEstimator(Estimator, HasInputCol, HasOutputCol):
+    shift = Param("MeanEstimator", "shift", "added to the learned mean",
+                  TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, shift=0.0):
+        super().__init__()
+        self._setDefault(shift=0.0)
+        self._set(inputCol=inputCol, outputCol=outputCol, shift=shift)
+
+    def _fit(self, dataset):
+        x = dataset.select(self.getInputCol()).collect() \
+            .column(0).to_numpy(zero_copy_only=False)
+        return MeanModel(float(x.mean()) + self.getOrDefault("shift"),
+                         self.getInputCol(), self.getOutputCol())
+
+
+def _df(n=20, parts=4):
+    return DataFrame.from_table(
+        pa.table({"x": np.arange(n, dtype=np.float64)}), parts)
+
+
+class TestParams:
+    def test_set_get_default(self):
+        t = AddConst(inputCol="x", outputCol="y")
+        assert t.getInputCol() == "x"
+        assert t.getOrDefault("value") == 1.0
+        t.set("value", 2)
+        assert t.getOrDefault("value") == 2.0
+
+    def test_keyword_only_rejects_positional(self):
+        with pytest.raises(TypeError):
+            AddConst("x")
+
+    def test_type_converter_rejects(self):
+        t = AddConst(inputCol="x", outputCol="y")
+        with pytest.raises(TypeError):
+            t.set("value", "not-a-number")
+        with pytest.raises(TypeError):
+            t.set("inputCol", 42)
+
+    def test_copy_isolation(self):
+        t = AddConst(inputCol="x", outputCol="y", value=1.0)
+        t2 = t.copy({t.value: 5.0})
+        assert t.getOrDefault("value") == 1.0
+        assert t2.getOrDefault("value") == 5.0
+
+    def test_unknown_param(self):
+        t = AddConst(inputCol="x", outputCol="y")
+        with pytest.raises(AttributeError):
+            t.getParam("nope")
+
+    def test_explain_params(self):
+        t = AddConst(inputCol="x", outputCol="y")
+        s = t.explainParams()
+        assert "inputCol" in s and "value" in s
+
+
+class TestTransform:
+    def test_transform(self):
+        out = AddConst(inputCol="x", outputCol="y", value=10.0) \
+            .transform(_df())
+        tab = out.collect()
+        x = tab.column("x").to_numpy()
+        y = tab.column("y").to_numpy()
+        np.testing.assert_allclose(y, x + 10.0)
+
+    def test_transform_with_extra_params(self):
+        t = AddConst(inputCol="x", outputCol="y", value=1.0)
+        out = t.transform(_df(), {t.value: 3.0})
+        tab = out.collect()
+        np.testing.assert_allclose(tab.column("y").to_numpy(),
+                                   tab.column("x").to_numpy() + 3.0)
+
+
+class TestPipeline:
+    def test_pipeline_fit_transform(self):
+        p = Pipeline(stages=[
+            AddConst(inputCol="x", outputCol="x2", value=1.0),
+            MeanEstimator(inputCol="x2", outputCol="m"),
+        ])
+        model = p.fit(_df(10))
+        tab = model.transform(_df(10)).collect()
+        # mean of x+1 for x in 0..9 = 5.5
+        np.testing.assert_allclose(tab.column("m").to_numpy(), 5.5)
+
+    def test_param_grid(self):
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        grid = ParamGridBuilder() \
+            .addGrid(e.shift, [0.0, 1.0]) \
+            .addGrid(e.getParam("outputCol"), ["m1", "m2"]).build()
+        assert len(grid) == 4
+
+    def test_fit_multiple(self):
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        maps = [{e.shift: 0.0}, {e.shift: 10.0}]
+        models = dict(e.fitMultiple(_df(10), maps))
+        assert models[1].mean == models[0].mean + 10.0
+
+
+class MAE(Evaluator):
+    """Mean |m - x| — lower is better."""
+
+    def evaluate(self, dataset):
+        tab = dataset.collect()
+        return float(np.abs(tab.column("m").to_numpy()
+                            - tab.column("x").to_numpy()).mean())
+
+    def isLargerBetter(self):
+        return False
+
+
+class TestCrossValidator:
+    def test_cv_selects_best_shift(self):
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        grid = [{e.shift: 0.0}, {e.shift: 100.0}]
+        cv = CrossValidator(estimator=e, estimatorParamMaps=grid,
+                            evaluator=MAE(), numFolds=3)
+        cvm = cv.fit(_df(30))
+        assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
+        assert isinstance(cvm.bestModel, MeanModel)
+        # best model trained with shift=0
+        assert abs(cvm.bestModel.mean - np.arange(30).mean()) < 1e-9
