@@ -20,6 +20,17 @@ import pyarrow as pa
 Row = dict  # a collected row is a plain dict, keyed by column name
 
 
+def column_index(batch: pa.RecordBatch, name: str) -> int:
+    """Resolve a column name to its index, raising KeyError for unknown
+    names (pyarrow's get_field_index returns -1, which would silently
+    negative-index the last column)."""
+    idx = batch.schema.get_field_index(name)
+    if idx < 0:
+        raise KeyError(
+            f"column {name!r} not in batch ({batch.schema.names})")
+    return idx
+
+
 @dataclasses.dataclass(frozen=True)
 class Stage:
     """One plan step: RecordBatch → RecordBatch."""
@@ -228,6 +239,9 @@ class DataFrame:
         from sparkdl_tpu.data.tensors import arrow_to_tensor
         table = self.collect()
         idx = table.schema.get_field_index(col)
+        if idx < 0:
+            raise KeyError(
+                f"column {col!r} not in frame ({table.schema.names})")
         return arrow_to_tensor(table.column(idx), table.schema.field(idx))
 
     def __repr__(self) -> str:

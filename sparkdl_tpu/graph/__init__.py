@@ -1,0 +1,25 @@
+"""Graph toolkit (reference L4: ``python/sparkdl/graph/``).
+
+The reference's unit of deployable compute was a frozen TF GraphDef
+(``GraphFunction``) built inside an ``IsolatedSession`` and broadcast to
+executors. Here the unit is a :class:`ModelFunction`: a pure jittable
+function + params pytree + named IO signature, serializable to StableHLO
+via ``jax.export`` — the north-star's "serializes StableHLO instead of TF
+GraphDefs". Composition replaces graph surgery; XLA fusion replaces
+manual graph stitching.
+"""
+
+from sparkdl_tpu.graph.function import ModelFunction  # noqa: F401
+from sparkdl_tpu.graph.ingest import ModelIngest, TFInputGraph  # noqa: F401
+from sparkdl_tpu.graph.pieces import (  # noqa: F401
+    buildFlattener,
+    buildSpImageConverter,
+)
+
+__all__ = [
+    "ModelFunction",
+    "ModelIngest",
+    "TFInputGraph",
+    "buildSpImageConverter",
+    "buildFlattener",
+]

@@ -1,0 +1,265 @@
+"""ModelFunction: the deployable unit of compute.
+
+TPU-native re-design of the reference's
+``python/sparkdl/graph/builder.py::GraphFunction`` (frozen GraphDef +
+input/output names) and ``IsolatedSession`` (hermetic graph build +
+``asGraphFunction`` freeze). A ModelFunction is:
+
+* ``apply_fn(params, inputs: dict[str, Array]) -> dict[str, Array]`` — a
+  pure function; ``params`` is a pytree (the reference froze variables
+  into graph constants; here they stay an explicit pytree, and "freezing"
+  is ``export()`` which bakes them into serialized StableHLO).
+* named input/output signatures (per-row shapes, batch dim implicit) —
+  the counterpart of the reference's tensor-name mappings.
+* ``fromList`` composition replacing GraphFunction.fromList's GraphDef
+  import/re-export surgery: plain function composition, fused by XLA
+  into one program at jit time.
+
+No session isolation is needed: JAX is functional, so the reference's
+``IsolatedSession``/``KSessionWrap`` global-state hygiene (builder.py,
+keras_utils.py) has no analogue — that entire failure class is gone.
+
+A ModelFunction may instead wrap an opaque **host** callable (backend
+"host") for ingested TF-era graphs that execute via the TF CPU runtime —
+the same place the reference executed them (executor CPUs via JNI
+libtensorflow); see ``graph/ingest.py`` for the boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# name -> (per-row shape tuple, dtype)
+Signature = Dict[str, Tuple[Tuple[int, ...], Any]]
+
+
+def _as_dict(x, names: Sequence[str]) -> Dict[str, Any]:
+    if isinstance(x, dict):
+        return x
+    if len(names) != 1:
+        raise ValueError(
+            f"got a single array for multi-input function {list(names)}")
+    return {names[0]: x}
+
+
+class ModelFunction:
+    """A named-IO pure function + params, composable and exportable."""
+
+    def __init__(self,
+                 apply_fn: Callable[[Any, Dict[str, jax.Array]],
+                                    Dict[str, jax.Array]],
+                 params: Any = None,
+                 input_signature: Optional[Signature] = None,
+                 output_names: Optional[Sequence[str]] = None,
+                 backend: str = "jax",
+                 name: str = "model_fn"):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.input_signature: Signature = dict(input_signature or {})
+        self._output_names = list(output_names) if output_names else None
+        self.backend = backend
+        self.name = name
+        self._jit_cache: Dict[Any, Callable] = {}
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def fromSingle(fn: Callable, params: Any = None,
+                   input_shape: Tuple[int, ...] = (),
+                   input_dtype=jnp.float32,
+                   input_name: str = "input",
+                   output_name: str = "output",
+                   name: str = "model_fn") -> "ModelFunction":
+        """Wrap a single-tensor function ``fn(params, x) -> y`` (or
+        ``fn(x) -> y`` when params is None)."""
+
+        def apply_fn(params_, inputs):
+            x = inputs[input_name]
+            y = fn(params_, x) if params_ is not None else fn(x)
+            if isinstance(y, dict):
+                return y
+            return {output_name: y}
+
+        return ModelFunction(
+            apply_fn, params,
+            input_signature={input_name: (tuple(input_shape), input_dtype)},
+            output_names=[output_name], name=name)
+
+    @staticmethod
+    def fromList(functions: Sequence["ModelFunction"],
+                 name: str = "composed") -> "ModelFunction":
+        """Chain single-output→single-input functions into one
+        (reference ``GraphFunction.fromList``). The composite is one
+        jittable function; XLA fuses the stages."""
+        functions = list(functions)
+        if not functions:
+            raise ValueError("fromList needs at least one function")
+        for f in functions:
+            if f.backend != "jax":
+                raise ValueError(
+                    f"fromList requires jax-backend functions, got "
+                    f"'{f.backend}' for {f.name}")
+        head = functions[0]
+
+        def apply_fn(params_list, inputs):
+            cur = inputs
+            out: Dict[str, jax.Array] = {}
+            for i, f in enumerate(functions):
+                out = f.apply_fn(params_list[i], cur)
+                if i + 1 < len(functions):
+                    out_names = list(out)
+                    if len(out_names) != 1:
+                        raise ValueError(
+                            f"stage {f.name} has {len(out_names)} outputs; "
+                            "fromList chains single-output stages")
+                    nxt_in = functions[i + 1].input_names
+                    if len(nxt_in) != 1:
+                        raise ValueError(
+                            f"stage {functions[i+1].name} has "
+                            f"{len(nxt_in)} inputs; fromList chains "
+                            "single-input stages")
+                    cur = {nxt_in[0]: out[out_names[0]]}
+            return out
+
+        return ModelFunction(
+            apply_fn,
+            params=[f.params for f in functions],
+            input_signature=dict(head.input_signature),
+            output_names=functions[-1]._output_names,
+            name=name)
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def input_names(self) -> List[str]:
+        return list(self.input_signature)
+
+    @property
+    def output_names(self) -> List[str]:
+        if self._output_names is None:
+            self._output_names = list(self.output_signature())
+        return list(self._output_names)
+
+    def output_signature(self, batch_size: int = 1) -> Signature:
+        """Infer named output shapes via ``jax.eval_shape`` (per-row
+        shapes, batch stripped)."""
+        if self.backend != "jax":
+            raise ValueError("output_signature requires a jax backend")
+        inputs = {
+            n: jax.ShapeDtypeStruct((batch_size,) + tuple(shape), dtype)
+            for n, (shape, dtype) in self.input_signature.items()
+        }
+        out = jax.eval_shape(self.apply_fn, self.params, inputs)
+        return {n: (tuple(s.shape[1:]), s.dtype) for n, s in out.items()}
+
+    def rename_io(self, input_map: Optional[Dict[str, str]] = None,
+                  output_map: Optional[Dict[str, str]] = None
+                  ) -> "ModelFunction":
+        """New ModelFunction with renamed inputs/outputs (the counterpart
+        of the reference's signature-name↔tensor-name translation,
+        ``graph/input.py::translateInputMapping``)."""
+        input_map = input_map or {}
+        output_map = output_map or {}
+        inv_in = {new: old for old, new in input_map.items()}
+        base = self
+
+        def apply_fn(params_, inputs):
+            renamed = {inv_in.get(n, n): v for n, v in inputs.items()}
+            out = base.apply_fn(params_, renamed)
+            return {output_map.get(n, n): v for n, v in out.items()}
+
+        sig = {input_map.get(n, n): v
+               for n, v in self.input_signature.items()}
+        out_names = ([output_map.get(n, n) for n in self._output_names]
+                     if self._output_names else None)
+        return ModelFunction(apply_fn, self.params, sig, out_names,
+                             backend=self.backend,
+                             name=f"{self.name}.renamed")
+
+    # -- execution ----------------------------------------------------------
+
+    def jitted(self, donate_inputs: bool = False) -> Callable:
+        """Jit-compiled ``(params, inputs) -> outputs`` (cached)."""
+        if self.backend != "jax":
+            raise ValueError(f"cannot jit backend '{self.backend}'")
+        key = ("jit", donate_inputs)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                self.apply_fn,
+                donate_argnums=(1,) if donate_inputs else ())
+        return self._jit_cache[key]
+
+    def __call__(self, inputs, params: Any = "__own__"):
+        p = self.params if params == "__own__" else params
+        if self.backend == "host":
+            d = _as_dict(inputs, self.input_names)
+            return self.apply_fn(p, {k: np.asarray(v) for k, v in d.items()})
+        single = not isinstance(inputs, dict)
+        d = _as_dict(inputs, self.input_names)
+        d = {k: jnp.asarray(v) for k, v in d.items()}
+        out = self.jitted()(p, d)
+        if single and len(out) == 1:
+            return next(iter(out.values()))
+        return out
+
+    # -- serialization (the "freeze" step) ----------------------------------
+
+    def export(self, batch_size: Optional[int] = None) -> bytes:
+        """Serialize to StableHLO bytes with params baked in — the
+        TPU-era analogue of ``strip_and_freeze_until`` + GraphDef
+        serialization (reference ``graph/utils.py``). ``batch_size=None``
+        exports a symbolic batch dimension."""
+        if self.backend != "jax":
+            raise ValueError(f"cannot export backend '{self.backend}'")
+        from jax import export as jax_export
+
+        params = self.params
+        base = self.apply_fn
+
+        def frozen(inputs):
+            return base(params, inputs)
+
+        if batch_size is None:
+            (bdim,) = jax_export.symbolic_shape("batch")
+            mk = lambda shape: (bdim,) + tuple(shape)  # noqa: E731
+        else:
+            mk = lambda shape: (batch_size,) + tuple(shape)  # noqa: E731
+        args = {
+            n: jax.ShapeDtypeStruct(mk(shape), dtype)
+            for n, (shape, dtype) in self.input_signature.items()
+        }
+        exported = jax_export.export(jax.jit(frozen))(args)
+        return exported.serialize()
+
+    @staticmethod
+    def deserialize(blob: bytes, name: str = "stablehlo") -> "ModelFunction":
+        """Load serialized StableHLO back into a callable ModelFunction.
+        The result is jittable and composable (it re-traces through the
+        exported computation)."""
+        from jax import export as jax_export
+        exported = jax_export.deserialize(blob)
+        in_tree = exported.in_tree
+        # input signature from the exported avals: one dict arg
+        avals = exported.in_avals
+        flat_names = jax.tree.unflatten(in_tree, list(range(len(avals))))
+        # flat_names is ((dict_arg,), {}) structure mirror with leaf indices
+        (dict_arg,), _ = flat_names
+        sig = {}
+        for key, idx in dict_arg.items():
+            aval = avals[idx]
+            sig[key] = (tuple(int(d) for d in aval.shape[1:]), aval.dtype)
+
+        def apply_fn(params_, inputs):
+            return exported.call(inputs)
+
+        return ModelFunction(apply_fn, None, sig, None, name=name)
+
+    def __repr__(self) -> str:
+        outs = self._output_names or "?"
+        return (f"ModelFunction({self.name}, backend={self.backend}, "
+                f"inputs={self.input_names}, outputs={outs})")

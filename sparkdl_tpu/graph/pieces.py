@@ -1,0 +1,59 @@
+"""Prebuilt converter pieces gluing the image schema to model tensors.
+
+Re-design of the reference's ``python/sparkdl/graph/pieces.py``
+(``buildSpImageConverter``: struct fields → decode_raw → reshape → cast;
+``buildFlattener``: reshape(x, [-1])). Here the host runner already
+assembles image structs into contiguous uint8 NHWC batches (see
+``runtime/runner.py``), so the converter's device-side job is the cast /
+scale / channel-reorder — deliberately done ON DEVICE so the host ships
+uint8 (4× less host→device bandwidth, see BASELINE.md) and XLA fuses the
+cast into the model's first conv.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.graph.function import ModelFunction
+
+
+def buildSpImageConverter(height: int, width: int, nChannels: int = 3,
+                          channel_order: str = "RGB",
+                          scale: float = 1.0,
+                          offset: float = 0.0) -> ModelFunction:
+    """uint8 [N,H,W,C] image batch → float32 ``x*scale + offset`` with
+    optional BGR reorder (the reference supported OpenCV-style BGR
+    structs; our structs are RGB so BGR is the conversion case)."""
+    if channel_order not in ("RGB", "BGR"):
+        raise ValueError(f"channel_order must be RGB or BGR, "
+                         f"got {channel_order!r}")
+
+    def convert(x):
+        x = x.astype(jnp.float32)
+        if channel_order == "BGR":
+            x = x[..., ::-1]
+        if scale != 1.0:
+            x = x * scale
+        if offset != 0.0:
+            x = x + offset
+        return x
+
+    return ModelFunction.fromSingle(
+        convert, None,
+        input_shape=(height, width, nChannels), input_dtype=jnp.uint8,
+        input_name="image", output_name="converted",
+        name="spImageConverter")
+
+
+def buildFlattener(input_shape: Tuple[int, ...] = (),
+                   input_name: str = "input") -> ModelFunction:
+    """[N, ...] → float32 [N, prod(...)] (reference ``buildFlattener``)."""
+
+    def flatten(x):
+        return x.reshape(x.shape[0], -1).astype(jnp.float32)
+
+    return ModelFunction.fromSingle(
+        flatten, None, input_shape=tuple(input_shape),
+        input_name=input_name, output_name="flattened", name="flattener")

@@ -1,0 +1,17 @@
+"""Flax model implementations for the named-model zoo.
+
+The reference shipped no model code — it pulled frozen Keras-Applications
+graphs (``transformers/keras_applications.py``, Scala ``Models.scala`` +
+``ModelFetcher``). A TPU-native framework needs the architectures as
+jittable functions, so they are implemented here in Flax (NHWC, bf16
+compute / f32 params by default — MXU-friendly).
+"""
+
+from sparkdl_tpu.models.inception import InceptionV3  # noqa: F401
+from sparkdl_tpu.models.resnet import ResNet50  # noqa: F401
+from sparkdl_tpu.models.vgg import VGG16, VGG19  # noqa: F401
+from sparkdl_tpu.models.xception import Xception  # noqa: F401
+from sparkdl_tpu.models.testnet import TestNet  # noqa: F401
+
+__all__ = ["InceptionV3", "ResNet50", "VGG16", "VGG19", "Xception",
+           "TestNet"]

@@ -1,0 +1,93 @@
+"""Hash-verified parameter fetcher + local cache.
+
+Mechanism parity with the reference's Scala ``ModelFetcher``
+(``ModelFetcher.getFromWeb(url, fileName, hash)``): pretrained weights
+are fetched once into a local cache and content-hash-verified on every
+load. Weights are stored as flax msgpack bytes. In a zero-egress
+environment ``getFromWeb`` fails with a clear message; ``put``/``get``
+against the cache (and ``file://`` URLs) still work, and the zoo falls
+back to deterministic seeded initialization so every pipeline mechanism
+remains exercisable without ImageNet weights (SURVEY §7 hard-parts note).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Optional
+
+from flax import serialization
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "sparkdl_tpu", "models")
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class ModelFetcher:
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or os.environ.get(
+            "SPARKDL_TPU_MODEL_CACHE", DEFAULT_CACHE_DIR)
+
+    def _path(self, fileName: str) -> str:
+        return os.path.join(self.cache_dir, fileName)
+
+    def has(self, fileName: str) -> bool:
+        return os.path.exists(self._path(fileName))
+
+    def put(self, fileName: str, params: Any) -> str:
+        """Serialize a params pytree into the cache; returns its sha256."""
+        blob = serialization.to_bytes(params)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        with open(self._path(fileName), "wb") as f:
+            f.write(blob)
+        digest = _sha256(blob)
+        with open(self._path(fileName) + ".sha256", "w") as f:
+            f.write(digest)
+        return digest
+
+    def get(self, fileName: str, template: Any,
+            expected_sha256: Optional[str] = None) -> Any:
+        """Load cached params into the structure of ``template``,
+        verifying content hash (stored sidecar, or explicit)."""
+        path = self._path(fileName)
+        with open(path, "rb") as f:
+            blob = f.read()
+        digest = _sha256(blob)
+        check = expected_sha256
+        sidecar = path + ".sha256"
+        if check is None and os.path.exists(sidecar):
+            with open(sidecar) as f:
+                check = f.read().strip()
+        if check is not None and digest != check:
+            raise IOError(
+                f"hash mismatch for {fileName}: got {digest[:12]}…, "
+                f"expected {check[:12]}… — delete the cache entry and "
+                "re-fetch")
+        return serialization.from_bytes(template, blob)
+
+    def getFromWeb(self, url: str, fileName: str,
+                   expected_sha256: str, template: Any) -> Any:
+        """Fetch weights from a URL into the cache (reference
+        ``ModelFetcher.getFromWeb``), then hash-verify and load.
+        ``file://`` URLs work offline."""
+        if not self.has(fileName):
+            import urllib.request
+            os.makedirs(self.cache_dir, exist_ok=True)
+            try:
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    blob = r.read()
+            except Exception as e:
+                raise IOError(
+                    f"could not fetch {url}: {e}. This environment may "
+                    "have no network egress; pre-seed the cache with "
+                    "ModelFetcher.put() or use a file:// URL.") from e
+            if _sha256(blob) != expected_sha256:
+                raise IOError(f"downloaded {fileName} failed hash check")
+            with open(self._path(fileName), "wb") as f:
+                f.write(blob)
+            with open(self._path(fileName) + ".sha256", "w") as f:
+                f.write(expected_sha256)
+        return self.get(fileName, template, expected_sha256)

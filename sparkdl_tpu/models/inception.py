@@ -1,0 +1,177 @@
+"""InceptionV3 in Flax (NHWC, bf16 compute).
+
+The flagship zoo model — the reference's north-star benchmark runs
+``DeepImageFeaturizer(modelName="InceptionV3")`` (reference
+``transformers/keras_applications.py`` InceptionV3 entry; Scala
+``Models.scala``). Architecture follows the canonical InceptionV3
+(Szegedy et al. 2015), matching Keras Applications' layer plan: stem →
+3×block-A (35×35) → reduction-A → 4×block-B (17×17) → reduction-B →
+2×block-C (8×8) → global average pool (2048-d featurize point) → logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import (
+    ConvBN,
+    avg_pool,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class InceptionBlockA(nn.Module):
+    """35×35 mixed block: 1x1 / 5x5 / double-3x3 / pool branches."""
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        b1 = ConvBN(64, (1, 1), dtype=d)(x, train)
+
+        b5 = ConvBN(48, (1, 1), dtype=d)(x, train)
+        b5 = ConvBN(64, (5, 5), dtype=d)(b5, train)
+
+        b3 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+
+        bp = avg_pool(x)
+        bp = ConvBN(self.pool_features, (1, 1), dtype=d)(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """35×35 → 17×17 (keras mixed3)."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        b3 = ConvBN(384, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(x, train)
+        bd = ConvBN(64, (1, 1), dtype=d)(x, train)
+        bd = ConvBN(96, (3, 3), dtype=d)(bd, train)
+        bd = ConvBN(96, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(bd, train)
+        bp = max_pool(x)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionBlockB(nn.Module):
+    """17×17 mixed block with factorized 7×7 convs."""
+    c7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d, c7 = self.dtype, self.c7
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, train)
+
+        b7 = ConvBN(c7, (1, 1), dtype=d)(x, train)
+        b7 = ConvBN(c7, (1, 7), dtype=d)(b7, train)
+        b7 = ConvBN(192, (7, 1), dtype=d)(b7, train)
+
+        bd = ConvBN(c7, (1, 1), dtype=d)(x, train)
+        bd = ConvBN(c7, (7, 1), dtype=d)(bd, train)
+        bd = ConvBN(c7, (1, 7), dtype=d)(bd, train)
+        bd = ConvBN(c7, (7, 1), dtype=d)(bd, train)
+        bd = ConvBN(192, (1, 7), dtype=d)(bd, train)
+
+        bp = avg_pool(x)
+        bp = ConvBN(192, (1, 1), dtype=d)(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """17×17 → 8×8 (keras mixed8)."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        b3 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(320, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(b3, train)
+        b7 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b7 = ConvBN(192, (1, 7), dtype=d)(b7, train)
+        b7 = ConvBN(192, (7, 1), dtype=d)(b7, train)
+        b7 = ConvBN(192, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(b7, train)
+        bp = max_pool(x)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionBlockC(nn.Module):
+    """8×8 mixed block with split 1x3/3x1 branches."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        b1 = ConvBN(320, (1, 1), dtype=d)(x, train)
+
+        b3 = ConvBN(384, (1, 1), dtype=d)(x, train)
+        b3a = ConvBN(384, (1, 3), dtype=d)(b3, train)
+        b3b = ConvBN(384, (3, 1), dtype=d)(b3, train)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+
+        bd = ConvBN(448, (1, 1), dtype=d)(x, train)
+        bd = ConvBN(384, (3, 3), dtype=d)(bd, train)
+        bda = ConvBN(384, (1, 3), dtype=d)(bd, train)
+        bdb = ConvBN(384, (3, 1), dtype=d)(bd, train)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+
+        bp = avg_pool(x)
+        bp = ConvBN(192, (1, 1), dtype=d)(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Input: float [N,299,299,3] preprocessed to [-1,1].
+
+    ``features()`` (2048-d global-pool vector) is the featurize layer the
+    reference's DeepImageFeaturizer exposed; ``__call__`` adds logits.
+    """
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        # stem
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID",
+                   dtype=d)(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(64, (3, 3), dtype=d)(x, train)
+        x = max_pool(x)
+        x = ConvBN(80, (1, 1), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(192, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = max_pool(x)
+        # 35x35
+        x = InceptionBlockA(32, dtype=d)(x, train)
+        x = InceptionBlockA(64, dtype=d)(x, train)
+        x = InceptionBlockA(64, dtype=d)(x, train)
+        x = ReductionA(dtype=d)(x, train)
+        # 17x17
+        x = InceptionBlockB(128, dtype=d)(x, train)
+        x = InceptionBlockB(160, dtype=d)(x, train)
+        x = InceptionBlockB(160, dtype=d)(x, train)
+        x = InceptionBlockB(192, dtype=d)(x, train)
+        x = ReductionB(dtype=d)(x, train)
+        # 8x8
+        x = InceptionBlockC(dtype=d)(x, train)
+        x = InceptionBlockC(dtype=d)(x, train)
+        feats = global_avg_pool(x).astype(jnp.float32)
+        if features_only:
+            return feats
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          param_dtype=jnp.float32)(feats)
+        return logits

@@ -1,0 +1,82 @@
+"""Shared conv building blocks (NHWC, MXU-friendly dtypes).
+
+All zoo models compute in a configurable ``dtype`` (default bfloat16 —
+the MXU's native input precision) with float32 params and float32
+BatchNorm statistics; XLA fuses BN+ReLU into the convs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class ConvBN(nn.Module):
+    """Conv (no bias) + BatchNorm + optional ReLU — the ``conv2d_bn``
+    unit every zoo CNN is built from."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    relu: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32,
+                         param_dtype=jnp.float32)(x)
+        x = x.astype(self.dtype)
+        if self.relu:
+            x = nn.relu(x)
+        return x
+
+
+class SeparableConvBN(nn.Module):
+    """Depthwise + pointwise conv, BN after the pointwise (Xception's
+    separable_conv unit)."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    relu: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_feat = x.shape[-1]
+        x = nn.Conv(in_feat, self.kernel, strides=self.strides,
+                    padding="SAME", feature_group_count=in_feat,
+                    use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32,
+                         param_dtype=jnp.float32)(x)
+        x = x.astype(self.dtype)
+        if self.relu:
+            x = nn.relu(x)
+        return x
+
+
+def max_pool(x, window=(3, 3), strides=(2, 2), padding="VALID"):
+    return nn.max_pool(x, window_shape=window, strides=strides,
+                       padding=padding)
+
+
+def avg_pool(x, window=(3, 3), strides=(1, 1), padding="SAME"):
+    return nn.avg_pool(x, window_shape=window, strides=strides,
+                       padding=padding)
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))

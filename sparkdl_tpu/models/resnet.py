@@ -1,0 +1,64 @@
+"""ResNet50 in Flax (NHWC, bf16 compute).
+
+Zoo entry (reference ``keras_applications.py`` ResNet50, 224×224,
+caffe-style preprocessing). Standard ResNet-v1 bottleneck plan
+[3, 4, 6, 3]; ``features_only`` returns the 2048-d global-pool vector
+(the reference's featurize layer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import ConvBN, global_avg_pool, max_pool
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    project: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        shortcut = x
+        if self.project:
+            shortcut = ConvBN(self.filters * 4, (1, 1),
+                              strides=self.strides, relu=False,
+                              dtype=d)(x, train)
+        y = ConvBN(self.filters, (1, 1), strides=self.strides,
+                   dtype=d)(x, train)
+        y = ConvBN(self.filters, (3, 3), dtype=d)(y, train)
+        y = ConvBN(self.filters * 4, (1, 1), relu=False, dtype=d)(y, train)
+        return nn.relu(y + shortcut)
+
+
+class ResNet50(nn.Module):
+    """Input: float [N,224,224,3], caffe-preprocessed (BGR,
+    mean-subtracted) per the reference's ResNet50 entry."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        x = ConvBN(64, (7, 7), strides=(2, 2),
+                   padding=[(3, 3), (3, 3)], dtype=d)(x, train)
+        x = max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, (blocks, filters) in enumerate(
+                zip([3, 4, 6, 3], [64, 128, 256, 512])):
+            for b in range(blocks):
+                strides = (2, 2) if (b == 0 and i > 0) else (1, 1)
+                x = Bottleneck(filters, strides=strides, project=(b == 0),
+                               dtype=d)(x, train)
+        feats = global_avg_pool(x).astype(jnp.float32)
+        if features_only:
+            return feats
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(feats)

@@ -1,0 +1,34 @@
+"""TestNet: the tiny committed test model.
+
+The reference committed a tiny frozen graph resource so its Scala suite
+could exercise the full featurizer path in seconds without downloading
+weights (``Models.scala::TestNet``, SURVEY §4.5). Same trick here: a
+3-layer CNN, deterministic params from a fixed seed, 16-d features.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import ConvBN, global_avg_pool, max_pool
+
+
+class TestNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        x = ConvBN(8, (3, 3), strides=(2, 2), dtype=d)(x, train)
+        x = max_pool(x, (2, 2), (2, 2), padding="SAME")
+        x = ConvBN(16, (3, 3), dtype=d)(x, train)
+        feats = global_avg_pool(x).astype(jnp.float32)
+        if features_only:
+            return feats
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(feats)

@@ -1,0 +1,49 @@
+"""VGG16 / VGG19 in Flax (NHWC, bf16 compute).
+
+Zoo entries (reference ``keras_applications.py`` VGG16/VGG19, 224×224,
+caffe preprocessing). The reference featurized at the penultimate fully-
+connected layer (fc2, 4096-d) — ``features_only`` matches that.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import max_pool
+
+
+class _VGG(nn.Module):
+    blocks: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        filters = [64, 128, 256, 512, 512]
+        for n_convs, f in zip(self.blocks, filters):
+            for _ in range(n_convs):
+                x = nn.Conv(f, (3, 3), padding="SAME", dtype=d,
+                            param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+            x = max_pool(x, (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(4096, dtype=d, param_dtype=jnp.float32)(x))
+        x = nn.relu(nn.Dense(4096, dtype=d, param_dtype=jnp.float32)(x))
+        feats = x.astype(jnp.float32)   # fc2 — reference featurize layer
+        if features_only:
+            return feats
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(feats)
+
+
+class VGG16(_VGG):
+    blocks: Sequence[int] = (2, 2, 3, 3, 3)
+
+
+class VGG19(_VGG):
+    blocks: Sequence[int] = (2, 2, 4, 4, 4)

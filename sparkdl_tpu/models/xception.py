@@ -1,0 +1,91 @@
+"""Xception in Flax (NHWC, bf16 compute).
+
+Zoo entry (reference ``keras_applications.py`` Xception, 299×299,
+inception-style preprocessing). Entry flow → 8× middle-flow blocks →
+exit flow; ``features_only`` = 2048-d global pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import (
+    ConvBN,
+    SeparableConvBN,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class _EntryBlock(nn.Module):
+    features: int
+    first_relu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        shortcut = ConvBN(self.features, (1, 1), strides=(2, 2),
+                          relu=False, dtype=d)(x, train)
+        y = x
+        if self.first_relu:
+            y = nn.relu(y)
+        y = SeparableConvBN(self.features, relu=False, dtype=d)(y, train)
+        y = nn.relu(y)
+        y = SeparableConvBN(self.features, relu=False, dtype=d)(y, train)
+        y = max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        return y + shortcut
+
+
+class _MiddleBlock(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        y = x
+        for _ in range(3):
+            y = nn.relu(y)
+            y = SeparableConvBN(728, relu=False, dtype=d)(y, train)
+        return y + x
+
+
+class Xception(nn.Module):
+    """Input: float [N,299,299,3] preprocessed to [-1,1]."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        # entry flow
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID",
+                   dtype=d)(x, train)
+        x = ConvBN(64, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = _EntryBlock(128, first_relu=False, dtype=d)(x, train)
+        x = _EntryBlock(256, dtype=d)(x, train)
+        x = _EntryBlock(728, dtype=d)(x, train)
+        # middle flow
+        for _ in range(8):
+            x = _MiddleBlock(dtype=d)(x, train)
+        # exit flow
+        shortcut = ConvBN(1024, (1, 1), strides=(2, 2), relu=False,
+                          dtype=d)(x, train)
+        y = nn.relu(x)
+        y = SeparableConvBN(728, relu=False, dtype=d)(y, train)
+        y = nn.relu(y)
+        y = SeparableConvBN(1024, relu=False, dtype=d)(y, train)
+        y = max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        x = y + shortcut
+        x = SeparableConvBN(1536, dtype=d)(x, train)
+        x = SeparableConvBN(2048, dtype=d)(x, train)
+        feats = global_avg_pool(x).astype(jnp.float32)
+        if features_only:
+            return feats
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(feats)

@@ -1,0 +1,200 @@
+"""Named-model zoo registry.
+
+Re-design of the reference's ``transformers/keras_applications.py``
+(``KERAS_APPLICATION_MODELS``, ``getKerasApplicationModel``; Scala twin
+``Models.scala``): per-model input size, device-side preprocessing, the
+featurize layer, and a constructor — here a Flax module + params instead
+of a frozen Keras graph.
+
+Preprocessing is part of the model's device program (uint8 in → XLA
+fuses scale/mean-subtract into the first conv), so the host ships uint8
+NHWC only — the reference instead ran per-model preprocess ops inside
+its stitched TF graph (same idea, TF-era mechanics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.models import (
+    InceptionV3,
+    ResNet50,
+    TestNet,
+    VGG16,
+    VGG19,
+    Xception,
+)
+from sparkdl_tpu.models.fetcher import ModelFetcher
+
+
+def _inception_preprocess(x):
+    """uint8 → [-1, 1] float (reference: x/127.5 - 1 for
+    InceptionV3/Xception)."""
+    return x.astype(jnp.float32) * (1.0 / 127.5) - 1.0
+
+
+_CAFFE_MEAN = (103.939, 116.779, 123.68)  # BGR means
+
+
+def _caffe_preprocess(x):
+    """uint8 RGB → BGR float, ImageNet-mean-subtracted (reference:
+    VGG/ResNet caffe-style)."""
+    x = x.astype(jnp.float32)[..., ::-1]
+    return x - jnp.asarray(_CAFFE_MEAN, dtype=jnp.float32)
+
+
+def _testnet_preprocess(x):
+    return x.astype(jnp.float32) * (1.0 / 255.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NamedImageModel:
+    """Zoo entry (reference ``NamedImageModel`` trait, Models.scala)."""
+
+    name: str
+    module_fn: Callable[[], Any]          # () -> flax nn.Module
+    input_size: Tuple[int, int]           # (height, width)
+    preprocess: Callable                  # uint8 NHWC -> float NHWC
+    feature_dim: int
+    num_classes: int = 1000
+
+    @property
+    def height(self) -> int:
+        return self.input_size[0]
+
+    @property
+    def width(self) -> int:
+        return self.input_size[1]
+
+
+KERAS_APPLICATION_MODELS: Dict[str, NamedImageModel] = {
+    m.name: m for m in [
+        NamedImageModel("InceptionV3", InceptionV3, (299, 299),
+                        _inception_preprocess, 2048),
+        NamedImageModel("Xception", Xception, (299, 299),
+                        _inception_preprocess, 2048),
+        NamedImageModel("ResNet50", ResNet50, (224, 224),
+                        _caffe_preprocess, 2048),
+        NamedImageModel("VGG16", VGG16, (224, 224),
+                        _caffe_preprocess, 4096),
+        NamedImageModel("VGG19", VGG19, (224, 224),
+                        _caffe_preprocess, 4096),
+        NamedImageModel("TestNet", TestNet, (32, 32),
+                        _testnet_preprocess, 16, num_classes=10),
+    ]
+}
+
+SUPPORTED_MODELS = tuple(KERAS_APPLICATION_MODELS)
+
+
+def getKerasApplicationModel(name: str) -> NamedImageModel:
+    """Reference ``getKerasApplicationModel`` — case-sensitive lookup
+    with a helpful error."""
+    if name not in KERAS_APPLICATION_MODELS:
+        raise ValueError(
+            f"unsupported model {name!r}; supported: "
+            f"{sorted(KERAS_APPLICATION_MODELS)}")
+    return KERAS_APPLICATION_MODELS[name]
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=2)  # bounded: full param pytrees are large
+def _init_variables(name: str, seed: int = 0):
+    """Deterministic seeded init. Real pretrained weights load through
+    the hash-verified fetcher cache when present (weights cannot be
+    downloaded in a zero-egress build env — mechanism over artifacts,
+    like the reference's committed TestNet)."""
+    spec = getKerasApplicationModel(name)
+    module = spec.module_fn()
+    x = jnp.zeros((1, spec.height, spec.width, 3), jnp.uint8)
+    return jax.jit(module.init)(jax.random.PRNGKey(seed),
+                                spec.preprocess(x))
+
+
+def load_variables(name: str, fetcher: Optional[ModelFetcher] = None,
+                   seed: int = 0):
+    """Pretrained variables from the fetcher cache if available,
+    otherwise deterministic seeded init."""
+    fetcher = fetcher or ModelFetcher()
+    fileName = f"{name}.msgpack"
+    init = _init_variables(name, seed)
+    if fetcher.has(fileName):
+        return fetcher.get(fileName, init)
+    return init
+
+
+# ---------------------------------------------------------------------------
+# ModelFunction assembly
+# ---------------------------------------------------------------------------
+
+def getModelFunction(name: str, featurize: bool = True,
+                     fetcher: Optional[ModelFetcher] = None
+                     ) -> ModelFunction:
+    """Named model → ModelFunction: uint8 NHWC [N,H,W,3] → features (or
+    logits). Preprocess + model is ONE jittable program."""
+    spec = getKerasApplicationModel(name)
+    module = spec.module_fn()
+    variables = load_variables(name, fetcher)
+
+    def apply_fn(vars_, inputs):
+        x = spec.preprocess(inputs["image"])
+        out = module.apply(vars_, x, train=False,
+                           features_only=featurize)
+        key = "features" if featurize else "logits"
+        return {key: out}
+
+    return ModelFunction(
+        apply_fn, variables,
+        input_signature={"image": ((spec.height, spec.width, 3),
+                                   np.uint8)},
+        output_names=["features" if featurize else "logits"],
+        name=f"{name}:{'featurize' if featurize else 'predict'}")
+
+
+# ---------------------------------------------------------------------------
+# prediction decoding (reference DeepImagePredictor decodePredictions)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _imagenet_class_names() -> Dict[int, Tuple[str, str]]:
+    """ImageNet class index. Uses keras's cached
+    ``imagenet_class_index.json`` when present on disk; otherwise
+    synthetic ``class_i`` names (no network egress here)."""
+    candidates = [
+        os.path.join(os.path.expanduser("~"), ".keras", "models",
+                     "imagenet_class_index.json"),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            return {int(k): tuple(v) for k, v in raw.items()}
+    return {i: (f"n{i:08d}", f"class_{i}") for i in range(1000)}
+
+
+def decode_predictions(logits: np.ndarray, top: int = 5):
+    """logits/probs [N, C] → per-row list of (class_id, class_name,
+    score), best first."""
+    logits = np.asarray(logits)
+    names = _imagenet_class_names()
+    out = []
+    for row in logits:
+        idx = np.argsort(row)[::-1][:top]
+        out.append([
+            (*names.get(int(i), (f"n{int(i):08d}", f"class_{int(i)}")),
+             float(row[i]))
+            for i in idx
+        ])
+    return out

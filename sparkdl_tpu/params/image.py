@@ -38,8 +38,8 @@ class CanLoadImage(Params):
         loader = self.getImageLoader()
 
         def _load(batch):
-            uris = batch.column(batch.schema.get_field_index(uri_col)) \
-                .to_pylist()
+            from sparkdl_tpu.data.frame import column_index
+            uris = batch.column(column_index(batch, uri_col)).to_pylist()
             arrs = [np.asarray(loader(u), dtype=np.float32) for u in uris]
             if not arrs:
                 return np.zeros((0, 1), dtype=np.float32)

@@ -46,18 +46,21 @@ class HasLabelCol(Params):
         return self.getOrDefault("labelCol")
 
 
+def _toOutputMode(value):
+    if value not in ("vector", "image"):
+        raise TypeError(f"outputMode must be 'vector' or 'image', "
+                        f"got {value!r}")
+    return value
+
+
 class HasOutputMode(Params):
     """'vector' → flat float features column; 'image' → image struct column
     (reference ``transformers/tf_image.py`` outputMode)."""
 
     outputMode = Param("HasOutputMode", "outputMode",
-                       "output mode: 'vector' or 'image'",
-                       TypeConverters.toString)
+                       "output mode: 'vector' or 'image'", _toOutputMode)
 
     def setOutputMode(self, value: str):
-        if value not in ("vector", "image"):
-            raise ValueError(f"outputMode must be 'vector' or 'image', "
-                             f"got {value!r}")
         return self._set(outputMode=value)
 
     def getOutputMode(self) -> str:

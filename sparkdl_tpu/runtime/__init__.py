@@ -1,0 +1,7 @@
+"""Execution runtime (reference L1: TensorFrames' per-partition block
+execution, re-designed for TPU: Arrow batch → pinned host buffer →
+device → jit apply → Arrow batch out)."""
+
+from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics  # noqa: F401
+
+__all__ = ["BatchRunner", "RunnerMetrics"]

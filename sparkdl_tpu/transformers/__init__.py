@@ -1,0 +1,22 @@
+"""Pipeline transformer stages (reference L5:
+``python/sparkdl/transformers/``)."""
+
+from sparkdl_tpu.transformers.named_image import (  # noqa: F401
+    DeepImageFeaturizer,
+    DeepImagePredictor,
+)
+from sparkdl_tpu.transformers.image_transform import ImageTransformer  # noqa: F401
+from sparkdl_tpu.transformers.tensor_transform import TensorTransformer  # noqa: F401
+from sparkdl_tpu.transformers.keras_image import (  # noqa: F401
+    KerasImageFileTransformer,
+)
+from sparkdl_tpu.transformers.keras_tensor import KerasTransformer  # noqa: F401
+
+__all__ = [
+    "DeepImageFeaturizer",
+    "DeepImagePredictor",
+    "ImageTransformer",
+    "TensorTransformer",
+    "KerasImageFileTransformer",
+    "KerasTransformer",
+]

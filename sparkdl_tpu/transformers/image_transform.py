@@ -1,0 +1,85 @@
+"""ImageTransformer: apply a compiled model to an image column.
+
+Re-design of the reference's ``transformers/tf_image.py::
+TFImageTransformer`` (params ``graph``/``inputTensor``/``outputTensor``/
+``outputMode``): the TF graph param becomes a :class:`ModelFunction`;
+the reference's driver-side graph stitching ([spImage converter ⊕ user
+graph ⊕ flattener], then freeze + TensorFrames execution) becomes: host
+threads resize/pack uint8 NHWC batches → serialized device stage jit-runs
+the model (cast/preprocess fused by XLA) → vector or image output column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.data.tensors import append_tensor_column
+from sparkdl_tpu.params import (
+    HasBatchSize,
+    HasInputCol,
+    HasModelFunction,
+    HasOutputCol,
+    HasOutputMode,
+    Transformer,
+    keyword_only,
+)
+from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.transformers import utils as tfr_utils
+
+_PACKED_COL = "__sparkdl_tpu_packed__"
+
+
+class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
+                       HasModelFunction, HasOutputMode, HasBatchSize):
+    """Applies a single-input ModelFunction to an image struct column."""
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelFunction=None,
+                 outputMode="vector", batchSize=64):
+        super().__init__()
+        self._setDefault(outputMode="vector", batchSize=64)
+        self._set(inputCol=inputCol, outputCol=outputCol,
+                  modelFunction=modelFunction, outputMode=outputMode,
+                  batchSize=batchSize)
+        self.metrics = RunnerMetrics()
+
+    def _input_hwc(self):
+        mf = self.getModelFunction()
+        in_name, _ = tfr_utils.single_io(mf)
+        shape, dtype = mf.input_signature[in_name]
+        if len(shape) != 3:
+            raise ValueError(
+                f"model input must be HWC, got shape {shape}")
+        return in_name, shape, dtype
+
+    def _transform(self, dataset):
+        mf = self.getModelFunction()
+        in_name, (h, w, c), in_dtype = self._input_hwc()
+        _, out_name = tfr_utils.single_io(mf)
+        in_col = self.getInputCol()
+        out_col = self.getOutputCol()
+        mode = self.getOutputMode()
+        runner = BatchRunner(mf, self.getBatchSize(),
+                             metrics=self.metrics)
+
+        def pack(batch: pa.RecordBatch) -> pa.RecordBatch:
+            from sparkdl_tpu.data.frame import column_index
+            idx = column_index(batch, in_col)
+            arr = tfr_utils.packImageBatch(batch.column(idx), h, w, c)
+            if np.dtype(in_dtype) != np.uint8:
+                arr = arr.astype(in_dtype)
+            return append_tensor_column(batch, _PACKED_COL, arr)
+
+        def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
+            from sparkdl_tpu.data.frame import column_index
+            from sparkdl_tpu.data.tensors import arrow_to_tensor
+            idx = column_index(batch, _PACKED_COL)
+            arr = arrow_to_tensor(batch.column(idx),
+                                  batch.schema.field(idx))
+            out = runner.run({in_name: arr})[out_name]
+            batch = batch.remove_column(idx)
+            return tfr_utils.appendModelOutput(batch, out_col, out, mode)
+
+        return dataset.map_batches(pack, name="packImageBatch") \
+            .map_batches(apply, kind="device", name=f"apply({mf.name})")

@@ -1,0 +1,73 @@
+"""KerasImageFileTransformer: URI column → user Keras model inference.
+
+Re-design of the reference's ``transformers/keras_image.py`` (params
+``modelFile``, ``imageLoader``, ``outputMode``): the user's
+``imageLoader(uri) -> ndarray`` decodes/preprocesses on host engine
+threads (the reference ran it in Spark python workers), and the Keras 3
+model — loaded once with the JAX backend — runs as one jitted device
+program (the reference loaded the .h5 into an isolated TF session and
+delegated to TFImageTransformer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.data.tensors import arrow_to_tensor
+from sparkdl_tpu.params import (
+    CanLoadImage,
+    HasBatchSize,
+    HasInputCol,
+    HasKerasModel,
+    HasOutputCol,
+    HasOutputMode,
+    Transformer,
+    keyword_only,
+)
+from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.transformers import utils as tfr_utils
+
+_LOADED_COL = "__sparkdl_tpu_loaded__"
+
+
+class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
+                                HasKerasModel, HasOutputMode, HasBatchSize,
+                                CanLoadImage):
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
+                 imageLoader=None, outputMode="vector", batchSize=64):
+        super().__init__()
+        self._setDefault(outputMode="vector", batchSize=64)
+        self._set(inputCol=inputCol, outputCol=outputCol,
+                  modelFile=modelFile, imageLoader=imageLoader,
+                  outputMode=outputMode, batchSize=batchSize)
+        self.metrics = RunnerMetrics()
+
+    def _transform(self, dataset):
+        from sparkdl_tpu.graph.ingest import ModelIngest
+        mf = ModelIngest.fromKerasFile(self.getModelFile())
+        in_name, out_name = tfr_utils.single_io(mf)
+        out_col = self.getOutputCol()
+        mode = self.getOutputMode()
+        runner = BatchRunner(mf, self.getBatchSize(), metrics=self.metrics)
+
+        loaded = self.loadImagesInternal(dataset, self.getInputCol(),
+                                         _LOADED_COL)
+
+        def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
+            from sparkdl_tpu.data.frame import column_index
+            idx = column_index(batch, _LOADED_COL)
+            arr = arrow_to_tensor(batch.column(idx),
+                                  batch.schema.field(idx))
+            shape, dtype = mf.input_signature[in_name]
+            arr = np.asarray(arr)
+            if shape and arr.ndim >= 2 and arr.shape[1:] != tuple(shape):
+                arr = arr.reshape((arr.shape[0],) + tuple(shape))
+            out = runner.run({in_name: arr.astype(dtype, copy=False)})
+            out = out[out_name]
+            batch = batch.remove_column(idx)
+            return tfr_utils.appendModelOutput(batch, out_col, out, mode)
+
+        return loaded.map_batches(apply, kind="device",
+                                  name=f"apply({mf.name})")

@@ -1,0 +1,46 @@
+"""KerasTransformer: 1-D tensor column → user Keras model inference.
+
+Re-design of the reference's ``transformers/keras_tensor.py`` (param
+``modelFile``; internally delegated to TFTransformer via TFInputGraph —
+here to :class:`TensorTransformer` via ``ModelIngest.fromKerasFile``).
+"""
+
+from __future__ import annotations
+
+from sparkdl_tpu.params import (
+    HasBatchSize,
+    HasInputCol,
+    HasKerasModel,
+    HasOutputCol,
+    Transformer,
+    keyword_only,
+)
+
+
+class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
+                       HasKerasModel, HasBatchSize):
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
+                 batchSize=64):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(inputCol=inputCol, outputCol=outputCol,
+                  modelFile=modelFile, batchSize=batchSize)
+        self.metrics = None
+
+    def _transform(self, dataset):
+        from sparkdl_tpu.graph.ingest import ModelIngest
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        from sparkdl_tpu.transformers.utils import single_io
+
+        mf = ModelIngest.fromKerasFile(self.getModelFile())
+        in_name, out_name = single_io(mf)
+        inner = TensorTransformer(
+            modelFunction=mf,
+            inputMapping={self.getInputCol(): in_name},
+            outputMapping={out_name: self.getOutputCol()},
+            batchSize=self.getBatchSize())
+        self.metrics = inner.metrics
+        return inner.transform(dataset)

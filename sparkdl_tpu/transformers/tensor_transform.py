@@ -1,0 +1,85 @@
+"""TensorTransformer: apply a model to numeric/tensor columns.
+
+Re-design of the reference's ``transformers/tf_tensor.py::TFTransformer``
+(params ``tfInputGraph``/``inputMapping``/``outputMapping``): maps named
+DataFrame columns onto the ModelFunction's named inputs, runs it in
+device batches (or host batches for ingested TF SavedModels), and maps
+named outputs back to columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.data.tensors import append_tensor_column, arrow_to_tensor
+from sparkdl_tpu.params import (
+    HasBatchSize,
+    HasInputMapping,
+    HasModelFunction,
+    HasOutputMapping,
+    Transformer,
+    keyword_only,
+)
+from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+
+
+class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
+                        HasOutputMapping, HasBatchSize):
+    @keyword_only
+    def __init__(self, *, modelFunction=None, inputMapping=None,
+                 outputMapping=None, batchSize=64):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(modelFunction=modelFunction, inputMapping=inputMapping,
+                  outputMapping=outputMapping, batchSize=batchSize)
+        self.metrics = RunnerMetrics()
+
+    def _validate(self):
+        mf = self.getModelFunction()
+        in_map = self.getInputMapping()     # col -> input name
+        out_map = self.getOutputMapping()   # output name -> col
+        missing = set(in_map.values()) - set(mf.input_names)
+        if missing:
+            raise ValueError(
+                f"inputMapping references unknown model inputs {missing}; "
+                f"model has {mf.input_names}")
+        unmapped = set(mf.input_names) - set(in_map.values())
+        if unmapped:
+            raise ValueError(f"model inputs {unmapped} not mapped")
+        unknown_out = set(out_map) - set(mf.output_names)
+        if unknown_out:
+            raise ValueError(
+                f"outputMapping references unknown model outputs "
+                f"{unknown_out}; model has {mf.output_names}")
+        return mf, in_map, out_map
+
+    def _transform(self, dataset):
+        mf, in_map, out_map = self._validate()
+        runner = BatchRunner(mf, self.getBatchSize(), metrics=self.metrics)
+        sig = mf.input_signature
+
+        def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
+            inputs = {}
+            for col, input_name in in_map.items():
+                idx = batch.schema.get_field_index(col)
+                if idx < 0:
+                    raise KeyError(f"column {col!r} not in batch "
+                                   f"({batch.schema.names})")
+                arr = arrow_to_tensor(batch.column(idx),
+                                      batch.schema.field(idx))
+                shape, dtype = sig[input_name]
+                arr = np.asarray(arr)
+                static = shape and all(d is not None for d in shape)
+                if static and arr.shape[1:] != tuple(shape):
+                    arr = arr.reshape((arr.shape[0],) + tuple(shape))
+                inputs[input_name] = arr.astype(dtype, copy=False)
+            outputs = runner.run(inputs)
+            for output_name, col in out_map.items():
+                out = np.asarray(outputs[output_name])
+                batch = append_tensor_column(batch, col, out)
+            return batch
+
+        kind = "device" if mf.backend == "jax" else "host"
+        return dataset.map_batches(apply, kind=kind,
+                                   name=f"apply({mf.name})")

@@ -1,0 +1,82 @@
+"""Shared transformer helpers (reference
+``python/sparkdl/transformers/utils.py`` — its ``imageInputPlaceholder``
+built the uint8 batch placeholder; here the equivalent is packing image
+struct rows into the contiguous uint8 NHWC host buffer the device batch
+expects)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.image import imageIO
+
+IMAGE_INPUT_NAME = "image"
+
+
+def packImageBatch(column, height: int, width: int, nChannels: int = 3,
+                   resize: bool = True) -> np.ndarray:
+    """Image struct column → contiguous [N,H,W,C] uint8, resizing rows on
+    the host as needed (the JVM-side ``ImageUtils.resizeImage`` step of
+    the reference's Scala featurizer, reference call stack §3.2)."""
+    structs = imageIO.batchToStructs(column)
+    out = np.zeros((len(structs), height, width, nChannels), np.uint8)
+    for i, s in enumerate(structs):
+        if s is None:
+            continue
+        arr = imageIO.imageStructToArray(s)
+        if resize and (arr.shape[0] != height or arr.shape[1] != width
+                       or arr.shape[2] != nChannels):
+            arr = imageIO.resizeImageArray(arr, height, width, nChannels)
+        elif arr.shape != (height, width, nChannels):
+            raise ValueError(
+                f"row {i}: image {arr.shape} != {(height, width, nChannels)}")
+        out[i] = arr
+    return out
+
+
+def outputToImageStructs(array: np.ndarray, origins=None) -> pa.Array:
+    """Float/uint8 [N,H,W,C] model output → image struct column
+    (reference ``tf_image.py`` outputMode='image' conversion)."""
+    array = np.asarray(array)
+    if array.ndim != 4:
+        raise ValueError(
+            f"image output mode needs [N,H,W,C] output, got {array.shape}")
+    if array.dtype != np.uint8:
+        array = np.clip(np.round(array), 0, 255).astype(np.uint8)
+    structs = []
+    for i, arr in enumerate(array):
+        origin = origins[i] if origins is not None else ""
+        structs.append(imageIO.imageArrayToStruct(arr, origin=origin))
+    return pa.array(structs, type=imageIO.imageType)
+
+
+def appendModelOutput(batch: pa.RecordBatch, out_col: str,
+                      out: np.ndarray, mode: str,
+                      origins=None) -> pa.RecordBatch:
+    """Append a model's output as either a flat float32 vector column or
+    an image struct column — shared tail of ImageTransformer and
+    KerasImageFileTransformer."""
+    from sparkdl_tpu.data.tensors import append_tensor_column
+    out = np.asarray(out)
+    if mode == "image":
+        return batch.append_column(out_col,
+                                   outputToImageStructs(out, origins))
+    width = int(np.prod(out.shape[1:])) if out.ndim > 1 else 1
+    flat = out.reshape(len(out), width).astype(np.float32, copy=False)
+    return append_tensor_column(batch, out_col, flat)
+
+
+def single_io(model_fn) -> Tuple[str, str]:
+    """Validate single-input/single-output and return (in_name, out_name)."""
+    ins = model_fn.input_names
+    if len(ins) != 1:
+        raise ValueError(
+            f"expected a single-input model, got inputs {ins}")
+    outs = model_fn.output_names
+    if len(outs) != 1:
+        raise ValueError(
+            f"expected a single-output model, got outputs {outs}")
+    return ins[0], outs[0]

@@ -18,6 +18,13 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 
+import jax
+
+# The environment's sitecustomize registers the axon TPU plugin and calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start,
+# overriding JAX_PLATFORMS from the env — force CPU back explicitly.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

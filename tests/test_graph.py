@@ -1,0 +1,256 @@
+"""Graph toolkit tests.
+
+The centerpiece mirrors the reference's strongest L4 suite
+(``python/tests/graph/test_input.py``, SURVEY §4.3): one tiny MLP with
+fixed weights persisted through every ingestion source, all asserted to
+produce identical outputs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.graph import (
+    ModelFunction,
+    ModelIngest,
+    TFInputGraph,
+    buildFlattener,
+    buildSpImageConverter,
+)
+
+IN_DIM, HID, OUT_DIM = 4, 8, 3
+
+
+@pytest.fixture(scope="module")
+def mlp_weights():
+    r = np.random.default_rng(1)
+    return {
+        "W1": r.normal(size=(IN_DIM, HID)).astype(np.float32),
+        "b1": r.normal(size=(HID,)).astype(np.float32),
+        "W2": r.normal(size=(HID, OUT_DIM)).astype(np.float32),
+        "b2": r.normal(size=(OUT_DIM,)).astype(np.float32),
+    }
+
+
+def mlp_apply(params, x):
+    h = jax.nn.relu(x @ params["W1"] + params["b1"])
+    return h @ params["W2"] + params["b2"]
+
+
+@pytest.fixture(scope="module")
+def x_batch():
+    return np.random.default_rng(2).normal(size=(6, IN_DIM)) \
+        .astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def expected(mlp_weights, x_batch):
+    return np.asarray(mlp_apply(
+        {k: jnp.asarray(v) for k, v in mlp_weights.items()},
+        jnp.asarray(x_batch)))
+
+
+def _assert_matches(mf, x_batch, expected, atol=1e-5):
+    out = mf(x_batch)
+    if isinstance(out, dict):
+        (out,) = out.values()
+    np.testing.assert_allclose(np.asarray(out), expected, atol=atol)
+
+
+class TestModelFunction:
+    def test_from_single_call(self, mlp_weights, x_batch, expected):
+        mf = ModelFunction.fromSingle(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        _assert_matches(mf, x_batch, expected)
+
+    def test_output_signature(self, mlp_weights):
+        mf = ModelFunction.fromSingle(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        sig = mf.output_signature()
+        assert sig["output"][0] == (OUT_DIM,)
+
+    def test_from_list_composition(self, mlp_weights, x_batch, expected):
+        """converter ⊕ model ⊕ flattener — the tf_image.py composition."""
+        model = ModelFunction.fromSingle(mlp_apply, mlp_weights,
+                                         input_shape=(IN_DIM,))
+        flat = buildFlattener(input_shape=(OUT_DIM,))
+        composed = ModelFunction.fromList([model, flat])
+        out = composed(x_batch)
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+    def test_from_list_rejects_multi_io_chain(self, mlp_weights):
+        def two_out(params, inputs):
+            x = inputs["input"]
+            return {"a": x, "b": x}
+        multi = ModelFunction(two_out, None,
+                              {"input": ((IN_DIM,), np.float32)})
+        flat = buildFlattener(input_shape=(IN_DIM,))
+        composed = ModelFunction.fromList([multi, flat])
+        with pytest.raises(ValueError):
+            composed(np.zeros((2, IN_DIM), np.float32))
+
+    def test_rename_io(self, mlp_weights, x_batch, expected):
+        mf = ModelFunction.fromSingle(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        mf2 = mf.rename_io({"input": "features"}, {"output": "logits"})
+        assert mf2.input_names == ["features"]
+        out = mf2({"features": x_batch})
+        np.testing.assert_allclose(np.asarray(out["logits"]), expected,
+                                   atol=1e-5)
+
+    def test_image_converter_piece(self):
+        conv = buildSpImageConverter(2, 2, 3, scale=1 / 127.5, offset=-1.0)
+        x = np.full((1, 2, 2, 3), 255, np.uint8)
+        out = np.asarray(conv(x))
+        np.testing.assert_allclose(out, 1.0, atol=1e-6)
+        assert out.dtype == np.float32
+
+    def test_image_converter_bgr(self):
+        conv = buildSpImageConverter(1, 1, 3, channel_order="BGR")
+        x = np.zeros((1, 1, 1, 3), np.uint8)
+        x[..., 0] = 10  # R
+        out = np.asarray(conv(x))
+        assert out[0, 0, 0, 2] == 10  # R moved to last channel
+
+
+class TestIngestionMatrix:
+    """All sources must produce identical outputs (reference
+    test_input.py conformance pattern)."""
+
+    def test_from_function(self, mlp_weights, x_batch, expected):
+        mf = ModelIngest.fromFunction(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        _assert_matches(mf, x_batch, expected)
+
+    def test_from_export_roundtrip(self, mlp_weights, x_batch, expected):
+        mf = ModelIngest.fromFunction(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        blob = mf.export(batch_size=None)  # symbolic batch
+        mf2 = ModelIngest.fromExport(blob)
+        assert mf2.input_signature["input"][0] == (IN_DIM,)
+        _assert_matches(mf2, x_batch, expected)
+
+    def test_from_export_fixed_batch(self, mlp_weights, x_batch, expected):
+        mf = ModelIngest.fromFunction(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        mf2 = ModelIngest.fromExport(mf.export(batch_size=6))
+        _assert_matches(mf2, x_batch, expected)
+
+    def _keras_model(self, mlp_weights):
+        import keras
+        m = keras.Sequential([
+            keras.layers.Input((IN_DIM,)),
+            keras.layers.Dense(HID, activation="relu"),
+            keras.layers.Dense(OUT_DIM),
+        ])
+        m.set_weights([mlp_weights["W1"], mlp_weights["b1"],
+                       mlp_weights["W2"], mlp_weights["b2"]])
+        return m
+
+    def test_from_keras_model(self, mlp_weights, x_batch, expected):
+        mf = ModelIngest.fromKerasModel(self._keras_model(mlp_weights))
+        _assert_matches(mf, x_batch, expected)
+
+    @pytest.mark.parametrize("ext", ["h5", "keras"])
+    def test_from_keras_file(self, mlp_weights, x_batch, expected,
+                             tmp_path, ext):
+        path = str(tmp_path / f"model.{ext}")
+        self._keras_model(mlp_weights).save(path)
+        mf = ModelIngest.fromKerasFile(path)
+        _assert_matches(mf, x_batch, expected)
+
+    def _saved_model(self, mlp_weights, tmp_path):
+        import tensorflow as tf
+        W1, b1 = tf.constant(mlp_weights["W1"]), tf.constant(mlp_weights["b1"])
+        W2, b2 = tf.constant(mlp_weights["W2"]), tf.constant(mlp_weights["b2"])
+
+        @tf.function(input_signature=[
+            tf.TensorSpec([None, IN_DIM], tf.float32, name="x")])
+        def fn(x):
+            h = tf.nn.relu(tf.matmul(x, W1) + b1)
+            return {"y": tf.matmul(h, W2) + b2}
+
+        mod = tf.Module()
+        d = str(tmp_path / "sm")
+        tf.saved_model.save(mod, d, signatures={"serving_default": fn,
+                                                "featurize": fn})
+        return d
+
+    def test_from_saved_model(self, mlp_weights, x_batch, expected,
+                              tmp_path):
+        d = self._saved_model(mlp_weights, tmp_path)
+        mf = ModelIngest.fromSavedModel(d)
+        assert mf.backend == "host"
+        assert mf.input_signature["x"][0] == (IN_DIM,)
+        out = mf({"x": x_batch})
+        np.testing.assert_allclose(out["y"], expected, atol=1e-5)
+
+    def test_from_saved_model_with_signature(self, mlp_weights, x_batch,
+                                             expected, tmp_path):
+        d = self._saved_model(mlp_weights, tmp_path)
+        mf = ModelIngest.fromSavedModelWithSignature(d, "featurize")
+        out = mf({"x": x_batch})
+        np.testing.assert_allclose(out["y"], expected, atol=1e-5)
+
+    def test_from_saved_model_bad_signature(self, mlp_weights, tmp_path):
+        d = self._saved_model(mlp_weights, tmp_path)
+        with pytest.raises(KeyError):
+            ModelIngest.fromSavedModel(d, signatureDefKey="nope")
+
+    def _checkpoint(self, mlp_weights, tmp_path):
+        import tensorflow as tf
+        ckpt = tf.train.Checkpoint(
+            W1=tf.Variable(mlp_weights["W1"]),
+            b1=tf.Variable(mlp_weights["b1"]),
+            W2=tf.Variable(mlp_weights["W2"]),
+            b2=tf.Variable(mlp_weights["b2"]))
+        return ckpt.save(str(tmp_path / "ckpt" / "model"))
+
+    def test_from_checkpoint(self, mlp_weights, x_batch, expected,
+                             tmp_path):
+        prefix = self._checkpoint(mlp_weights, tmp_path)
+
+        def apply_fn(params, inputs):
+            return {"output": mlp_apply(params, inputs["input"])}
+
+        mf = ModelIngest.fromCheckpoint(
+            prefix, apply_fn,
+            input_signature={"input": ((IN_DIM,), np.float32)})
+        out = mf({"input": x_batch})
+        np.testing.assert_allclose(np.asarray(out["output"]), expected,
+                                   atol=1e-5)
+
+    def test_from_checkpoint_dir_latest(self, mlp_weights, x_batch,
+                                        expected, tmp_path):
+        self._checkpoint(mlp_weights, tmp_path)
+
+        def apply_fn(params, inputs):
+            return {"output": mlp_apply(params, inputs["input"])}
+
+        mf = ModelIngest.fromCheckpoint(
+            str(tmp_path / "ckpt"), apply_fn,
+            input_signature={"input": ((IN_DIM,), np.float32)})
+        out = mf({"input": x_batch})
+        np.testing.assert_allclose(np.asarray(out["output"]), expected,
+                                   atol=1e-5)
+
+    def test_from_checkpoint_with_signature(self, mlp_weights, x_batch,
+                                            expected, tmp_path):
+        prefix = self._checkpoint(mlp_weights, tmp_path)
+
+        def apply_fn(params, inputs):
+            return {"output": mlp_apply(params, inputs["input"])}
+
+        mf = ModelIngest.fromCheckpointWithSignature(
+            prefix, apply_fn,
+            input_signature={"input": ((IN_DIM,), np.float32)},
+            input_mapping={"input": "features"},
+            output_mapping={"output": "logits"})
+        out = mf({"features": x_batch})
+        np.testing.assert_allclose(np.asarray(out["logits"]), expected,
+                                   atol=1e-5)
+
+    def test_alias(self):
+        assert TFInputGraph is ModelIngest

@@ -1,0 +1,161 @@
+"""Model zoo tests (reference pattern: tiny committed TestNet exercises
+the full path; heavy models shape-checked — SURVEY §4.2/§4.5; only one
+heavy model runs a real forward, as the reference gated CI to
+InceptionV3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models import zoo
+from sparkdl_tpu.models.fetcher import ModelFetcher
+
+
+class TestRegistry:
+    def test_supported_models(self):
+        assert set(zoo.SUPPORTED_MODELS) >= {
+            "InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19",
+            "TestNet"}
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError, match="unsupported model"):
+            zoo.getKerasApplicationModel("NopeNet")
+
+    def test_specs(self):
+        inc = zoo.getKerasApplicationModel("InceptionV3")
+        assert inc.input_size == (299, 299) and inc.feature_dim == 2048
+        r50 = zoo.getKerasApplicationModel("ResNet50")
+        assert r50.input_size == (224, 224) and r50.feature_dim == 2048
+        vgg = zoo.getKerasApplicationModel("VGG16")
+        assert vgg.feature_dim == 4096
+
+
+class TestPreprocess:
+    def test_inception_range(self):
+        x = jnp.asarray(np.array([[[[0, 127, 255]]]], np.uint8))
+        out = np.asarray(zoo._inception_preprocess(x))
+        np.testing.assert_allclose(out.ravel(),
+                                   [-1.0, -0.0039216, 1.0], atol=1e-4)
+
+    def test_caffe_bgr_mean(self):
+        x = np.zeros((1, 1, 1, 3), np.uint8)
+        x[..., 0] = 255  # R
+        out = np.asarray(zoo._caffe_preprocess(jnp.asarray(x)))
+        # channel 0 is now B (0 - B_mean), channel 2 is R (255 - R_mean)
+        np.testing.assert_allclose(out[0, 0, 0, 0], -103.939, atol=1e-3)
+        np.testing.assert_allclose(out[0, 0, 0, 2], 255 - 123.68,
+                                   atol=1e-3)
+
+
+@pytest.mark.parametrize("name,feat_dim", [
+    ("InceptionV3", 2048), ("Xception", 2048), ("ResNet50", 2048),
+    ("VGG16", 4096), ("VGG19", 4096), ("TestNet", 16),
+])
+class TestShapes:
+    def test_feature_and_logit_shapes(self, name, feat_dim):
+        """Shape-check every zoo model without running the math."""
+        spec = zoo.getKerasApplicationModel(name)
+        module = spec.module_fn()
+        x = jnp.zeros((2, spec.height, spec.width, 3), jnp.float32)
+        variables = jax.eval_shape(
+            module.init, jax.random.PRNGKey(0), x)
+        feats = jax.eval_shape(
+            lambda v, x: module.apply(v, x, features_only=True),
+            variables, x)
+        assert feats.shape == (2, feat_dim)
+        logits = jax.eval_shape(module.apply, variables, x)
+        assert logits.shape == (2, spec.num_classes)
+
+
+class TestForward:
+    def test_testnet_forward(self):
+        mf = zoo.getModelFunction("TestNet")
+        x = np.random.default_rng(0).integers(
+            0, 255, (4, 32, 32, 3), dtype=np.uint8)
+        out = mf(x)
+        assert np.asarray(out).shape == (4, 16)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_testnet_deterministic_params(self):
+        a = zoo.getModelFunction("TestNet")
+        b = zoo.getModelFunction("TestNet")
+        xa = jax.tree.leaves(a.params)[0]
+        xb = jax.tree.leaves(b.params)[0]
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+    @pytest.mark.slow
+    def test_inceptionv3_forward(self):
+        """The one heavy model we actually run (reference gated CI the
+        same way)."""
+        mf = zoo.getModelFunction("InceptionV3")
+        x = np.random.default_rng(0).integers(
+            0, 255, (1, 299, 299, 3), dtype=np.uint8)
+        out = np.asarray(mf(x))
+        assert out.shape == (1, 2048)
+        assert np.isfinite(out).all()
+
+    def test_predict_mode(self):
+        mf = zoo.getModelFunction("TestNet", featurize=False)
+        x = np.zeros((2, 32, 32, 3), np.uint8)
+        out = np.asarray(mf(x))
+        assert out.shape == (2, 10)
+
+
+class TestFetcher:
+    def test_put_get_roundtrip(self, tmp_path):
+        f = ModelFetcher(cache_dir=str(tmp_path))
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        f.put("toy.msgpack", params)
+        back = f.get("toy.msgpack", {"w": np.zeros((2, 3), np.float32)})
+        np.testing.assert_array_equal(back["w"], params["w"])
+
+    def test_hash_verification(self, tmp_path):
+        f = ModelFetcher(cache_dir=str(tmp_path))
+        f.put("toy.msgpack", {"w": np.ones(3, np.float32)})
+        # corrupt the blob
+        p = tmp_path / "toy.msgpack"
+        p.write_bytes(p.read_bytes() + b"x")
+        with pytest.raises(IOError, match="hash mismatch"):
+            f.get("toy.msgpack", {"w": np.zeros(3, np.float32)})
+
+    def test_getfromweb_offline_error(self, tmp_path):
+        f = ModelFetcher(cache_dir=str(tmp_path))
+        with pytest.raises(IOError, match="could not fetch"):
+            f.getFromWeb("http://203.0.113.1/w.msgpack", "w.msgpack",
+                         "0" * 64, {})
+
+    def test_getfromweb_file_url(self, tmp_path):
+        import hashlib
+        from flax import serialization
+        params = {"w": np.ones(3, np.float32)}
+        blob = serialization.to_bytes(params)
+        src = tmp_path / "src.msgpack"
+        src.write_bytes(blob)
+        digest = hashlib.sha256(blob).hexdigest()
+        f = ModelFetcher(cache_dir=str(tmp_path / "cache"))
+        back = f.getFromWeb(src.as_uri(), "w.msgpack", digest,
+                            {"w": np.zeros(3, np.float32)})
+        np.testing.assert_array_equal(back["w"], params["w"])
+
+    def test_zoo_uses_cached_weights(self, tmp_path, monkeypatch):
+        f = ModelFetcher(cache_dir=str(tmp_path))
+        init = zoo._init_variables("TestNet")
+        custom = jax.tree.map(lambda a: np.full_like(np.asarray(a), 0.5),
+                              init)
+        f.put("TestNet.msgpack", custom)
+        loaded = zoo.load_variables("TestNet", fetcher=f)
+        leaf = np.asarray(jax.tree.leaves(loaded)[0])
+        np.testing.assert_allclose(leaf, 0.5)
+
+
+class TestDecodePredictions:
+    def test_topk(self):
+        logits = np.zeros((2, 1000), np.float32)
+        logits[0, 42] = 9.0
+        logits[1, 7] = 3.0
+        out = zoo.decode_predictions(logits, top=3)
+        assert len(out) == 2 and len(out[0]) == 3
+        assert out[0][0][2] == 9.0
+        assert out[1][0][2] == 3.0

@@ -1,0 +1,70 @@
+"""BatchRunner tests (L1: static-shape chunking, padding, async gather)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+
+
+def _double_fn():
+    return ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                    input_shape=(3,))
+
+
+class TestBatchRunner:
+    def test_exact_multiple(self):
+        r = BatchRunner(_double_fn(), batch_size=4)
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        out = r.run({"input": x})["output"]
+        np.testing.assert_allclose(out, x * 2)
+
+    def test_padding_last_chunk(self):
+        r = BatchRunner(_double_fn(), batch_size=4)
+        x = np.arange(21, dtype=np.float32).reshape(7, 3)
+        out = r.run({"input": x})["output"]
+        assert out.shape == (7, 3)
+        np.testing.assert_allclose(out, x * 2)
+
+    def test_smaller_than_batch(self):
+        r = BatchRunner(_double_fn(), batch_size=64)
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(r.run({"input": x})["output"], 2.0)
+
+    def test_empty_input(self):
+        r = BatchRunner(_double_fn(), batch_size=4)
+        out = r.run({"input": np.zeros((0, 3), np.float32)})
+        assert out["output"].shape == (0, 3)
+
+    def test_metrics(self):
+        m = RunnerMetrics()
+        r = BatchRunner(_double_fn(), batch_size=4, metrics=m)
+        r.run({"input": np.zeros((10, 3), np.float32)})
+        assert m.rows == 10
+        assert m.batches == 3
+        assert m.seconds > 0
+        assert m.rows_per_second > 0
+
+    def test_row_count_mismatch(self):
+        def two_in(params, inputs):
+            return {"out": inputs["a"] + inputs["b"]}
+        mf = ModelFunction(two_in, None,
+                           {"a": ((2,), np.float32),
+                            "b": ((2,), np.float32)})
+        r = BatchRunner(mf, batch_size=4)
+        with pytest.raises(ValueError, match="rows"):
+            r.run({"a": np.zeros((3, 2), np.float32),
+                   "b": np.zeros((4, 2), np.float32)})
+
+    def test_bad_batch_size(self):
+        with pytest.raises(ValueError):
+            BatchRunner(_double_fn(), batch_size=0)
+
+    def test_host_backend(self):
+        def host_apply(params, inputs):
+            return {"y": np.asarray(inputs["x"]) + 1.0}
+        mf = ModelFunction(host_apply, None, {"x": ((3,), np.float32)},
+                           output_names=["y"], backend="host")
+        r = BatchRunner(mf, batch_size=4)
+        x = np.zeros((6, 3), np.float32)
+        np.testing.assert_allclose(r.run({"x": x})["y"], 1.0)

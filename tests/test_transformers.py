@@ -1,0 +1,241 @@
+"""Transformer tests — the reference's strongest pattern: pipeline output
+vs in-process model oracle (``named_image_test.py``, SURVEY §4.2)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.models import zoo
+from sparkdl_tpu.transformers import (
+    DeepImageFeaturizer,
+    DeepImagePredictor,
+    ImageTransformer,
+    KerasImageFileTransformer,
+    KerasTransformer,
+    TensorTransformer,
+)
+from sparkdl_tpu.transformers.utils import packImageBatch
+
+
+@pytest.fixture(scope="module")
+def image_df(tmp_path_factory):
+    """Mixed-size images on disk, read through readImages."""
+    from PIL import Image
+    rng = np.random.default_rng(5)
+    d = tmp_path_factory.mktemp("tximgs")
+    for i, (h, w) in enumerate([(40, 50), (32, 32), (64, 48), (20, 30),
+                                (55, 21)]):
+        arr = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        Image.fromarray(arr, "RGB").save(d / f"t{i}.png")
+    return imageIO.readImages(str(d), numPartitions=2)
+
+
+class TestImageTransformer:
+    def test_matches_direct_model_oracle(self, image_df):
+        mf = zoo.getModelFunction("TestNet")
+        t = ImageTransformer(inputCol="image", outputCol="features",
+                             modelFunction=mf, batchSize=3)
+        got = t.transform(image_df).tensor("features")
+
+        packed = packImageBatch(
+            image_df.collect().column("image"), 32, 32, 3)
+        expected = np.asarray(mf(packed))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+        assert t.metrics.rows == 5
+
+    def test_image_output_mode(self, image_df):
+        def invert(x):
+            return 255.0 - x.astype("float32")
+        mf = ModelFunction.fromSingle(
+            invert, None, input_shape=(8, 8, 3), input_dtype=np.uint8,
+            input_name="image")
+        t = ImageTransformer(inputCol="image", outputCol="inverted",
+                             modelFunction=mf, outputMode="image",
+                             batchSize=2)
+        rows = t.transform(image_df).collect_rows()
+        for r in rows:
+            out = imageIO.imageStructToArray(r["inverted"])
+            assert out.shape == (8, 8, 3)
+
+    def test_empty_partition(self, image_df):
+        """A partition whose rows were all filtered out must flow through
+        the device stage (regression: reshape(0, -1) crash)."""
+        empty = image_df.filter(lambda b: np.zeros(b.num_rows, bool))
+        t = ImageTransformer(inputCol="image", outputCol="f",
+                             modelFunction=zoo.getModelFunction("TestNet"),
+                             batchSize=2)
+        out = t.transform(empty).collect()
+        assert out.num_rows == 0
+        assert "f" in out.schema.names
+
+    def test_non_hwc_model_rejected(self, image_df):
+        mf = ModelFunction.fromSingle(lambda x: x, None, input_shape=(4,))
+        t = ImageTransformer(inputCol="image", outputCol="o",
+                             modelFunction=mf)
+        with pytest.raises(ValueError, match="HWC"):
+            t.transform(image_df)
+
+
+class TestNamedImage:
+    def test_featurizer_oracle(self, image_df):
+        f = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                modelName="TestNet", batchSize=2)
+        got = f.transform(image_df).tensor("features")
+        assert got.shape == (5, 16)
+        mf = zoo.getModelFunction("TestNet")
+        packed = packImageBatch(image_df.collect().column("image"),
+                                32, 32, 3)
+        np.testing.assert_allclose(got, np.asarray(mf(packed)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_featurizer_unknown_model(self, image_df):
+        f = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                                modelName="NopeNet")
+        with pytest.raises(ValueError, match="unsupported"):
+            f.transform(image_df)
+
+    def test_predictor_raw(self, image_df):
+        p = DeepImagePredictor(inputCol="image", outputCol="preds",
+                               modelName="TestNet", batchSize=2)
+        out = p.transform(image_df).tensor("preds")
+        assert out.shape == (5, 10)
+
+    def test_predictor_decoded(self, image_df):
+        p = DeepImagePredictor(inputCol="image", outputCol="preds",
+                               modelName="TestNet",
+                               decodePredictions=True, topK=3)
+        rows = p.transform(image_df).collect_rows()
+        for r in rows:
+            assert len(r["preds"]) == 3
+            scores = [e["score"] for e in r["preds"]]
+            assert scores == sorted(scores, reverse=True)
+            assert all(isinstance(e["description"], str)
+                       for e in r["preds"])
+
+
+def _mlp_model_fn():
+    r = np.random.default_rng(3)
+    params = {"W": r.normal(size=(4, 2)).astype(np.float32)}
+
+    def apply_fn(p, inputs):
+        return {"scores": inputs["feats"] @ p["W"]}
+
+    return ModelFunction(apply_fn, params,
+                         {"feats": ((4,), np.float32)},
+                         output_names=["scores"])
+
+
+class TestTensorTransformer:
+    def _df(self, n=10):
+        r = np.random.default_rng(4)
+        x = r.normal(size=(n, 4)).astype(np.float32)
+        df = DataFrame.from_table(pa.table({"id": np.arange(n)}), 3)
+        return df.with_column("x", lambda b, x=x: x[
+            b.column(0).to_numpy(zero_copy_only=False).astype(int)]), x
+
+    def test_apply_and_oracle(self):
+        df, x = self._df()
+        mf = _mlp_model_fn()
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"x": "feats"},
+                              outputMapping={"scores": "y"},
+                              batchSize=4)
+        got = t.transform(df).tensor("y")
+        np.testing.assert_allclose(got, x @ np.asarray(mf.params["W"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_model_input(self):
+        df, _ = self._df()
+        t = TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={"x": "bogus"},
+                              outputMapping={"scores": "y"})
+        with pytest.raises(ValueError, match="unknown model inputs"):
+            t.transform(df)
+
+    def test_unmapped_input(self):
+        df, _ = self._df()
+        t = TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={},
+                              outputMapping={"scores": "y"})
+        with pytest.raises(ValueError, match="not mapped"):
+            t.transform(df)
+
+    def test_unknown_output(self):
+        df, _ = self._df()
+        t = TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={"x": "feats"},
+                              outputMapping={"bogus": "y"})
+        with pytest.raises(ValueError, match="unknown model outputs"):
+            t.transform(df)
+
+    def test_missing_column(self):
+        df, _ = self._df()
+        t = TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={"nope": "feats"},
+                              outputMapping={"scores": "y"})
+        with pytest.raises(KeyError):
+            t.transform(df).collect()
+
+
+@pytest.fixture(scope="module")
+def keras_file(tmp_path_factory):
+    import keras
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(4, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    path = str(tmp_path_factory.mktemp("km") / "model.keras")
+    m.save(path)
+    x = np.random.default_rng(6).normal(size=(9, 6)).astype(np.float32)
+    return path, x, m.predict(x, verbose=0)
+
+
+class TestKerasTransformers:
+    def test_keras_tensor_oracle(self, keras_file):
+        path, x, expected = keras_file
+        df = DataFrame.from_table(pa.table({"i": np.arange(len(x))}), 2) \
+            .with_column("x", lambda b: x[
+                b.column(0).to_numpy(zero_copy_only=False).astype(int)])
+        t = KerasTransformer(inputCol="x", outputCol="y", modelFile=path,
+                             batchSize=4)
+        got = t.transform(df).tensor("y")
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_keras_image_file_oracle(self, keras_file, tmp_path):
+        import keras
+        from PIL import Image
+        rng = np.random.default_rng(8)
+        paths = []
+        for i in range(5):
+            arr = rng.integers(0, 255, (10, 10, 3), dtype=np.uint8)
+            p = tmp_path / f"k{i}.png"
+            Image.fromarray(arr, "RGB").save(p)
+            paths.append(str(p))
+
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(2, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        mpath = str(tmp_path / "imgmodel.keras")
+        m.save(mpath)
+
+        def loader(uri):
+            img = Image.open(uri).resize((8, 8), Image.BILINEAR)
+            return np.asarray(img, np.float32) / 255.0
+
+        df = DataFrame.from_table(pa.table({"uri": paths}), 2)
+        t = KerasImageFileTransformer(
+            inputCol="uri", outputCol="feats", modelFile=mpath,
+            imageLoader=loader, batchSize=2)
+        got = t.transform(df).tensor("feats")
+
+        expected = m.predict(np.stack([loader(p) for p in paths]),
+                             verbose=0)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
