@@ -20,14 +20,15 @@ import pyarrow as pa
 Row = dict  # a collected row is a plain dict, keyed by column name
 
 
-def column_index(batch: pa.RecordBatch, name: str) -> int:
-    """Resolve a column name to its index, raising KeyError for unknown
-    names (pyarrow's get_field_index returns -1, which would silently
-    negative-index the last column)."""
-    idx = batch.schema.get_field_index(name)
+def column_index(data, name: str) -> int:
+    """Resolve a column name to its index in a RecordBatch/Table/Schema,
+    raising KeyError for unknown names (pyarrow's get_field_index
+    returns -1, which would silently negative-index the last column)."""
+    schema = data if isinstance(data, pa.Schema) else data.schema
+    idx = schema.get_field_index(name)
     if idx < 0:
         raise KeyError(
-            f"column {name!r} not in batch ({batch.schema.names})")
+            f"column {name!r} not in batch ({schema.names})")
     return idx
 
 
@@ -238,10 +239,7 @@ class DataFrame:
         """Collect one tensor column as a stacked ndarray [N, *shape]."""
         from sparkdl_tpu.data.tensors import arrow_to_tensor
         table = self.collect()
-        idx = table.schema.get_field_index(col)
-        if idx < 0:
-            raise KeyError(
-                f"column {col!r} not in frame ({table.schema.names})")
+        idx = column_index(table, col)
         return arrow_to_tensor(table.column(idx), table.schema.field(idx))
 
     def __repr__(self) -> str:

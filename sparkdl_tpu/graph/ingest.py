@@ -97,7 +97,14 @@ class ModelIngest:
         if len(model.inputs) != 1:
             raise ValueError(
                 f"expected a single-input model, got {len(model.inputs)}")
-        in_shape = tuple(int(d) for d in model.inputs[0].shape[1:])
+        raw_shape = model.inputs[0].shape[1:]
+        if any(d is None for d in raw_shape):
+            raise ValueError(
+                f"model {model.name!r} has dynamic input shape "
+                f"{model.inputs[0].shape}; XLA needs static shapes — "
+                "rebuild the model with concrete input dims "
+                "(e.g. Input((224, 224, 3)) instead of Input((None, None, 3)))")
+        in_shape = tuple(int(d) for d in raw_shape)
         in_dtype = model.inputs[0].dtype or "float32"
         out_names = [f"output_{i}" for i in range(len(model.outputs))]
 

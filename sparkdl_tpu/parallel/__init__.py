@@ -1,0 +1,41 @@
+"""Multi-chip parallelism: meshes, sharded inference, DP training.
+
+The reference owned no collective-communication layer at all (SURVEY
+§2.5: Spark RPC + broadcast + py4j + JNI was its complete inter-process
+inventory). The TPU-native equivalent lives here: a
+``jax.sharding.Mesh`` over the slice, data-parallel inference sharding,
+and a pjit training step whose gradient all-reduce rides ICI — the
+north-star capability that *exceeds* the reference (BASELINE.json
+mandates a pjit DP fine-tune where the reference only had per-task
+single-machine Keras fits).
+"""
+
+from sparkdl_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    data_sharding,
+    replicated,
+    param_shardings,
+)
+from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+from sparkdl_tpu.parallel.train import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+    shard_train_step,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "data_sharding",
+    "replicated",
+    "param_shardings",
+    "ShardedBatchRunner",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "shard_train_step",
+]

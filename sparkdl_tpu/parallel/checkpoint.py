@@ -1,0 +1,76 @@
+"""Train-state checkpoint/resume via orbax.
+
+The reference had load-only checkpointing (SURVEY §5: ``TFInputGraph``
+read TF checkpoints/SavedModels, but no training state was ever saved —
+a crashed estimator fit restarted from scratch). Orbax save/restore of
+the full :class:`~sparkdl_tpu.parallel.train.TrainState` closes that
+gap: fine-tunes resume at the last saved step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from sparkdl_tpu.parallel.train import TrainState
+
+_STATE_KEY = "train_state"
+
+
+def _as_saveable(state: TrainState) -> dict:
+    """The array-valued part of the state (apply_fn/tx are code, not
+    data — reconstructed by the caller on restore)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "batch_stats": state.batch_stats,
+    }
+
+
+def save_checkpoint(directory: str, state: TrainState, step: int,
+                    keep: int = 3) -> str:
+    """Save the state under ``directory/step_{step}``; prunes to the
+    newest ``keep`` checkpoints. Returns the checkpoint path."""
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
+    ) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(_as_saveable(state)))
+        mgr.wait_until_finished()
+    return os.path.join(directory, str(step))
+
+
+def latest_step(directory: str) -> Optional[int]:
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    with ocp.CheckpointManager(directory) as mgr:
+        return mgr.latest_step()
+
+
+def restore_checkpoint(directory: str, state: TrainState,
+                       step: Optional[int] = None) -> TrainState:
+    """Restore into the structure of ``state`` (shapes/dtypes/shardings
+    taken from it; pass a freshly-built state). ``step=None`` →
+    latest."""
+    directory = os.path.abspath(directory)
+    template = jax.tree.map(np.asarray, _as_saveable(state))
+    with ocp.CheckpointManager(directory) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {directory}")
+        restored = mgr.restore(
+            step, args=ocp.args.StandardRestore(template))
+    return state.replace(
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        batch_stats=restored["batch_stats"])

@@ -1,0 +1,101 @@
+"""Device meshes and sharding rules.
+
+The slice topology is expressed once as a ``jax.sharding.Mesh`` with
+axes ``("data", "model")``; everything else (inference sharding, the DP
+train step, the estimator) derives `NamedSharding`s from it. The
+reference's counterpart was Spark's executor topology — implicit, owned
+by the cluster manager; here it is an explicit, testable object
+(simulated CPU devices in tests, real chips in prod).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh request: how many devices along each axis.
+
+    ``data=-1`` means "all remaining devices" (the common case: pure DP
+    over every chip, model axis 1).
+    """
+
+    data: int = -1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        model = max(1, self.model)
+        data = self.data
+        if data == -1:
+            if n_devices % model:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model={model}")
+            data = n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} != {n_devices} devices")
+        return {DATA_AXIS: data, MODEL_AXIS: model}
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 2-D ("data", "model") mesh over the given devices
+    (default: all local devices)."""
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(sizes[DATA_AXIS], sizes[MODEL_AXIS])
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the data axis (leading dim split across
+    chips; each chip sees its shard only — the DP layout)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _largest_divisible_dim(shape: Sequence[int], n: int) -> Optional[int]:
+    best = None
+    for i, d in enumerate(shape):
+        if n > 1 and d % n == 0 and d >= n and (
+                best is None or d > shape[best]):
+            best = i
+    return best
+
+
+def param_shardings(params: Any, mesh: Mesh,
+                    shard_model_axis: bool = True) -> Any:
+    """Per-leaf NamedShardings for a params pytree.
+
+    With ``model`` axis size 1 (pure DP) every leaf is replicated and
+    XLA's gradient psum over the data axis is the only collective. With
+    a real model axis, each leaf's largest divisible dim is sharded over
+    it (weight sharding in the FSDP/TP family); XLA's sharding
+    propagation inserts the all-gathers/reduce-scatters over ICI.
+    """
+    model_n = mesh.shape.get(MODEL_AXIS, 1)
+
+    def leaf_sharding(leaf):
+        shape = getattr(leaf, "shape", ())
+        if shard_model_axis and model_n > 1:
+            dim = _largest_divisible_dim(shape, model_n)
+            if dim is not None:
+                spec = [None] * len(shape)
+                spec[dim] = MODEL_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf_sharding, params)

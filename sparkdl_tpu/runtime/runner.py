@@ -19,15 +19,62 @@ the reference ran them.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from sparkdl_tpu.graph.function import ModelFunction
+
+# In-flight device batches before the oldest result is fetched: enough to
+# overlap host→device transfer with compute, bounded so a huge partition
+# can't queue unbounded device memory.
+MAX_INFLIGHT_BATCHES = 8
+
+
+def check_row_counts(inputs: Dict[str, np.ndarray]) -> int:
+    """Validate equal leading dims across named inputs; returns N."""
+    names = list(inputs)
+    if not names:
+        raise ValueError("no inputs")
+    n = len(inputs[names[0]])
+    for k, v in inputs.items():
+        if len(v) != n:
+            raise ValueError(f"input {k!r} has {len(v)} rows, expected {n}")
+    return n
+
+
+def iter_padded_chunks(inputs: Dict[str, np.ndarray], n: int,
+                       chunk_size: int
+                       ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
+    """Cut [N, ...] host arrays into contiguous fixed-size chunks
+    (XLA needs static shapes); the tail is zero-padded. Yields
+    ``(n_valid, chunk)`` — callers truncate outputs to ``n_valid``."""
+    for lo in range(0, n, chunk_size):
+        hi = min(lo + chunk_size, n)
+        chunk = {k: np.ascontiguousarray(v[lo:hi])
+                 for k, v in inputs.items()}
+        if hi - lo < chunk_size:
+            pad = chunk_size - (hi - lo)
+            chunk = {k: np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                for k, v in chunk.items()}
+        yield hi - lo, chunk
+
+
+def drain_bounded(pending: "collections.deque", outs: Dict[str, List],
+                  limit: int):
+    """device_get completed batches until at most ``limit`` remain
+    enqueued (the backpressure half of async dispatch)."""
+    while len(pending) > limit:
+        valid, res = pending.popleft()
+        res = jax.device_get(res)
+        for k, v in res.items():
+            outs.setdefault(k, []).append(np.asarray(v)[:valid])
 
 
 @dataclass
@@ -69,14 +116,7 @@ class BatchRunner:
 
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]}."""
-        names = list(inputs)
-        if not names:
-            raise ValueError("no inputs")
-        n = len(inputs[names[0]])
-        for k, v in inputs.items():
-            if len(v) != n:
-                raise ValueError(
-                    f"input {k!r} has {len(v)} rows, expected {n}")
+        n = check_row_counts(inputs)
         if n == 0:
             return self._empty_outputs()
 
@@ -105,30 +145,35 @@ class BatchRunner:
     def _run_device(self, inputs, n) -> Dict[str, np.ndarray]:
         fn = self.model_fn.jitted()
         params = self.model_fn.params
-        bs = self.batch_size
-        pending = []
-        for lo, hi in self._chunks(n):
-            chunk = {k: np.ascontiguousarray(v[lo:hi])
-                     for k, v in inputs.items()}
-            if hi - lo < bs:
-                pad = bs - (hi - lo)
-                chunk = {k: np.concatenate(
-                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
-                    for k, v in chunk.items()}
-            # async dispatch: enqueue and move on; transfers and compute
-            # pipeline behind the scenes
-            pending.append((hi - lo, fn(params, chunk)))
+        # async dispatch: enqueue and move on; transfers and compute
+        # pipeline behind the scenes, bounded by drain_bounded
+        pending: collections.deque = collections.deque()
         outs: Dict[str, List[np.ndarray]] = {}
-        for valid, res in pending:
-            res = jax.device_get(res)
-            for k, v in res.items():
-                outs.setdefault(k, []).append(np.asarray(v)[:valid])
+        for valid, chunk in iter_padded_chunks(inputs, n, self.batch_size):
+            pending.append((valid, fn(params, chunk)))
+            drain_bounded(pending, outs, MAX_INFLIGHT_BATCHES)
+        drain_bounded(pending, outs, 0)
         return {k: np.concatenate(v) for k, v in outs.items()}
 
     def _empty_outputs(self) -> Dict[str, np.ndarray]:
         if self.model_fn.backend != "jax":
-            return {k: np.zeros((0,), np.float32)
-                    for k in self.model_fn.output_names}
+            # Host fns (TF SavedModels) usually handle N=0; running them
+            # is the only way to learn the per-row output shape so empty
+            # partitions keep the same schema as full ones.
+            try:
+                zero = {
+                    k: np.zeros(
+                        (0,) + tuple(d if d is not None else 1
+                                     for d in shape), dtype)
+                    for k, (shape, dtype)
+                    in self.model_fn.input_signature.items()
+                }
+                return {k: np.asarray(v)
+                        for k, v in self.model_fn.apply_fn(
+                            self.model_fn.params, zero).items()}
+            except Exception:
+                return {k: np.zeros((0,), np.float32)
+                        for k in self.model_fn.output_names}
         sig = self.model_fn.output_signature()
         return {k: np.zeros((0,) + tuple(shape), dtype)
                 for k, (shape, dtype) in sig.items()}

@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
+from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.data.tensors import append_tensor_column, arrow_to_tensor
 from sparkdl_tpu.params import (
     HasBatchSize,
@@ -62,10 +63,7 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
         def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
             inputs = {}
             for col, input_name in in_map.items():
-                idx = batch.schema.get_field_index(col)
-                if idx < 0:
-                    raise KeyError(f"column {col!r} not in batch "
-                                   f"({batch.schema.names})")
+                idx = column_index(batch, col)
                 arr = arrow_to_tensor(batch.column(idx),
                                       batch.schema.field(idx))
                 shape, dtype = sig[input_name]

@@ -25,7 +25,13 @@ def packImageBatch(column, height: int, width: int, nChannels: int = 3,
     out = np.zeros((len(structs), height, width, nChannels), np.uint8)
     for i, s in enumerate(structs):
         if s is None:
-            continue
+            # A silent zero image would featurize like real data; fail
+            # loudly instead (readImages(dropImageFailures=True) or a
+            # filter removes nulls upstream).
+            raise ValueError(
+                f"row {i}: null image in batch; drop failed/null image "
+                "rows before applying a model (e.g. readImages(..., "
+                "dropImageFailures=True) or df.filter)")
         arr = imageIO.imageStructToArray(s)
         if resize and (arr.shape[0] != height or arr.shape[1] != width
                        or arr.shape[2] != nChannels):

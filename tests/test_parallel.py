@@ -1,0 +1,177 @@
+"""Multi-device tests on the 8-virtual-CPU-device mesh (conftest sets
+XLA_FLAGS) — the SURVEY §4.1 substrate: single host, simulated chips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.models.zoo import getKerasApplicationModel, getModelFunction
+from sparkdl_tpu.parallel import (
+    MeshSpec,
+    ShardedBatchRunner,
+    create_train_state,
+    make_eval_step,
+    make_mesh,
+    make_train_step,
+    param_shardings,
+    shard_train_step,
+)
+from sparkdl_tpu.parallel.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def test_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
+    mesh2 = make_mesh(MeshSpec(data=-1, model=2))
+    assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, model=2).resolve(8)
+
+
+def test_param_shardings_model_axis():
+    mesh = make_mesh(MeshSpec(data=-1, model=2))
+    params = {"w": jnp.zeros((6, 4)), "b": jnp.zeros((3,)),
+              "scalar": jnp.zeros(())}
+    sh = param_shardings(params, mesh)
+    assert sh["w"].spec == jax.sharding.PartitionSpec("model", None)
+    assert sh["b"].spec == jax.sharding.PartitionSpec()
+    assert sh["scalar"].spec == jax.sharding.PartitionSpec()
+
+
+class TestShardedInference:
+
+    def test_matches_single_device(self):
+        mesh = make_mesh()
+        mf = getModelFunction("TestNet", featurize=True)
+        runner = ShardedBatchRunner(mf, mesh, batch_size=4)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 255, size=(70, 32, 32, 3), dtype=np.uint8)
+        out = runner.run({"image": x})["features"]
+        assert out.shape == (70, 16)
+        ref = np.asarray(mf({"image": x[:70]})["features"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        assert runner.metrics.rows == 70
+
+    def test_rejects_host_backend(self):
+        mf = ModelFunction(lambda p, d: d, backend="host",
+                           input_signature={"x": ((2,), np.float32)})
+        with pytest.raises(ValueError, match="jax backend"):
+            ShardedBatchRunner(mf)
+
+
+class TestDPTraining:
+
+    def _setup(self, mesh):
+        spec = getKerasApplicationModel("TestNet")
+        module = spec.module_fn()
+        x = jnp.zeros((1, 32, 32, 3), jnp.uint8)
+        variables = module.init(jax.random.PRNGKey(0), spec.preprocess(x))
+        state = create_train_state(module, variables,
+                                   optax.sgd(1e-2, momentum=0.9))
+        step = make_train_step(module, spec.preprocess, spec.num_classes)
+        return spec, module, state, step
+
+    def test_loss_decreases_and_stats_update(self):
+        mesh = make_mesh()
+        spec, module, state, step = self._setup(mesh)
+        jitted, state = shard_train_step(step, mesh, state)
+        rng = np.random.default_rng(1)
+        batch = {
+            "image": jnp.asarray(rng.integers(
+                0, 255, size=(16, 32, 32, 3), dtype=np.uint8)),
+            "label": jnp.asarray(rng.integers(0, 10, size=(16,))),
+        }
+        first = None
+        for _ in range(8):
+            state, metrics = jitted(state, batch)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first
+        assert int(state.step) == 8
+
+    def test_dp_matches_single_device_step(self):
+        """One sharded DP step == the same step unsharded (grads psum
+        over the data axis must be numerically equivalent)."""
+        mesh = make_mesh()
+        spec, module, state0, step = self._setup(mesh)
+        rng = np.random.default_rng(2)
+        batch = {
+            "image": jnp.asarray(rng.integers(
+                0, 255, size=(16, 32, 32, 3), dtype=np.uint8)),
+            "label": jnp.asarray(rng.integers(0, 10, size=(16,))),
+        }
+        ref_state, ref_metrics = jax.jit(step)(state0, batch)
+
+        jitted, sharded = shard_train_step(step, mesh, state0)
+        new_state, metrics = jitted(sharded, batch)
+        np.testing.assert_allclose(float(metrics["loss"]),
+                                   float(ref_metrics["loss"]),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(ref_state.params),
+                        jax.tree.leaves(new_state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_model_axis_sharding_compiles(self):
+        mesh = make_mesh(MeshSpec(data=-1, model=2))
+        spec, module, state, step = self._setup(mesh)
+        jitted, state = shard_train_step(step, mesh, state,
+                                         shard_model_axis=True)
+        rng = np.random.default_rng(3)
+        batch = {
+            "image": jnp.asarray(rng.integers(
+                0, 255, size=(8, 32, 32, 3), dtype=np.uint8)),
+            "label": jnp.asarray(rng.integers(0, 10, size=(8,))),
+        }
+        state, metrics = jitted(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_eval_step(self):
+        mesh = make_mesh()
+        spec, module, state, _ = self._setup(mesh)
+        ev = jax.jit(make_eval_step(module, spec.preprocess,
+                                    spec.num_classes))
+        rng = np.random.default_rng(4)
+        batch = {
+            "image": jnp.asarray(rng.integers(
+                0, 255, size=(8, 32, 32, 3), dtype=np.uint8)),
+            "label": jnp.asarray(rng.integers(0, 10, size=(8,))),
+        }
+        m = ev(state, batch)
+        assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+class TestCheckpoint:
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        spec = getKerasApplicationModel("TestNet")
+        module = spec.module_fn()
+        x = jnp.zeros((1, 32, 32, 3), jnp.uint8)
+        variables = module.init(jax.random.PRNGKey(0), spec.preprocess(x))
+        state = create_train_state(module, variables, optax.adam(1e-3))
+        step = make_train_step(module, spec.preprocess, spec.num_classes)
+        rng = np.random.default_rng(5)
+        batch = {
+            "image": jnp.asarray(rng.integers(
+                0, 255, size=(4, 32, 32, 3), dtype=np.uint8)),
+            "label": jnp.asarray(rng.integers(0, 10, size=(4,))),
+        }
+        state, _ = jax.jit(step)(state, batch)
+        ckdir = str(tmp_path / "ck")
+        save_checkpoint(ckdir, state, step=1)
+        assert latest_step(ckdir) == 1
+
+        fresh = create_train_state(module, variables, optax.adam(1e-3))
+        restored = restore_checkpoint(ckdir, fresh)
+        assert int(restored.step) == 1
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(restored.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
