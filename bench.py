@@ -1,0 +1,64 @@
+"""North-star benchmark: InceptionV3 DeepImageFeaturizer throughput.
+
+Measures images/sec/chip for the full device program (uint8 NHWC infeed
+→ fused preprocess → InceptionV3 → 2048-d features) through the
+production ``BatchRunner`` on whatever accelerator is attached (the one
+real TPU chip under the driver; CPU as fallback).
+
+``vs_baseline`` compares against the BASELINE.json north-star of 10,000
+images/sec aggregate on v5e-8 == 1,250 images/sec/chip under linear DP
+scaling (see BASELINE.md "Unit note").
+
+Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 1250.0  # 10k img/s ÷ 8 chips (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch_size = 256 if on_tpu else 16
+    n_rows = batch_size * (16 if on_tpu else 2)
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, size=(n_rows, 299, 299, 3),
+                          dtype=np.uint8)
+
+    mf = getModelFunction("InceptionV3", featurize=True)
+    runner = BatchRunner(mf, batch_size=batch_size)
+
+    # Warmup: compile + one full pass so caches/transfers are steady.
+    runner.run({"image": images[: batch_size * 2]})
+
+    metrics = RunnerMetrics()
+    runner.metrics = metrics
+    t0 = time.perf_counter()
+    out = runner.run({"image": images})
+    elapsed = time.perf_counter() - t0
+    assert out["features"].shape == (n_rows, 2048), out["features"].shape
+
+    ips = n_rows / elapsed
+    print(json.dumps({
+        "metric": f"images_per_sec_per_chip_inceptionv3_featurize[{platform}]",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / PER_CHIP_TARGET, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

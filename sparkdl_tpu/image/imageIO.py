@@ -138,13 +138,18 @@ def batchToStructs(column) -> List[Optional[dict]]:
 def imageColumnToNHWC(column, height: int, width: int,
                       nChannels: int = 3) -> np.ndarray:
     """Image struct column (all rows already h×w×c) → contiguous
-    [N,H,W,C] uint8 array, zero rows for nulls. The fast path the runner
-    feeds to the TPU."""
+    [N,H,W,C] uint8 array. The fast path the runner feeds to the TPU.
+    Null rows raise: a silent zero image would featurize like real data
+    (drop failures upstream, e.g. ``readImages(dropImageFailures=True)``
+    or ``df.filter``)."""
     structs = batchToStructs(column)
     out = np.zeros((len(structs), height, width, nChannels), dtype=np.uint8)
     for i, s in enumerate(structs):
         if s is None:
-            continue
+            raise ValueError(
+                f"row {i}: null image in batch; drop failed/null image "
+                "rows before converting to NHWC (e.g. readImages(..., "
+                "dropImageFailures=True) or df.filter)")
         if s["height"] != height or s["width"] != width \
                 or s["nChannels"] != nChannels:
             raise ValueError(

@@ -54,13 +54,25 @@ def latest_step(directory: str) -> Optional[int]:
         return mgr.latest_step()
 
 
+def _abstract_leaf(leaf):
+    """Template leaf for StandardRestore: shape/dtype, plus the leaf's
+    sharding when it is a device array — so a state laid out by
+    ``shard_train_step`` restores straight into the same mesh layout
+    (works multi-host, where materializing to numpy would not)."""
+    if isinstance(leaf, jax.Array):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=leaf.sharding)
+    arr = np.asarray(leaf)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
 def restore_checkpoint(directory: str, state: TrainState,
                        step: Optional[int] = None) -> TrainState:
     """Restore into the structure of ``state`` (shapes/dtypes/shardings
     taken from it; pass a freshly-built state). ``step=None`` →
     latest."""
     directory = os.path.abspath(directory)
-    template = jax.tree.map(np.asarray, _as_saveable(state))
+    template = jax.tree.map(_abstract_leaf, _as_saveable(state))
     with ocp.CheckpointManager(directory) as mgr:
         if step is None:
             step = mgr.latest_step()

@@ -32,6 +32,7 @@ from sparkdl_tpu.runtime.runner import (
     RunnerMetrics,
     check_row_counts,
     drain_bounded,
+    empty_jax_outputs,
     iter_padded_chunks,
 )
 
@@ -71,9 +72,7 @@ class ShardedBatchRunner:
         N is cut into global batches, the tail padded then truncated."""
         n = check_row_counts(inputs)
         if n == 0:
-            sig = self.model_fn.output_signature()
-            return {k: np.zeros((0,) + tuple(shape), dtype)
-                    for k, (shape, dtype) in sig.items()}
+            return empty_jax_outputs(self.model_fn)
 
         t0 = time.perf_counter()
         gb = self._global_batch

@@ -33,7 +33,13 @@ class MeshSpec:
     model: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        model = max(1, self.model)
+        if self.model < 1:
+            raise ValueError(f"model axis must be >= 1, got {self.model}")
+        if self.data != -1 and self.data < 1:
+            raise ValueError(
+                f"data axis must be >= 1 (or -1 for 'all remaining'), "
+                f"got {self.data}")
+        model = self.model
         data = self.data
         if data == -1:
             if n_devices % model:

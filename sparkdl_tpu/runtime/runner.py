@@ -159,7 +159,10 @@ class BatchRunner:
         if self.model_fn.backend != "jax":
             # Host fns (TF SavedModels) usually handle N=0; running them
             # is the only way to learn the per-row output shape so empty
-            # partitions keep the same schema as full ones.
+            # partitions keep the same schema as full ones. A model that
+            # rejects N=0 must fail loudly here — a guessed fallback
+            # schema would diverge from non-empty partitions and break
+            # far away at the Arrow concat.
             try:
                 zero = {
                     k: np.zeros(
@@ -171,9 +174,18 @@ class BatchRunner:
                 return {k: np.asarray(v)
                         for k, v in self.model_fn.apply_fn(
                             self.model_fn.params, zero).items()}
-            except Exception:
-                return {k: np.zeros((0,), np.float32)
-                        for k in self.model_fn.output_names}
-        sig = self.model_fn.output_signature()
-        return {k: np.zeros((0,) + tuple(shape), dtype)
-                for k, (shape, dtype) in sig.items()}
+            except Exception as e:
+                raise ValueError(
+                    f"host model {self.model_fn.name!r} failed on the "
+                    "empty (N=0) probe batch used to determine the "
+                    "empty-partition output schema; filter out empty "
+                    "partitions or make the model accept N=0") from e
+        return empty_jax_outputs(self.model_fn)
+
+
+def empty_jax_outputs(model_fn: ModelFunction) -> Dict[str, np.ndarray]:
+    """Schema-correct zero-row outputs for a jax-backend ModelFunction
+    (shared by BatchRunner and ShardedBatchRunner)."""
+    sig = model_fn.output_signature()
+    return {k: np.zeros((0,) + tuple(shape), dtype)
+            for k, (shape, dtype) in sig.items()}
