@@ -1,0 +1,22 @@
+"""Estimators: pipeline stages that fit models.
+
+Reference: ``python/sparkdl/estimators/keras_image_file_estimator.py``
+(the repo's single Estimator) plus the evaluators its CrossValidator
+composition needed from Spark ML.
+"""
+
+from sparkdl_tpu.estimators.evaluators import (
+    ClassificationEvaluator,
+    LossEvaluator,
+)
+from sparkdl_tpu.estimators.keras_image_file_estimator import (
+    KerasImageFileEstimator,
+    KerasImageFileModel,
+)
+
+__all__ = [
+    "KerasImageFileEstimator",
+    "KerasImageFileModel",
+    "ClassificationEvaluator",
+    "LossEvaluator",
+]

@@ -1,0 +1,95 @@
+"""Evaluators for CrossValidator / TrainValidationSplit scoring.
+
+The reference leaned on Spark ML's evaluators (its estimator tests
+composed ``KerasImageFileEstimator`` with ``CrossValidator`` + a
+``MulticlassClassificationEvaluator``); these are the native
+counterparts scoring a transformed DataFrame's prediction column
+against its label column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparkdl_tpu.data.frame import column_index
+from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.params.pipeline import Evaluator
+
+
+def _collect_pred_and_labels(dataset, predictionCol: str, labelCol: str):
+    table = dataset.collect()
+    from sparkdl_tpu.data.tensors import arrow_to_tensor
+    pidx = column_index(table, predictionCol)
+    preds = np.asarray(arrow_to_tensor(table.column(pidx),
+                                       table.schema.field(pidx)))
+    labels = np.asarray(
+        table.column(column_index(table, labelCol)).to_pylist())
+    return preds, labels
+
+
+class ClassificationEvaluator(Evaluator):
+    """Accuracy of argmax(prediction vector) vs an integer (or one-hot)
+    label column. Larger is better."""
+
+    predictionCol = Param("ClassificationEvaluator", "predictionCol",
+                          "prediction vector column",
+                          TypeConverters.toString)
+    labelCol = Param("ClassificationEvaluator", "labelCol", "label column",
+                     TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, *, predictionCol="prediction", labelCol="label"):
+        super().__init__()
+        self._setDefault(predictionCol="prediction", labelCol="label")
+        self._set(predictionCol=predictionCol, labelCol=labelCol)
+
+    def evaluate(self, dataset) -> float:
+        preds, labels = _collect_pred_and_labels(
+            dataset, self.getOrDefault("predictionCol"),
+            self.getOrDefault("labelCol"))
+        if labels.ndim > 1:  # one-hot labels
+            labels = labels.argmax(-1)
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
+        if preds.ndim == 1:
+            hit = (preds > 0.5).astype(np.int64) == labels
+        else:
+            hit = preds.argmax(-1) == labels
+        return float(np.mean(hit))
+
+
+class LossEvaluator(Evaluator):
+    """Mean categorical cross-entropy of a probability-vector prediction
+    column vs integer labels. Smaller is better."""
+
+    predictionCol = Param("LossEvaluator", "predictionCol",
+                          "probability vector column",
+                          TypeConverters.toString)
+    labelCol = Param("LossEvaluator", "labelCol", "label column",
+                     TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, *, predictionCol="prediction", labelCol="label"):
+        super().__init__()
+        self._setDefault(predictionCol="prediction", labelCol="label")
+        self._set(predictionCol=predictionCol, labelCol=labelCol)
+
+    def isLargerBetter(self) -> bool:
+        return False
+
+    def evaluate(self, dataset) -> float:
+        preds, labels = _collect_pred_and_labels(
+            dataset, self.getOrDefault("predictionCol"),
+            self.getOrDefault("labelCol"))
+        preds = np.clip(preds, 1e-7, 1.0 - 1e-7)
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
+        if preds.ndim == 1:  # binary cross-entropy on a scalar probability
+            y = (labels.argmax(-1) if labels.ndim > 1
+                 else labels).astype(np.float64)
+            picked = np.where(y > 0.5, preds, 1.0 - preds)
+        elif labels.ndim == 1:
+            picked = preds[np.arange(len(labels)), labels.astype(np.int64)]
+        else:
+            picked = np.sum(preds * labels, axis=-1)
+        return float(-np.mean(np.log(picked)))

@@ -1,0 +1,28 @@
+"""Batch-UDF registry + Keras image UDF registration.
+
+Reference: ``python/sparkdl/graph/tensorframes_udf.py::makeGraphUDF``
+(frozen graph → named Spark SQL function via TensorFrames' JVM registry)
+and ``python/sparkdl/udf/keras_image_model.py::registerKerasImageUDF``.
+"""
+
+from sparkdl_tpu.udf.keras_image_model import registerKerasImageUDF
+from sparkdl_tpu.udf.registry import (
+    ModelUDF,
+    callUDF,
+    getUDF,
+    listUDFs,
+    makeModelUDF,
+    registerUDF,
+    unregisterUDF,
+)
+
+__all__ = [
+    "ModelUDF",
+    "makeModelUDF",
+    "registerUDF",
+    "registerKerasImageUDF",
+    "unregisterUDF",
+    "getUDF",
+    "listUDFs",
+    "callUDF",
+]

@@ -1,0 +1,91 @@
+"""registerKerasImageUDF: expose a Keras model as a named image UDF.
+
+Re-design of the reference's
+``python/sparkdl/udf/keras_image_model.py::registerKerasImageUDF(name,
+model_or_file, preprocessor=None)``, which froze the Keras model inside
+``KSessionWrap``, composed ``GraphFunction.fromList([spImage converter,
+(preprocessor), model])`` and registered it through TensorFrames. Here
+the converter is the transformers' host-side resize/pack, and the
+(optional) preprocessor + model compose into ONE jitted device program —
+XLA fuses what the reference stitched as GraphDefs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.udf.registry import ModelUDF, makeModelUDF
+
+
+def _composed_image_fn(model_mf: ModelFunction,
+                       preprocessor: Optional[Callable],
+                       input_size: Optional[Tuple[int, int]],
+                       name: str) -> ModelFunction:
+    """[uint8 image batch → (preprocessor) → model] as one ModelFunction.
+
+    Without a preprocessor the UDF's input is the model's own HWC shape.
+    With one, ``preprocessor(float32 [N,h,w,c] in 0..255) -> model-input
+    batch`` runs inside the jitted program (reference: a user TF graph
+    spliced between converter and model); ``input_size`` sets the
+    pre-resize target when it differs from the model's.
+    """
+    (model_in,) = model_mf.input_names
+    m_shape, m_dtype = model_mf.input_signature[model_in]
+    if len(m_shape) != 3:
+        raise ValueError(
+            f"registerKerasImageUDF needs an HWC image model, got input "
+            f"shape {m_shape}")
+
+    if preprocessor is None:
+        # identity composition: just relabel the model's input as the
+        # canonical uint8 image input
+        def apply_fn(params, inputs):
+            x = inputs["image"].astype(m_dtype)
+            return model_mf.apply_fn(params, {model_in: x})
+
+        h, w, c = m_shape
+    else:
+        h, w = input_size or (m_shape[0], m_shape[1])
+        c = m_shape[2]
+
+        def apply_fn(params, inputs):
+            import jax.numpy as jnp
+            x = inputs["image"].astype(jnp.float32)
+            x = preprocessor(x)
+            return model_mf.apply_fn(params, {model_in: x.astype(m_dtype)})
+
+    return ModelFunction(
+        apply_fn, model_mf.params,
+        input_signature={"image": ((h, w, c), np.uint8)},
+        output_names=model_mf.output_names,
+        name=name)
+
+
+def registerKerasImageUDF(udf_name: str, keras_model_or_file,
+                          preprocessor: Optional[Callable] = None,
+                          input_size: Optional[Tuple[int, int]] = None,
+                          batch_size: int = 64,
+                          register: bool = True,
+                          replace: bool = False) -> ModelUDF:
+    """Register a Keras model (object or ``.h5``/``.keras`` path) as a
+    named image UDF.
+
+    Returns the :class:`ModelUDF`; apply it with
+    ``callUDF(udf_name, df, "image", "out")`` or ``udf.apply(...)`` —
+    the reference's ``spark.sql("SELECT udf(image) ...")`` analogue.
+    """
+    from sparkdl_tpu.graph.ingest import ModelIngest
+
+    if isinstance(keras_model_or_file, str):
+        model_mf = ModelIngest.fromKerasFile(keras_model_or_file)
+    else:
+        model_mf = ModelIngest.fromKerasModel(keras_model_or_file)
+
+    composed = _composed_image_fn(model_mf, preprocessor, input_size,
+                                  name=f"udf:{udf_name}")
+    return makeModelUDF(composed, udf_name, kind="image",
+                        batch_size=batch_size, register=register,
+                        replace=replace)

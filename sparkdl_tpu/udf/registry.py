@@ -1,0 +1,139 @@
+"""Named batch-UDF registry.
+
+TPU-native re-design of the reference's
+``graph/tensorframes_udf.py::makeGraphUDF(graph, udf_name, fetches,
+feeds_to_fields_map, blocked, register)``: the reference registered a
+frozen TF graph as a named Spark SQL function through TensorFrames' JVM
+catalog; here a :class:`ModelUDF` wraps a compiled
+:class:`~sparkdl_tpu.graph.function.ModelFunction` in a process-global
+catalog, callable three ways:
+
+* ``udf.apply(df, inputCol, outputCol)`` — columnar, the SQL
+  ``SELECT udf(col)`` analogue (delegates to the Image/Tensor
+  transformers so execution is identical to pipeline stages);
+* ``udf(ndarray)`` — direct batched host-array call;
+* by name from anywhere in the process via :func:`callUDF` — the
+  catalog role Spark's function registry played.
+
+The reference's ``blocked=True`` (row-blocked execution) is the only
+mode here: everything is batch-columnar by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+
+
+class ModelUDF:
+    """A named, registered model applied to DataFrame columns.
+
+    ``kind`` selects the column contract: ``"image"`` applies to an
+    image struct column (host resize/pack → device program), ``"tensor"``
+    to numeric/tensor columns via explicit name mappings.
+    """
+
+    def __init__(self, name: str, model_fn: ModelFunction,
+                 kind: str = "tensor", batch_size: int = 64):
+        if kind not in ("image", "tensor"):
+            raise ValueError(f"kind must be 'image' or 'tensor', got {kind!r}")
+        self.name = name
+        self.model_fn = model_fn
+        self.kind = kind
+        self.batch_size = batch_size
+
+    def apply(self, dataset, inputCol: str, outputCol: str,
+              outputMode: str = "vector", batchSize: Optional[int] = None):
+        """Columnar application — the ``SELECT udf_name(col)`` analogue."""
+        bs = batchSize or self.batch_size
+        if self.kind == "image":
+            from sparkdl_tpu.transformers.image_transform import (
+                ImageTransformer)
+            t = ImageTransformer(inputCol=inputCol, outputCol=outputCol,
+                                 modelFunction=self.model_fn,
+                                 outputMode=outputMode, batchSize=bs)
+        else:
+            from sparkdl_tpu.transformers.tensor_transform import (
+                TensorTransformer)
+            from sparkdl_tpu.transformers.utils import single_io
+            in_name, out_name = single_io(self.model_fn)
+            t = TensorTransformer(modelFunction=self.model_fn,
+                                  inputMapping={inputCol: in_name},
+                                  outputMapping={out_name: outputCol},
+                                  batchSize=bs)
+        return t.transform(dataset)
+
+    def __call__(self, inputs):
+        """Direct batched call on host arrays (single-input models take a
+        bare ndarray; multi-input take ``{name: ndarray}``)."""
+        from sparkdl_tpu.runtime.runner import BatchRunner
+        runner = BatchRunner(self.model_fn, self.batch_size)
+        if not isinstance(inputs, dict):
+            (in_name,) = self.model_fn.input_names
+            shape, dtype = self.model_fn.input_signature[in_name]
+            arr = np.asarray(inputs)
+            inputs = {in_name: arr.astype(dtype, copy=False)}
+        out = runner.run({k: np.asarray(v) for k, v in inputs.items()})
+        if len(out) == 1:
+            return next(iter(out.values()))
+        return out
+
+    def __repr__(self) -> str:
+        return (f"ModelUDF({self.name!r}, kind={self.kind}, "
+                f"model={self.model_fn.name})")
+
+
+_registry: Dict[str, ModelUDF] = {}
+_registry_lock = threading.Lock()
+
+
+def registerUDF(udf: ModelUDF, replace: bool = False) -> ModelUDF:
+    """Install a UDF into the process-global catalog."""
+    with _registry_lock:
+        if udf.name in _registry and not replace:
+            raise ValueError(
+                f"UDF {udf.name!r} already registered; pass replace=True "
+                "to overwrite")
+        _registry[udf.name] = udf
+    return udf
+
+
+def makeModelUDF(model_fn: ModelFunction, udf_name: str,
+                 kind: str = "tensor", batch_size: int = 64,
+                 register: bool = True, replace: bool = False) -> ModelUDF:
+    """Wrap + (optionally) register a ModelFunction as a named UDF —
+    signature shape mirrors the reference's ``makeGraphUDF(graph,
+    udf_name, fetches, ..., register)``; fetches/feeds maps are subsumed
+    by the ModelFunction's named IO."""
+    udf = ModelUDF(udf_name, model_fn, kind=kind, batch_size=batch_size)
+    if register:
+        registerUDF(udf, replace=replace)
+    return udf
+
+
+def getUDF(name: str) -> ModelUDF:
+    with _registry_lock:
+        if name not in _registry:
+            raise KeyError(
+                f"no UDF named {name!r}; registered: {sorted(_registry)}")
+        return _registry[name]
+
+
+def unregisterUDF(name: str) -> bool:
+    with _registry_lock:
+        return _registry.pop(name, None) is not None
+
+
+def listUDFs() -> List[str]:
+    with _registry_lock:
+        return sorted(_registry)
+
+
+def callUDF(name: str, dataset, inputCol: str, outputCol: str,
+            **kwargs):
+    """Apply a registered UDF by name (the SQL-call analogue)."""
+    return getUDF(name).apply(dataset, inputCol, outputCol, **kwargs)

@@ -1,0 +1,130 @@
+"""UDF registry + registerKerasImageUDF tests (reference
+``udf/keras_image_model_test.py`` pattern: register, call through the
+engine, compare against the in-process model oracle)."""
+
+import numpy as np
+import pytest
+
+import sparkdl_tpu.udf as udf_mod
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.udf import (
+    callUDF,
+    getUDF,
+    listUDFs,
+    makeModelUDF,
+    registerKerasImageUDF,
+    unregisterUDF,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for name in listUDFs():
+        unregisterUDF(name)
+
+
+@pytest.fixture(scope="module")
+def image_df(tmp_path_factory):
+    from PIL import Image
+    rng = np.random.default_rng(11)
+    d = tmp_path_factory.mktemp("udfimgs")
+    for i, (h, w) in enumerate([(16, 16), (24, 20), (10, 12)]):
+        arr = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        Image.fromarray(arr, "RGB").save(d / f"u{i}.png")
+    return imageIO.readImages(str(d), numPartitions=2)
+
+
+def _double_mf():
+    return ModelFunction.fromSingle(
+        lambda x: x.astype("float32") * 2.0, None,
+        input_shape=(4,), input_dtype=np.float32, name="double")
+
+
+class TestRegistry:
+    def test_register_get_call(self):
+        u = makeModelUDF(_double_mf(), "double", kind="tensor")
+        assert "double" in listUDFs()
+        assert getUDF("double") is u
+
+        df = DataFrame.from_pylist(
+            [{"x": [1.0, 2.0, 3.0, 4.0]}, {"x": [0.0, 0.5, 1.0, 1.5]}])
+        out = callUDF("double", df, "x", "y").tensor("y")
+        np.testing.assert_allclose(
+            out, [[2, 4, 6, 8], [0, 1, 2, 3]], rtol=1e-6)
+
+    def test_duplicate_rejected_unless_replace(self):
+        makeModelUDF(_double_mf(), "dup")
+        with pytest.raises(ValueError, match="already registered"):
+            makeModelUDF(_double_mf(), "dup")
+        makeModelUDF(_double_mf(), "dup", replace=True)
+
+    def test_unknown_name(self):
+        with pytest.raises(KeyError, match="no UDF named"):
+            getUDF("nope")
+
+    def test_direct_call(self):
+        u = makeModelUDF(_double_mf(), "d2", register=False)
+        out = u(np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(out, 2 * np.ones((3, 4)))
+
+    def test_unregister(self):
+        makeModelUDF(_double_mf(), "gone")
+        assert unregisterUDF("gone")
+        assert not unregisterUDF("gone")
+        assert "gone" not in listUDFs()
+
+
+@pytest.fixture(scope="module")
+def keras_img_model():
+    import keras
+    m = keras.Sequential([
+        keras.layers.Input((12, 12, 3)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    return m
+
+
+class TestRegisterKerasImageUDF:
+    def test_matches_keras_oracle(self, keras_img_model, image_df):
+        u = registerKerasImageUDF("kudf", keras_img_model)
+        out = callUDF("kudf", image_df, "image", "probs")
+        got = out.tensor("probs")
+        assert got.shape == (3, 5)
+
+        # oracle: pack/resize identically, call the Keras model directly
+        from sparkdl_tpu.transformers.utils import packImageBatch
+        packed = packImageBatch(image_df.collect().column("image"),
+                                12, 12, 3).astype(np.float32)
+        expected = np.asarray(keras_img_model(packed))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_with_preprocessor(self, keras_img_model, image_df):
+        def pre(x):  # scale to [0,1] inside the device program
+            return x / 255.0
+
+        registerKerasImageUDF("kpre", keras_img_model, preprocessor=pre)
+        got = callUDF("kpre", image_df, "image", "p").tensor("p")
+
+        from sparkdl_tpu.transformers.utils import packImageBatch
+        packed = packImageBatch(image_df.collect().column("image"),
+                                12, 12, 3).astype(np.float32) / 255.0
+        expected = np.asarray(keras_img_model(packed))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_from_file(self, keras_img_model, image_df, tmp_path):
+        path = str(tmp_path / "m.keras")
+        keras_img_model.save(path)
+        registerKerasImageUDF("kfile", path)
+        got = callUDF("kfile", image_df, "image", "o").tensor("o")
+        assert got.shape == (3, 5)
+
+    def test_non_image_model_rejected(self):
+        import keras
+        m = keras.Sequential([keras.layers.Input((7,)),
+                              keras.layers.Dense(2)])
+        with pytest.raises(ValueError, match="HWC"):
+            registerKerasImageUDF("bad", m)
