@@ -166,16 +166,17 @@ def imageColumnToNHWC(column, height: int, width: int,
 
 def resizeImageArray(arr: np.ndarray, height: int, width: int,
                      nChannels: Optional[int] = None) -> np.ndarray:
-    """Bilinear resize via PIL (native C++ resize shim replaces this on the
-    hot path when built — see sparkdl_tpu/native)."""
+    """Bilinear resize via PIL — the reference-semantics per-row path.
+    Batch call sites (``packImageBatch``, ``createResizeImageUDF``) use
+    the C++ shim when built (sparkdl_tpu/native) and fall back here."""
     c = arr.shape[2]
     if nChannels is not None and nChannels != c:
         if c == 1 and nChannels == 3:
             arr = np.repeat(arr, 3, axis=2)
         elif c == 4 and nChannels == 3:
             arr = arr[:, :, :3]
-        elif c == 3 and nChannels == 1:
-            pil = Image.fromarray(arr, "RGB").convert("L")
+        elif c in (3, 4) and nChannels == 1:
+            pil = Image.fromarray(arr[:, :, :3], "RGB").convert("L")
             arr = np.asarray(pil)[:, :, None]
         else:
             raise ValueError(f"cannot convert {c} channels to {nChannels}")
@@ -198,16 +199,24 @@ def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
     height, width = int(size[0]), int(size[1])
 
     def _resize(batch: pa.RecordBatch) -> pa.Array:
+        from sparkdl_tpu import native
         idx = batch.schema.get_field_index("image")
         structs = batchToStructs(batch.column(idx))
-        out = []
-        for s in structs:
-            if s is None:
-                out.append(None)
-                continue
-            arr = imageStructToArray(s)
-            arr = resizeImageArray(arr, height, width, nChannels)
-            out.append(imageArrayToStruct(arr, origin=s["origin"]))
+        live = [(i, imageStructToArray(s))
+                for i, s in enumerate(structs) if s is not None]
+        out: List[Optional[dict]] = [None] * len(structs)
+        packed = (native.resize_pack_batch([a for _, a in live], height,
+                                           width, nChannels)
+                  if live else None)
+        if packed is not None:
+            for (i, _), arr in zip(live, packed):
+                out[i] = imageArrayToStruct(arr,
+                                            origin=structs[i]["origin"])
+        else:
+            for i, arr in live:
+                arr = resizeImageArray(arr, height, width, nChannels)
+                out[i] = imageArrayToStruct(arr,
+                                            origin=structs[i]["origin"])
         return pa.array(out, type=imageType)
 
     return _resize

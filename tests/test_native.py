@@ -1,0 +1,134 @@
+"""Native C++ host shim tests: build, correctness vs the Python path,
+fallback behavior. (The reference's native host path — JVM resize +
+TensorFrames — was likewise tested against golden/PIL images,
+``ImageUtilsSuite.scala``.)"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import native
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.transformers.utils import packImageBatch
+
+
+@pytest.fixture(scope="module")
+def built():
+    ok = native.available()
+    assert ok, "native shim failed to build (g++ is expected in this env)"
+    return ok
+
+
+def _structs_column(arrays):
+    import pyarrow as pa
+    structs = [imageIO.imageArrayToStruct(a) if a is not None else None
+               for a in arrays]
+    return pa.array(structs, type=imageIO.imageType)
+
+
+class TestNativeShim:
+    def test_same_size_pack_is_exact(self, built):
+        rng = np.random.default_rng(0)
+        imgs = [rng.integers(0, 255, (16, 12, 3), dtype=np.uint8)
+                for _ in range(5)]
+        out = native.resize_pack_batch(imgs, 16, 12, 3)
+        np.testing.assert_array_equal(out, np.stack(imgs))
+
+    def test_resize_close_to_pil_on_smooth_images(self, built):
+        # smooth gradients: bilinear and PIL's triangle filter agree
+        # to within a few counts
+        y = np.linspace(0, 255, 64)[:, None, None]
+        x = np.linspace(0, 255, 48)[None, :, None]
+        img = np.clip((y + x) / 2, 0, 255).astype(np.uint8)
+        img = np.repeat(img, 3, axis=2)
+        got = native.resize_pack_batch([img], 32, 24, 3)[0]
+        exp = imageIO.resizeImageArray(img, 32, 24, 3)
+        assert np.abs(got.astype(int) - exp.astype(int)).max() <= 4
+
+    def test_upscale_close_to_pil(self, built):
+        y = np.linspace(0, 255, 10)[:, None, None]
+        img = np.repeat(np.repeat(y, 8, axis=1), 3, axis=2).astype(np.uint8)
+        got = native.resize_pack_batch([img], 20, 16, 3)[0]
+        exp = imageIO.resizeImageArray(img, 20, 16, 3)
+        assert np.abs(got.astype(int) - exp.astype(int)).max() <= 4
+
+    def test_channel_conversions(self, built):
+        rng = np.random.default_rng(1)
+        gray = rng.integers(0, 255, (10, 10, 1), dtype=np.uint8)
+        out = native.resize_pack_batch([gray], 10, 10, 3)[0]
+        np.testing.assert_array_equal(out, np.repeat(gray, 3, axis=2))
+
+        rgba = rng.integers(0, 255, (10, 10, 4), dtype=np.uint8)
+        out = native.resize_pack_batch([rgba], 10, 10, 3)[0]
+        np.testing.assert_array_equal(out, rgba[:, :, :3])
+
+        rgb = rng.integers(0, 255, (10, 10, 3), dtype=np.uint8)
+        out = native.resize_pack_batch([rgb], 10, 10, 1)[0]
+        # ITU-R 601-2 luma, same formula as PIL "L" (rounding ±1)
+        rgbf = rgb.astype(np.float64)
+        exp = (rgbf[..., 0] * 299 + rgbf[..., 1] * 587
+               + rgbf[..., 2] * 114) / 1000.0
+        assert np.abs(out[..., 0].astype(float) - exp).max() <= 1.0
+
+    def test_rgba_to_gray_both_paths(self, built):
+        """4→1 must be supported identically with and without the shim
+        (regression: native accepted it, the PIL fallback rejected it)."""
+        rng = np.random.default_rng(9)
+        rgba = rng.integers(0, 255, (6, 6, 4), dtype=np.uint8)
+        nat = native.resize_pack_batch([rgba], 6, 6, 1)[0]
+        py = imageIO.resizeImageArray(rgba, 6, 6, 1)
+        assert nat.shape == py.shape == (6, 6, 1)
+        assert np.abs(nat.astype(int) - py.astype(int)).max() <= 1
+
+    def test_unsupported_conversion_raises(self, built):
+        gray = np.zeros((4, 4, 1), dtype=np.uint8)
+        with pytest.raises(ValueError, match="channel conversion"):
+            native.resize_pack_batch([gray], 4, 4, 4)
+
+    def test_mixed_sizes_batch(self, built):
+        rng = np.random.default_rng(2)
+        imgs = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                for h, w in [(8, 8), (20, 30), (15, 7)]]
+        out = native.resize_pack_batch(imgs, 12, 12, 3)
+        assert out.shape == (3, 12, 12, 3)
+        np.testing.assert_array_equal(
+            out[0], native.resize_pack_batch([imgs[0]], 12, 12, 3)[0])
+
+    def test_empty_batch(self, built):
+        out = native.resize_pack_batch([], 8, 8, 3)
+        assert out.shape == (0, 8, 8, 3)
+
+
+class TestPackImageBatchIntegration:
+    def test_pack_uses_native_and_matches_python(self, built):
+        rng = np.random.default_rng(3)
+        smooth = np.repeat(np.repeat(
+            np.linspace(0, 255, 18)[:, None, None], 20, axis=1),
+            3, axis=2).astype(np.uint8)
+        imgs = [rng.integers(0, 255, (14, 14, 3), dtype=np.uint8),
+                rng.integers(0, 255, (14, 14, 3), dtype=np.uint8),
+                smooth]
+        col = _structs_column(imgs)
+        got = packImageBatch(col, 14, 14, 3)
+        # same-size rows are exact; the smooth resized row is close to
+        # PIL (resamplers differ: bilinear vs triangle filter)
+        np.testing.assert_array_equal(got[0], imgs[0])
+        np.testing.assert_array_equal(got[1], imgs[1])
+        exp2 = imageIO.resizeImageArray(imgs[2], 14, 14, 3)
+        assert np.abs(got[2].astype(int) - exp2.astype(int)).max() <= 6
+
+    def test_null_image_raises(self, built):
+        col = _structs_column(
+            [np.zeros((4, 4, 3), np.uint8), None])
+        with pytest.raises(ValueError, match="null image"):
+            packImageBatch(col, 4, 4, 3)
+
+    def test_python_fallback_env_flag(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_NO_NATIVE", "1")
+        assert native.resize_pack_batch(
+            [np.zeros((4, 4, 3), np.uint8)], 4, 4, 3) is None
+        rng = np.random.default_rng(4)
+        imgs = [rng.integers(0, 255, (6, 9, 3), dtype=np.uint8)]
+        col = _structs_column(imgs)
+        out = packImageBatch(col, 8, 8, 3)
+        np.testing.assert_array_equal(
+            out[0], imageIO.resizeImageArray(imgs[0], 8, 8, 3))
