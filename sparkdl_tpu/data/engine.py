@@ -13,6 +13,7 @@ A Spark/mapInArrow binding can replace this class behind the same
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
@@ -20,23 +21,36 @@ from typing import Iterator, List, Optional, Sequence
 
 import pyarrow as pa
 
+logger = logging.getLogger(__name__)
+
 
 class LocalEngine:
     """Thread-pool engine with ordered streaming and bounded in-flight
-    partitions (backpressure keeps memory flat on large frames)."""
+    partitions (backpressure keeps memory flat on large frames).
+
+    IO failures (``OSError`` family, which includes Arrow IO errors) are
+    retried ``max_retries`` times before propagating — the counterpart
+    of Spark's task retry, which gave the reference free retry of
+    inference partitions (SURVEY §5 "failure detection"): sources
+    re-load from disk, so a transient read failure re-runs cleanly.
+    Deterministic errors (bad column names, shape mismatches) propagate
+    immediately and unchanged.
+    """
 
     def __init__(self, num_workers: Optional[int] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 max_retries: int = 2):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
         # Enough in-flight partitions to keep workers busy while the
         # consumer drains in order.
         self.max_inflight = max_inflight or self.num_workers * 2
+        self.max_retries = max_retries
         self._pool = ThreadPoolExecutor(
             max_workers=self.num_workers,
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
 
-    def _run_partition(self, source, plan) -> pa.RecordBatch:
+    def _run_once(self, source, plan) -> pa.RecordBatch:
         batch = source.load()
         for stage in plan:
             if stage.kind == "device":
@@ -45,6 +59,18 @@ class LocalEngine:
             else:
                 batch = stage.fn(batch)
         return batch
+
+    def _run_partition(self, source, plan) -> pa.RecordBatch:
+        attempts = 1 + max(0, self.max_retries)
+        for attempt in range(attempts):
+            try:
+                return self._run_once(source, plan)
+            except OSError as e:
+                if attempt + 1 >= attempts:
+                    raise
+                logger.warning(
+                    "partition attempt %d/%d failed (%s); retrying",
+                    attempt + 1, attempts, e)
 
     def execute(self, sources: Sequence, plan: Sequence) -> Iterator[pa.RecordBatch]:
         """Yield transformed partition batches in partition order, running

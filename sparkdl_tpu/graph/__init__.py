@@ -15,6 +15,7 @@ from sparkdl_tpu.graph.pieces import (  # noqa: F401
     buildFlattener,
     buildSpImageConverter,
 )
+from sparkdl_tpu.graph import utils  # noqa: F401  (the reference's tfx)
 
 __all__ = [
     "ModelFunction",
@@ -22,4 +23,5 @@ __all__ = [
     "TFInputGraph",
     "buildSpImageConverter",
     "buildFlattener",
+    "utils",
 ]

@@ -234,7 +234,7 @@ class ModelFunction:
             for n, (shape, dtype) in self.input_signature.items()
         }
         exported = jax_export.export(jax.jit(frozen))(args)
-        return exported.serialize()
+        return bytes(exported.serialize())
 
     @staticmethod
     def deserialize(blob: bytes, name: str = "stablehlo") -> "ModelFunction":

@@ -1,0 +1,88 @@
+"""IO-name hygiene and freeze helpers for ModelFunctions.
+
+Re-design of the reference's ``python/sparkdl/graph/utils.py`` (imported
+there as ``tfx``: ``op_name``/``tensor_name`` canonicalization,
+``get_op``/``get_tensor``/``get_shape`` lookups, ``validated_graph``/
+``validated_input``/``validated_output`` checks,
+``strip_and_freeze_until`` graph surgery). TF-graph name strings
+("op:0") don't exist in the TPU design — a ModelFunction's named IO
+plays that role — so the module maps onto validation and freeze over
+those names.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from sparkdl_tpu.graph.function import ModelFunction
+
+
+def validated_model(mf) -> ModelFunction:
+    """Assert the object is a usable ModelFunction (reference
+    ``validated_graph``)."""
+    if not isinstance(mf, ModelFunction):
+        raise TypeError(
+            f"expected a ModelFunction, got {type(mf).__name__}")
+    if not mf.input_signature:
+        raise ValueError(f"model {mf.name!r} declares no inputs")
+    return mf
+
+
+def validated_input(mf: ModelFunction, name: str) -> str:
+    """Assert ``name`` is one of the model's inputs (reference
+    ``validated_input``)."""
+    validated_model(mf)
+    if name not in mf.input_signature:
+        raise ValueError(
+            f"input {name!r} not in model {mf.name!r}; inputs: "
+            f"{mf.input_names}")
+    return name
+
+
+def validated_output(mf: ModelFunction, name: str) -> str:
+    """Assert ``name`` is one of the model's outputs (reference
+    ``validated_output``)."""
+    validated_model(mf)
+    if name not in mf.output_names:
+        raise ValueError(
+            f"output {name!r} not in model {mf.name!r}; outputs: "
+            f"{mf.output_names}")
+    return name
+
+
+def get_input_shape(mf: ModelFunction, name: str
+                    ) -> Tuple[Optional[int], ...]:
+    """Per-row shape of a named input (reference ``get_shape``; batch
+    dim implicit)."""
+    shape, _ = mf.input_signature[validated_input(mf, name)]
+    return tuple(shape)
+
+
+def get_output_shape(mf: ModelFunction, name: str) -> Tuple[int, ...]:
+    """Per-row shape of a named output, inferred via eval_shape."""
+    validated_output(mf, name)
+    shape, _ = mf.output_signature()[name]
+    return tuple(shape)
+
+
+def input_names(mf: ModelFunction) -> List[str]:
+    return validated_model(mf).input_names
+
+
+def output_names(mf: ModelFunction) -> List[str]:
+    return validated_model(mf).output_names
+
+
+def strip_and_freeze(mf: ModelFunction,
+                     batch_size: Optional[int] = None) -> bytes:
+    """Params baked in, computation serialized to StableHLO bytes — the
+    TPU-era ``strip_and_freeze_until`` (which folded TF variables into
+    constants and pruned the graph; XLA export does both by
+    construction). The bytes are the broadcast/deploy form."""
+    return validated_model(mf).export(batch_size=batch_size)
+
+
+def load_frozen(blob: bytes, name: str = "frozen") -> ModelFunction:
+    """Inverse of :func:`strip_and_freeze` (reference: GraphDef parse +
+    import)."""
+    return ModelFunction.deserialize(blob, name=name)

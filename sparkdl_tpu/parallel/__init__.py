@@ -17,6 +17,14 @@ from sparkdl_tpu.parallel.mesh import (
     replicated,
     param_shardings,
 )
+from sparkdl_tpu.parallel.distributed import (
+    HostInfo,
+    global_mesh,
+    host_info,
+    host_shard_dataframe,
+    host_shard_indices,
+    initialize,
+)
 from sparkdl_tpu.parallel.inference import ShardedBatchRunner
 from sparkdl_tpu.parallel.train import (
     TrainState,
@@ -28,6 +36,12 @@ from sparkdl_tpu.parallel.train import (
 
 __all__ = [
     "MeshSpec",
+    "HostInfo",
+    "initialize",
+    "host_info",
+    "host_shard_indices",
+    "host_shard_dataframe",
+    "global_mesh",
     "make_mesh",
     "data_sharding",
     "replicated",

@@ -1,0 +1,214 @@
+"""Engine retry + multi-host sharding + graph utils tests (SURVEY §5:
+failure detection via task retry; §2.5 DCN host sharding; §2.1 tfx)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data.engine import LocalEngine
+from sparkdl_tpu.data.frame import DataFrame, Source, Stage
+from sparkdl_tpu.graph import utils as tfx
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.parallel import (
+    global_mesh,
+    host_info,
+    host_shard_dataframe,
+    host_shard_indices,
+    initialize,
+)
+
+
+def _batch(vals):
+    return pa.RecordBatch.from_pydict({"x": pa.array(vals)})
+
+
+class TestEngineRetry:
+    def test_transient_failure_retried(self):
+        engine = LocalEngine(num_workers=2, max_retries=2)
+        fails = {"n": 0}
+        lock = threading.Lock()
+
+        def flaky_load():
+            with lock:
+                fails["n"] += 1
+                if fails["n"] == 1:
+                    raise IOError("transient read error")
+            return _batch([1, 2, 3])
+
+        sources = [Source(flaky_load, 3)]
+        out = list(engine.execute(sources, []))
+        assert out[0].num_rows == 3
+        assert fails["n"] == 2  # one failure + one success
+
+    def test_flaky_stage_retried(self):
+        engine = LocalEngine(num_workers=2, max_retries=1)
+        attempts = {"n": 0}
+        lock = threading.Lock()
+
+        def flaky_stage(batch):
+            with lock:
+                attempts["n"] += 1
+                if attempts["n"] == 1:
+                    raise IOError("decode read hiccup")
+            return batch
+
+        sources = [Source(lambda: _batch([1]), 1)]
+        out = list(engine.execute(sources, [Stage(flaky_stage)]))
+        assert out[0].num_rows == 1
+
+    def test_permanent_failure_raises_after_attempts(self):
+        engine = LocalEngine(num_workers=1, max_retries=2)
+        calls = {"n": 0}
+
+        def always_fails():
+            calls["n"] += 1
+            raise IOError("disk gone")
+
+        sources = [Source(always_fails, 1)]
+        with pytest.raises(IOError, match="disk gone"):
+            list(engine.execute(sources, []))
+        assert calls["n"] == 3
+
+    def test_zero_retries(self):
+        engine = LocalEngine(num_workers=1, max_retries=0)
+        calls = {"n": 0}
+
+        def fails():
+            calls["n"] += 1
+            raise IOError("nope")
+
+        with pytest.raises(IOError, match="nope"):
+            list(engine.execute([Source(fails, 1)], []))
+        assert calls["n"] == 1
+
+    def test_deterministic_error_not_retried(self):
+        engine = LocalEngine(num_workers=1, max_retries=3)
+        calls = {"n": 0}
+
+        def bad_stage(batch):
+            calls["n"] += 1
+            raise KeyError("column 'nope' not in batch")
+
+        with pytest.raises(KeyError, match="nope"):
+            list(engine.execute([Source(lambda: _batch([1]), 1)],
+                                [Stage(bad_stage)]))
+        assert calls["n"] == 1  # no pointless retries of user errors
+
+
+class TestHostSharding:
+    def test_single_process_owns_everything(self):
+        initialize()  # no-op single process
+        info = host_info()
+        assert info.process_count == 1
+        assert info.process_index == 0
+        assert host_shard_indices(5) == [0, 1, 2, 3, 4]
+
+    def test_initialize_attempts_join_with_explicit_args(self,
+                                                         monkeypatch):
+        """Explicit multi-process args must reach
+        jax.distributed.initialize (regression: the old process_count
+        guard initialized the backend itself, making real
+        initialization unreachable)."""
+        import jax
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        initialize(coordinator_address="10.0.0.1:1234",
+                   num_processes=2, process_id=0)
+        assert calls == [{"coordinator_address": "10.0.0.1:1234",
+                          "num_processes": 2, "process_id": 0}]
+
+    def test_initialize_auto_detect_env(self, monkeypatch):
+        """A cluster env marker must trigger an initialize attempt even
+        with no args (TPU pod auto-detection path; regression: the old
+        all-None early return skipped it)."""
+        import jax
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        monkeypatch.setenv("SLURM_JOB_ID", "12345")
+        initialize()
+        assert len(calls) == 1
+
+    def test_initialize_plain_single_process_noop(self, monkeypatch):
+        import jax
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        for v in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS",
+                  "TPU_WORKER_HOSTNAMES", "SLURM_JOB_ID",
+                  "OMPI_COMM_WORLD_SIZE"):
+            monkeypatch.delenv(v, raising=False)
+        initialize()
+        assert calls == []
+
+    def test_round_robin_explicit(self):
+        assert host_shard_indices(10, process_index=0,
+                                  process_count=4) == [0, 4, 8]
+        assert host_shard_indices(10, process_index=3,
+                                  process_count=4) == [3, 7]
+        # every partition owned exactly once
+        owned = sorted(sum((host_shard_indices(10, i, 4)
+                            for i in range(4)), []))
+        assert owned == list(range(10))
+
+    def test_invalid_process(self):
+        with pytest.raises(ValueError, match="invalid process"):
+            host_shard_indices(4, process_index=4, process_count=4)
+
+    def test_host_shard_dataframe_lazy(self):
+        loaded = []
+
+        def make(i):
+            def _load():
+                loaded.append(i)
+                return _batch([i])
+            return Source(_load, 1)
+
+        df = DataFrame([make(i) for i in range(6)])
+        mine = host_shard_dataframe(df, process_index=1, process_count=3)
+        assert mine.num_partitions == 2
+        rows = mine.collect_rows()
+        assert [r["x"] for r in rows] == [1, 4]
+        assert sorted(loaded) == [1, 4]  # other hosts' sources untouched
+
+    def test_global_mesh_shape(self):
+        mesh = global_mesh()
+        assert mesh.devices.size == 8  # conftest's virtual CPU devices
+        assert mesh.axis_names == ("data", "model")
+
+
+class TestGraphUtils:
+    def _mf(self):
+        return ModelFunction.fromSingle(
+            lambda x: x * 2.0, None, input_shape=(3,),
+            input_name="inp", output_name="out", name="m")
+
+    def test_validated_io(self):
+        mf = self._mf()
+        assert tfx.validated_input(mf, "inp") == "inp"
+        assert tfx.validated_output(mf, "out") == "out"
+        with pytest.raises(ValueError, match="not in model"):
+            tfx.validated_input(mf, "bogus")
+        with pytest.raises(ValueError, match="not in model"):
+            tfx.validated_output(mf, "bogus")
+        with pytest.raises(TypeError, match="ModelFunction"):
+            tfx.validated_model("not a model")
+
+    def test_shapes_and_names(self):
+        mf = self._mf()
+        assert tfx.get_input_shape(mf, "inp") == (3,)
+        assert tfx.get_output_shape(mf, "out") == (3,)
+        assert tfx.input_names(mf) == ["inp"]
+        assert tfx.output_names(mf) == ["out"]
+
+    def test_freeze_roundtrip(self):
+        mf = self._mf()
+        blob = tfx.strip_and_freeze(mf)
+        assert isinstance(blob, bytes) and len(blob) > 0
+        back = tfx.load_frozen(blob)
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(back({"inp": x})["out"]), x * 2.0)
