@@ -65,6 +65,8 @@ class ModelFunction:
         self.backend = backend
         self.name = name
         self._jit_cache: Dict[Any, Callable] = {}
+        self._device_params = None      # device copy of params, cached
+        self._device_params_host = None  # the host object it came from
 
     # -- construction -------------------------------------------------------
 
@@ -183,6 +185,19 @@ class ModelFunction:
 
     # -- execution ----------------------------------------------------------
 
+    def device_params(self):
+        """``params`` resident on the default device, transferred once
+        and cached — passing the host pytree to every jitted call would
+        re-transfer each weight leaf per call. Cache is keyed on the
+        params object's identity, so reassigning ``self.params``
+        invalidates it."""
+        if self.backend != "jax" or self.params is None:
+            return self.params
+        if self._device_params_host is not self.params:
+            self._device_params = jax.device_put(self.params)
+            self._device_params_host = self.params
+        return self._device_params
+
     def jitted(self, donate_inputs: bool = False) -> Callable:
         """Jit-compiled ``(params, inputs) -> outputs`` (cached)."""
         if self.backend != "jax":
@@ -195,10 +210,11 @@ class ModelFunction:
         return self._jit_cache[key]
 
     def __call__(self, inputs, params: Any = "__own__"):
-        p = self.params if params == "__own__" else params
         if self.backend == "host":
+            p = self.params if params == "__own__" else params
             d = _as_dict(inputs, self.input_names)
             return self.apply_fn(p, {k: np.asarray(v) for k, v in d.items()})
+        p = self.device_params() if params == "__own__" else params
         single = not isinstance(inputs, dict)
         d = _as_dict(inputs, self.input_names)
         d = {k: jnp.asarray(v) for k, v in d.items()}

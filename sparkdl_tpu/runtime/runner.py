@@ -144,7 +144,7 @@ class BatchRunner:
 
     def _run_device(self, inputs, n) -> Dict[str, np.ndarray]:
         fn = self.model_fn.jitted()
-        params = self.model_fn.params
+        params = self.model_fn.device_params()
         # async dispatch: enqueue and move on; transfers and compute
         # pipeline behind the scenes, bounded by drain_bounded
         pending: collections.deque = collections.deque()

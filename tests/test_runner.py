@@ -68,3 +68,18 @@ class TestBatchRunner:
         r = BatchRunner(mf, batch_size=4)
         x = np.zeros((6, 3), np.float32)
         np.testing.assert_allclose(r.run({"x": x})["y"], 1.0)
+
+    def test_device_params_cached_and_invalidated(self):
+        """Params transfer to the device once per params object and the
+        cache invalidates when .params is reassigned (regression: a
+        runner-level cache served stale weights after reassignment)."""
+        mf = ModelFunction.fromSingle(
+            lambda p, x: x * p["scale"], {"scale": np.float32(2.0)},
+            input_shape=(2,))
+        r = BatchRunner(mf, batch_size=4)
+        x = np.ones((3, 2), np.float32)
+        np.testing.assert_allclose(r.run({"input": x})["output"], 2.0)
+        assert mf.device_params() is mf.device_params()  # cached
+
+        mf.params = {"scale": np.float32(5.0)}
+        np.testing.assert_allclose(r.run({"input": x})["output"], 5.0)
