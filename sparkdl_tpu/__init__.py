@@ -38,6 +38,8 @@ _EXPORTS = {
     "KerasImageFileEstimator": (
         "sparkdl_tpu.estimators.keras_image_file_estimator",
         "KerasImageFileEstimator"),
+    "LogisticRegression": ("sparkdl_tpu.estimators.logistic_regression",
+                           "LogisticRegression"),
     "registerKerasImageUDF": ("sparkdl_tpu.udf.keras_image_model",
                               "registerKerasImageUDF"),
     "DataFrame": ("sparkdl_tpu.data.frame", "DataFrame"),

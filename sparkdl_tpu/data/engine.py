@@ -39,25 +39,44 @@ class LocalEngine:
 
     def __init__(self, num_workers: Optional[int] = None,
                  max_inflight: Optional[int] = None,
-                 max_retries: int = 2):
+                 max_retries: int = 2,
+                 stage_metrics=None):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
         # Enough in-flight partitions to keep workers busy while the
         # consumer drains in order.
         self.max_inflight = max_inflight or self.num_workers * 2
         self.max_retries = max_retries
+        # optional sparkdl_tpu.utils.StageMetrics for per-stage timing
+        self.stage_metrics = stage_metrics
         self._pool = ThreadPoolExecutor(
             max_workers=self.num_workers,
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
 
+    def _run_stage(self, stage, batch, timings) -> pa.RecordBatch:
+        if timings is None:
+            return stage.fn(batch)
+        import time
+        t0 = time.perf_counter()
+        out = stage.fn(batch)
+        timings.append((stage.name, time.perf_counter() - t0,
+                        batch.num_rows))
+        return out
+
     def _run_once(self, source, plan) -> pa.RecordBatch:
+        # Buffer stage timings locally and flush only on success, so a
+        # retried partition doesn't double-count its completed stages.
+        timings = [] if self.stage_metrics is not None else None
         batch = source.load()
         for stage in plan:
             if stage.kind == "device":
                 with self._device_lock:
-                    batch = stage.fn(batch)
+                    batch = self._run_stage(stage, batch, timings)
             else:
-                batch = stage.fn(batch)
+                batch = self._run_stage(stage, batch, timings)
+        if timings:
+            for name, seconds, rows in timings:
+                self.stage_metrics.add(name, seconds, rows)
         return batch
 
     def _run_partition(self, source, plan) -> pa.RecordBatch:

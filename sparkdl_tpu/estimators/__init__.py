@@ -13,10 +13,16 @@ from sparkdl_tpu.estimators.keras_image_file_estimator import (
     KerasImageFileEstimator,
     KerasImageFileModel,
 )
+from sparkdl_tpu.estimators.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 
 __all__ = [
     "KerasImageFileEstimator",
     "KerasImageFileModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "ClassificationEvaluator",
     "LossEvaluator",
 ]

@@ -1,0 +1,170 @@
+"""LogisticRegression: the classifier half of the transfer-learning flow.
+
+The reference's headline use case composed ``DeepImageFeaturizer`` with
+Spark MLlib's ``LogisticRegression`` (upstream README's transfer-learning
+example); MLlib isn't here, so this is the native counterpart: a
+multinomial softmax classifier over a features vector column, trained
+full-batch with optax on the accelerator, returned as a Model that
+appends a probability-vector column.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from sparkdl_tpu.data.frame import column_index
+from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.params.pipeline import Estimator, Model
+from sparkdl_tpu.params.shared import HasLabelCol
+
+
+class LogisticRegressionModel(Model):
+    """Fitted coefficients; transform appends softmax probabilities."""
+
+    def __init__(self, coefficients: np.ndarray, intercept: np.ndarray,
+                 featuresCol: str, predictionCol: str,
+                 objectiveHistory: Optional[List[float]] = None):
+        super().__init__()
+        self.coefficients = np.asarray(coefficients)   # [D, C]
+        self.intercept = np.asarray(intercept)         # [C]
+        self.featuresCol = featuresCol
+        self.predictionCol = predictionCol
+        self.objectiveHistory = objectiveHistory or []
+
+    @property
+    def numClasses(self) -> int:
+        return self.coefficients.shape[1]
+
+    def _transform(self, dataset):
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.tensors import (
+            append_tensor_column,
+            arrow_to_tensor,
+        )
+        W, b = self.coefficients, self.intercept
+        feat, out = self.featuresCol, self.predictionCol
+
+        def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
+            idx = column_index(batch, feat)
+            X = np.asarray(arrow_to_tensor(batch.column(idx),
+                                           batch.schema.field(idx)),
+                           dtype=np.float32)
+            logits = X @ W + b
+            logits -= logits.max(-1, keepdims=True)
+            e = np.exp(logits)
+            probs = (e / e.sum(-1, keepdims=True)).astype(np.float32)
+            return append_tensor_column(batch, out, probs)
+
+        return dataset.map_batches(apply, name=f"logreg({feat})")
+
+    def copy(self, extra: Optional[dict] = None):
+        that = LogisticRegressionModel(
+            self.coefficients, self.intercept, self.featuresCol,
+            self.predictionCol, list(self.objectiveHistory))
+        return that
+
+
+class LogisticRegression(Estimator, HasLabelCol):
+    """Multinomial logistic regression on a features vector column.
+
+    Params track Spark MLlib's names where they map (``featuresCol``,
+    ``labelCol``, ``predictionCol``, ``maxIter``, ``regParam`` for L2);
+    training is full-batch adam on device, jitted once.
+    """
+
+    featuresCol = Param("LogisticRegression", "featuresCol",
+                        "features vector column", TypeConverters.toString)
+    predictionCol = Param("LogisticRegression", "predictionCol",
+                          "output probability-vector column",
+                          TypeConverters.toString)
+    maxIter = Param("LogisticRegression", "maxIter",
+                    "training iterations", TypeConverters.toInt)
+    regParam = Param("LogisticRegression", "regParam",
+                     "L2 regularization strength", TypeConverters.toFloat)
+    learningRate = Param("LogisticRegression", "learningRate",
+                         "adam learning rate", TypeConverters.toFloat)
+    seed = Param("LogisticRegression", "seed", "init seed",
+                 TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, featuresCol="features", labelCol="label",
+                 predictionCol="prediction", maxIter=100, regParam=0.0,
+                 learningRate=0.1, seed=0):
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction", maxIter=100,
+                         regParam=0.0, learningRate=0.1, seed=0)
+        self._set(featuresCol=featuresCol, labelCol=labelCol,
+                  predictionCol=predictionCol, maxIter=maxIter,
+                  regParam=regParam, learningRate=learningRate, seed=seed)
+
+    def _fit(self, dataset) -> LogisticRegressionModel:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        feat = self.getOrDefault("featuresCol")
+        # materialize ONCE: the upstream plan may include the expensive
+        # featurization; read features and labels from the same table
+        from sparkdl_tpu.data.tensors import arrow_to_tensor
+        table = dataset.collect()
+        fidx = column_index(table, feat)
+        X = np.asarray(arrow_to_tensor(table.column(fidx),
+                                       table.schema.field(fidx)),
+                       dtype=np.float32)
+        if X.ndim != 2:
+            X = X.reshape(len(X), -1)
+        y = np.asarray(
+            table.column(column_index(table, self.getLabelCol()))
+            .to_pylist())
+        if len(X) == 0:
+            raise ValueError("cannot fit on an empty dataset")
+        if y.ndim != 1 or not np.issubdtype(y.dtype, np.integer):
+            raise ValueError(
+                f"labelCol must hold integer class ids, got dtype "
+                f"{y.dtype} shape {y.shape}")
+        if len(y) and y.min() < 0:
+            raise ValueError(
+                f"labelCol must hold class ids in [0, C); got minimum "
+                f"{y.min()} (re-encode e.g. {{-1,1}} labels to {{0,1}})")
+        n_classes = int(y.max()) + 1
+        if n_classes < 2:
+            n_classes = 2
+        onehot = np.eye(n_classes, dtype=np.float32)[y]
+
+        reg = float(self.getOrDefault("regParam"))
+        rng = jax.random.PRNGKey(self.getOrDefault("seed"))
+        params = {
+            "W": (jax.random.normal(rng, (X.shape[1], n_classes),
+                                    jnp.float32) * 0.01),
+            "b": jnp.zeros((n_classes,), jnp.float32),
+        }
+        tx = optax.adam(float(self.getOrDefault("learningRate")))
+        opt_state = tx.init(params)
+
+        Xd, yd = jnp.asarray(X), jnp.asarray(onehot)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                logits = Xd @ p["W"] + p["b"]
+                ce = optax.softmax_cross_entropy(logits, yd).mean()
+                return ce + reg * jnp.sum(p["W"] ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        history = []
+        for _ in range(self.getOrDefault("maxIter")):
+            params, opt_state, loss = step(params, opt_state)
+            history.append(float(loss))
+
+        return LogisticRegressionModel(
+            np.asarray(params["W"]), np.asarray(params["b"]),
+            featuresCol=feat,
+            predictionCol=self.getOrDefault("predictionCol"),
+            objectiveHistory=history)

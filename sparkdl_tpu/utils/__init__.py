@@ -1,0 +1,14 @@
+"""Cross-cutting utilities (profiling, observability).
+
+The reference had no tracing/metrics of its own (SURVEY §5: it
+inherited the Spark UI and nothing else); these exist because the
+north-star throughput claim needs to be provable.
+"""
+
+from sparkdl_tpu.utils.profiling import (
+    StageMetrics,
+    trace,
+    throughput_report,
+)
+
+__all__ = ["trace", "StageMetrics", "throughput_report"]

@@ -1,0 +1,103 @@
+"""Profiling and per-stage throughput metrics.
+
+SURVEY §5 ("tracing/profiling: absent in the reference — add
+jax.profiler trace + per-stage images/sec counters, needed to prove the
+north-star number"). Two tools:
+
+* :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable device trace (XLA ops, infeed gaps, HBM);
+* :class:`StageMetrics` — cumulative wall-time/row counters per plan
+  stage, collected by the engine when attached, so a pipeline run can
+  report where its time went (decode vs resize vs device apply).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False
+          ) -> Iterator[None]:
+    """Capture a device/host profiler trace for the enclosed block into
+    ``log_dir`` (view with TensorBoard's profile plugin)."""
+    import jax.profiler
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class _StageStat:
+    seconds: float = 0.0
+    calls: int = 0
+    rows: int = 0
+
+
+@dataclass
+class StageMetrics:
+    """Thread-safe per-stage counters. Attach to a
+    :class:`~sparkdl_tpu.data.engine.LocalEngine` via
+    ``LocalEngine(stage_metrics=...)`` (or set ``engine.stage_metrics``)
+    and run any DataFrame materialization."""
+
+    _stats: Dict[str, _StageStat] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def add(self, stage_name: str, seconds: float, rows: int):
+        with self._lock:
+            st = self._stats.setdefault(stage_name, _StageStat())
+            st.seconds += seconds
+            st.calls += 1
+            st.rows += rows
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "seconds": st.seconds,
+                    "calls": st.calls,
+                    "rows": st.rows,
+                    "rows_per_second": (st.rows / st.seconds
+                                        if st.seconds else 0.0),
+                }
+                for name, st in self._stats.items()
+            }
+
+    def report(self) -> str:
+        """Human-readable table, slowest stage first."""
+        rows = sorted(self.as_dict().items(),
+                      key=lambda kv: -kv[1]["seconds"])
+        if not rows:
+            return "(no stages recorded)"
+        width = max(len(n) for n, _ in rows)
+        lines = [f"{'stage'.ljust(width)}  seconds  calls    rows   rows/s"]
+        for name, st in rows:
+            lines.append(
+                f"{name.ljust(width)}  {st['seconds']:7.3f}  "
+                f"{st['calls']:5d}  {st['rows']:6d}  "
+                f"{st['rows_per_second']:7.0f}")
+        return "\n".join(lines)
+
+
+def throughput_report(stage_metrics: Optional[StageMetrics] = None,
+                      runner_metrics=None) -> str:
+    """Combined engine-stage + device-runner report."""
+    parts = []
+    if stage_metrics is not None:
+        parts.append(stage_metrics.report())
+    if runner_metrics is not None:
+        parts.append(
+            f"device: {runner_metrics.rows} rows in "
+            f"{runner_metrics.seconds:.3f}s = "
+            f"{runner_metrics.rows_per_second:.0f} rows/s "
+            f"({runner_metrics.batches} batches)")
+    return "\n".join(parts) if parts else "(no metrics)"

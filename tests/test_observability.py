@@ -1,0 +1,199 @@
+"""StageMetrics / profiling + LogisticRegression (transfer-learning
+pipeline parity: the reference's headline flow was DeepImageFeaturizer →
+MLlib LogisticRegression, upstream README)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.data.engine import LocalEngine
+from sparkdl_tpu.estimators import (
+    ClassificationEvaluator,
+    LogisticRegression,
+)
+from sparkdl_tpu.params.pipeline import Pipeline
+from sparkdl_tpu.utils import StageMetrics, throughput_report
+
+
+class TestStageMetrics:
+    def test_engine_records_stage_timings(self):
+        sm = StageMetrics()
+        engine = LocalEngine(num_workers=2, stage_metrics=sm)
+        df = DataFrame.from_pylist(
+            [{"x": float(i)} for i in range(20)], num_partitions=4,
+            engine=engine)
+
+        def double(batch):
+            import pyarrow as pa
+            return batch.set_column(
+                0, "x", pa.array([v * 2 for v in
+                                  batch.column(0).to_pylist()]))
+
+        df.map_batches(double, name="double").collect()
+        stats = sm.as_dict()
+        assert "double" in stats
+        assert stats["double"]["calls"] == 4
+        assert stats["double"]["rows"] == 20
+        assert stats["double"]["seconds"] >= 0
+        assert "double" in sm.report()
+
+    def test_retried_partition_not_double_counted(self):
+        """Stage timings flush only when a partition succeeds, so
+        retries don't inflate totals (regression)."""
+        import threading
+        import pyarrow as pa
+        from sparkdl_tpu.data.frame import Source, Stage
+        sm = StageMetrics()
+        engine = LocalEngine(num_workers=1, max_retries=2,
+                             stage_metrics=sm)
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def ok_stage(batch):
+            return batch
+
+        def flaky_stage(batch):
+            with lock:
+                state["n"] += 1
+                if state["n"] == 1:
+                    raise IOError("blip")
+            return batch
+
+        src = Source(lambda: pa.RecordBatch.from_pydict(
+            {"x": pa.array([1, 2, 3])}), 3)
+        list(engine.execute([src], [Stage(ok_stage, name="ok"),
+                                    Stage(flaky_stage, name="flaky")]))
+        stats = sm.as_dict()
+        assert stats["ok"]["rows"] == 3      # counted once, not twice
+        assert stats["ok"]["calls"] == 1
+
+    def test_no_metrics_attached_is_fine(self):
+        engine = LocalEngine(num_workers=1)
+        df = DataFrame.from_pylist([{"x": 1.0}], engine=engine)
+        assert df.map_batches(lambda b: b).count() == 1
+
+    def test_throughput_report(self):
+        from sparkdl_tpu.runtime.runner import RunnerMetrics
+        sm = StageMetrics()
+        sm.add("decode", 1.0, 100)
+        rm = RunnerMetrics()
+        rm.add(100, 2, 0.5)
+        rep = throughput_report(sm, rm)
+        assert "decode" in rep and "device:" in rep
+        assert throughput_report() == "(no metrics)"
+
+
+class TestLogisticRegression:
+    def _df(self, n=120, d=5, seed=0):
+        rng = np.random.default_rng(seed)
+        import pyarrow as pa
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        # two gaussian blobs, linearly separable-ish
+        y = rng.integers(0, 2, n)
+        X = rng.normal(0, 1, (n, d)).astype(np.float32) + 3.0 * y[:, None]
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": int(v)} for v in y])
+        batch = append_tensor_column(batch, "features", X)
+        return DataFrame.from_batches([batch]), X, y
+
+    def test_fit_learns_separable_blobs(self):
+        df, X, y = self._df()
+        lr = LogisticRegression(featuresCol="features", labelCol="label",
+                                maxIter=200, learningRate=0.2)
+        model = lr.fit(df)
+        assert model.numClasses == 2
+        assert model.objectiveHistory[-1] < model.objectiveHistory[0]
+        probs = model.transform(df).tensor("prediction")
+        acc = np.mean(probs.argmax(-1) == y)
+        assert acc >= 0.95
+        assert np.allclose(probs.sum(-1), 1.0, atol=1e-5)
+
+    def test_regularization_shrinks_weights(self):
+        df, _, _ = self._df()
+        free = LogisticRegression(maxIter=150).fit(df)
+        reg = LogisticRegression(maxIter=150, regParam=0.5).fit(df)
+        assert (np.linalg.norm(reg.coefficients)
+                < np.linalg.norm(free.coefficients))
+
+    def test_negative_labels_rejected(self):
+        """{-1, 1} labels must error, not silently wrap through np.eye
+        fancy-indexing (regression)."""
+        import pyarrow as pa
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": -1}, {"label": 1}])
+        batch = append_tensor_column(
+            batch, "features", np.zeros((2, 3), np.float32))
+        df = DataFrame.from_batches([batch])
+        with pytest.raises(ValueError, match="re-encode"):
+            LogisticRegression().fit(df)
+
+    def test_fit_materializes_plan_once(self):
+        """LR._fit must run the upstream plan once, not once per column
+        read (regression: tensor() + select().collect() doubled the
+        featurization cost)."""
+        runs = {"n": 0}
+        df, X, y = self._df(n=8)
+
+        def counting(batch):
+            runs["n"] += 1
+            return batch
+
+        counted = df.map_batches(counting, name="count")
+        LogisticRegression(maxIter=2).fit(counted)
+        assert runs["n"] == counted.num_partitions
+
+    def test_bad_labels_rejected(self):
+        import pyarrow as pa
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": 0.5}, {"label": 1.0}])
+        batch = append_tensor_column(
+            batch, "features", np.zeros((2, 3), np.float32))
+        df = DataFrame.from_batches([batch])
+        with pytest.raises(ValueError, match="integer class ids"):
+            LogisticRegression().fit(df)
+
+    def test_empty_dataset_rejected(self):
+        import pyarrow as pa
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        batch = pa.RecordBatch.from_pylist([{"label": 0}])
+        batch = append_tensor_column(
+            batch, "features", np.zeros((1, 3), np.float32))
+        df = DataFrame.from_batches([batch]).filter_rows(
+            np.zeros(1, dtype=bool))
+        with pytest.raises(ValueError, match="empty"):
+            LogisticRegression().fit(df)
+
+
+class TestTransferLearningPipeline:
+    def test_featurizer_plus_logreg(self, image_dir):
+        """The reference's README headline: readImages →
+        DeepImageFeaturizer → LogisticRegression, as one Pipeline."""
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.transformers import DeepImageFeaturizer
+
+        df = imageIO.readImages(image_dir, numPartitions=2)
+        n = df.count()
+        labels = np.arange(n) % 2
+
+        # attach labels by row order
+        table = df.collect()
+        import pyarrow as pa
+        table = table.append_column("label",
+                                    pa.array(labels, type=pa.int64()))
+        labeled = DataFrame.from_table(table, num_partitions=2)
+
+        pipe = Pipeline(stages=[
+            DeepImageFeaturizer(modelName="TestNet", inputCol="image",
+                                outputCol="features"),
+            LogisticRegression(featuresCol="features", labelCol="label",
+                               maxIter=60, learningRate=0.2),
+        ])
+        model = pipe.fit(labeled)
+        out = model.transform(labeled)
+        probs = out.tensor("prediction")
+        assert probs.shape == (n, 2)
+        ev = ClassificationEvaluator(predictionCol="prediction",
+                                     labelCol="label")
+        assert 0.0 <= ev.evaluate(out) <= 1.0
