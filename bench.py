@@ -27,12 +27,12 @@ def main() -> None:
     import jax
 
     from sparkdl_tpu.models.zoo import getModelFunction
-    from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+    from sparkdl_tpu.runtime.runner import BatchRunner
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     batch_size = 256 if on_tpu else 16
-    n_rows = batch_size * (16 if on_tpu else 2)
+    n_rows = batch_size * (8 if on_tpu else 2)
 
     rng = np.random.default_rng(0)
     images = rng.integers(0, 255, size=(n_rows, 299, 299, 3),
@@ -44,14 +44,18 @@ def main() -> None:
     # Warmup: compile + one full pass so caches/transfers are steady.
     runner.run({"image": images[: batch_size * 2]})
 
-    metrics = RunnerMetrics()
-    runner.metrics = metrics
-    t0 = time.perf_counter()
-    out = runner.run({"image": images})
-    elapsed = time.perf_counter() - t0
-    assert out["features"].shape == (n_rows, 2048), out["features"].shape
-
-    ips = n_rows / elapsed
+    # Median of 3 passes: host->device link throughput varies several-x
+    # between minutes in shared environments; the median is robust to
+    # one contended pass without overstating sustained throughput.
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = runner.run({"image": images})
+        elapsed = time.perf_counter() - t0
+        assert out["features"].shape == (n_rows, 2048), \
+            out["features"].shape
+        rates.append(n_rows / elapsed)
+    ips = float(np.median(rates))
     print(json.dumps({
         "metric": f"images_per_sec_per_chip_inceptionv3_featurize[{platform}]",
         "value": round(ips, 1),

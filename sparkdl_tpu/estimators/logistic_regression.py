@@ -21,7 +21,17 @@ from sparkdl_tpu.params.shared import HasLabelCol
 
 
 class LogisticRegressionModel(Model):
-    """Fitted coefficients; transform appends softmax probabilities."""
+    """Fitted coefficients; transform appends softmax probabilities.
+
+    ``featuresCol``/``predictionCol`` are real Params so transform-time
+    overrides (``model.transform(df, {"predictionCol": ...})``) apply.
+    """
+
+    featuresCol = Param("LogisticRegressionModel", "featuresCol",
+                        "features vector column", TypeConverters.toString)
+    predictionCol = Param("LogisticRegressionModel", "predictionCol",
+                          "output probability-vector column",
+                          TypeConverters.toString)
 
     def __init__(self, coefficients: np.ndarray, intercept: np.ndarray,
                  featuresCol: str, predictionCol: str,
@@ -29,8 +39,7 @@ class LogisticRegressionModel(Model):
         super().__init__()
         self.coefficients = np.asarray(coefficients)   # [D, C]
         self.intercept = np.asarray(intercept)         # [C]
-        self.featuresCol = featuresCol
-        self.predictionCol = predictionCol
+        self._set(featuresCol=featuresCol, predictionCol=predictionCol)
         self.objectiveHistory = objectiveHistory or []
 
     @property
@@ -45,7 +54,8 @@ class LogisticRegressionModel(Model):
             arrow_to_tensor,
         )
         W, b = self.coefficients, self.intercept
-        feat, out = self.featuresCol, self.predictionCol
+        feat = self.getOrDefault("featuresCol")
+        out = self.getOrDefault("predictionCol")
 
         def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
             idx = column_index(batch, feat)
@@ -61,9 +71,10 @@ class LogisticRegressionModel(Model):
         return dataset.map_batches(apply, name=f"logreg({feat})")
 
     def copy(self, extra: Optional[dict] = None):
-        that = LogisticRegressionModel(
-            self.coefficients, self.intercept, self.featuresCol,
-            self.predictionCol, list(self.objectiveHistory))
+        that = super().copy(extra)  # applies extra to the Param slots
+        that.coefficients = self.coefficients
+        that.intercept = self.intercept
+        that.objectiveHistory = list(self.objectiveHistory)
         return that
 
 

@@ -108,6 +108,16 @@ class TestLogisticRegression:
         assert acc >= 0.95
         assert np.allclose(probs.sum(-1), 1.0, atol=1e-5)
 
+    def test_transform_time_param_override(self):
+        """model.transform(df, {param: value}) must honor the override
+        (regression: copy() dropped the extra map)."""
+        df, X, y = self._df(n=16)
+        model = LogisticRegression(maxIter=5).fit(df)
+        out = model.transform(df, {"predictionCol": "p2"})
+        assert "p2" in out.columns
+        # and the original model is unchanged
+        assert model.getOrDefault("predictionCol") == "prediction"
+
     def test_regularization_shrinks_weights(self):
         df, _, _ = self._df()
         free = LogisticRegression(maxIter=150).fit(df)
