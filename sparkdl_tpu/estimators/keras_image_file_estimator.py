@@ -43,11 +43,12 @@ from sparkdl_tpu.params import (
     HasLabelCol,
     HasOutputCol,
     HasOutputMode,
+    HasUseMesh,
     keyword_only,
 )
 from sparkdl_tpu.params.base import Param, TypeConverters
 from sparkdl_tpu.params.pipeline import Estimator, Model
-from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.runtime.runner import RunnerMetrics
 
 _LOADED_COL = "__sparkdl_tpu_loaded__"
 
@@ -102,7 +103,7 @@ def _resolve_optimizer(opt, fit_params: dict):
 # ---------------------------------------------------------------------------
 
 class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
-                          HasBatchSize, CanLoadImage):
+                          HasBatchSize, HasUseMesh, CanLoadImage):
     """Fitted model: trained weights wrapped as a ModelFunction.
 
     Plays the role of the ``KerasImageFileTransformer`` the reference
@@ -113,12 +114,12 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
 
     def __init__(self, model_fn: ModelFunction, *, inputCol, outputCol,
                  imageLoader, outputMode="vector", batchSize=64,
-                 history: Optional[List[float]] = None):
+                 useMesh=False, history: Optional[List[float]] = None):
         super().__init__()
-        self._setDefault(outputMode="vector", batchSize=64)
+        self._setDefault(outputMode="vector", batchSize=64, useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   imageLoader=imageLoader, outputMode=outputMode,
-                  batchSize=batchSize)
+                  batchSize=batchSize, useMesh=useMesh)
         self.modelFunction = model_fn
         self.history = history or []  # per-epoch mean training loss
         self.metrics = RunnerMetrics()
@@ -132,7 +133,10 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
         in_name, out_name = tfr_utils.single_io(mf)
         out_col = self.getOutputCol()
         mode = self.getOutputMode()
-        runner = BatchRunner(mf, self.getBatchSize(), metrics=self.metrics)
+        from sparkdl_tpu.transformers.utils import make_runner
+        runner = make_runner(mf, self.getBatchSize(),
+                             use_mesh=self.getUseMesh(),
+                             metrics=self.metrics)
         loaded = self.loadImagesInternal(dataset, self.getInputCol(),
                                          _LOADED_COL)
 
@@ -319,7 +323,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return KerasImageFileModel(
             mf, inputCol=est.getInputCol(), outputCol=est.getOutputCol(),
             imageLoader=est.getImageLoader(), outputMode=est.getOutputMode(),
-            batchSize=est.getBatchSize(), history=history)
+            batchSize=est.getBatchSize(),
+            useMesh=est.getOrDefault("useMesh"), history=history)
 
     def _compile_step(self, step, batch_size: int):
         """jit the train step — against the mesh (batch split over the

@@ -65,8 +65,10 @@ class ModelFunction:
         self.backend = backend
         self.name = name
         self._jit_cache: Dict[Any, Callable] = {}
-        self._device_params = None      # device copy of params, cached
-        self._device_params_host = None  # the host object it came from
+        # device copies of params keyed by placement; each entry keeps
+        # the host object it was built from so reassigning .params
+        # invalidates it
+        self._params_cache: Dict[Any, Tuple[Any, Any]] = {}
 
     # -- construction -------------------------------------------------------
 
@@ -185,6 +187,13 @@ class ModelFunction:
 
     # -- execution ----------------------------------------------------------
 
+    def _cached_device_params(self, key, put: Callable):
+        entry = self._params_cache.get(key)
+        if entry is None or entry[0] is not self.params:
+            entry = (self.params, put(self.params))
+            self._params_cache[key] = entry
+        return entry[1]
+
     def device_params(self):
         """``params`` resident on the default device, transferred once
         and cached — passing the host pytree to every jitted call would
@@ -193,10 +202,34 @@ class ModelFunction:
         invalidates it."""
         if self.backend != "jax" or self.params is None:
             return self.params
-        if self._device_params_host is not self.params:
-            self._device_params = jax.device_put(self.params)
-            self._device_params_host = self.params
-        return self._device_params
+        return self._cached_device_params("default", jax.device_put)
+
+    def replicated_params(self, mesh):
+        """``params`` replicated to every device of ``mesh``, cached per
+        mesh (the sharded-inference analogue of :meth:`device_params`)."""
+        if self.backend != "jax" or self.params is None:
+            return self.params
+        from jax.sharding import NamedSharding, PartitionSpec
+        sharding = NamedSharding(mesh, PartitionSpec())
+        return self._cached_device_params(
+            ("replicated", mesh), lambda p: jax.device_put(p, sharding))
+
+    def sharded_jitted(self, mesh) -> Callable:
+        """Jit compiled against ``mesh``: params replicated, every named
+        input/output batch-sharded over the ``data`` axis (cached per
+        mesh, like :meth:`jitted`)."""
+        if self.backend != "jax":
+            raise ValueError(f"cannot jit backend '{self.backend}'")
+        key = ("sharded", mesh)
+        if key not in self._jit_cache:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(mesh, PartitionSpec())
+            dat = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+            self._jit_cache[key] = jax.jit(
+                self.apply_fn,
+                in_shardings=(rep, {k: dat for k in self.input_names}),
+                out_shardings=dat)
+        return self._jit_cache[key]
 
     def jitted(self, donate_inputs: bool = False) -> Callable:
         """Jit-compiled ``(params, inputs) -> outputs`` (cached)."""

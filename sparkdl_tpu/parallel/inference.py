@@ -21,12 +21,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from sparkdl_tpu.graph.function import ModelFunction
-from sparkdl_tpu.parallel.mesh import (
-    DATA_AXIS,
-    data_sharding,
-    make_mesh,
-    replicated,
-)
+from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from sparkdl_tpu.runtime.runner import (
     MAX_INFLIGHT_BATCHES,
     RunnerMetrics,
@@ -54,18 +49,13 @@ class ShardedBatchRunner:
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         self.model_fn = model_fn
-        self.mesh = mesh or make_mesh()
+        # default: THIS process's devices — a global mesh over
+        # non-addressable devices can't consume host-local numpy batches
+        self.mesh = mesh if mesh is not None else make_mesh(
+            devices=jax.local_devices())
         self.batch_size = batch_size
         self.metrics = metrics or RunnerMetrics()
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
-
-        in_shard = data_sharding(self.mesh)
-        self._params = jax.device_put(model_fn.params, replicated(self.mesh))
-        self._fn = jax.jit(
-            model_fn.apply_fn,
-            in_shardings=(replicated(self.mesh),
-                          {k: in_shard for k in model_fn.input_names}),
-            out_shardings=in_shard)
 
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]};
@@ -74,13 +64,19 @@ class ShardedBatchRunner:
         if n == 0:
             return empty_jax_outputs(self.model_fn)
 
+        # compile + replicate lazily, cached on the ModelFunction so
+        # multiple runners over the same model share one program and one
+        # device copy of the weights
+        fn = self.model_fn.sharded_jitted(self.mesh)
+        params = self.model_fn.replicated_params(self.mesh)
+
         t0 = time.perf_counter()
         gb = self._global_batch
         pending: collections.deque = collections.deque()
         outs: Dict[str, List[np.ndarray]] = {}
         batches = 0
         for valid, chunk in iter_padded_chunks(inputs, n, gb):
-            pending.append((valid, self._fn(self._params, chunk)))
+            pending.append((valid, fn(params, chunk)))
             batches += 1
             drain_bounded(pending, outs, MAX_INFLIGHT_BATCHES)
         drain_bounded(pending, outs, 0)

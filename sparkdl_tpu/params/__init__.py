@@ -31,6 +31,7 @@ from sparkdl_tpu.params.tuning import (  # noqa: F401
 )
 from sparkdl_tpu.params.shared import (  # noqa: F401
     HasBatchSize,
+    HasUseMesh,
     HasInputCol,
     HasInputMapping,
     HasKerasLoss,
@@ -64,6 +65,7 @@ __all__ = [
     "HasLabelCol",
     "HasOutputMode",
     "HasBatchSize",
+    "HasUseMesh",
     "HasKerasModel",
     "HasKerasOptimizer",
     "HasKerasLoss",

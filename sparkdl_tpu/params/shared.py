@@ -82,6 +82,24 @@ class HasBatchSize(Params):
         return self.getOrDefault("batchSize")
 
 
+class HasUseMesh(Params):
+    """Run the device stage data-parallel over this host's local mesh
+    (batch split over the ``data`` axis, params replicated) instead of
+    single-device — the pipeline-surface switch for SURVEY §2.4's core
+    DP-inference strategy. Runner selection lives in
+    ``transformers/utils.py::make_runner``."""
+
+    useMesh = Param("HasUseMesh", "useMesh",
+                    "shard device batches over all local chips",
+                    TypeConverters.toBoolean)
+
+    def setUseMesh(self, value: bool):
+        return self._set(useMesh=value)
+
+    def getUseMesh(self) -> bool:
+        return self.getOrDefault("useMesh")
+
+
 class HasKerasModel(Params):
     """Path to a user Keras model file (.h5 / .keras), loaded with the JAX
     backend (reference ``HasKerasModel.modelFile`` + ``kerasFitParams``)."""

@@ -21,27 +21,29 @@ from sparkdl_tpu.params import (
     HasModelFunction,
     HasOutputCol,
     HasOutputMode,
+    HasUseMesh,
     Transformer,
     keyword_only,
 )
-from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.runtime.runner import RunnerMetrics
 from sparkdl_tpu.transformers import utils as tfr_utils
 
 _PACKED_COL = "__sparkdl_tpu_packed__"
 
 
 class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
-                       HasModelFunction, HasOutputMode, HasBatchSize):
+                       HasModelFunction, HasOutputMode, HasBatchSize,
+                       HasUseMesh):
     """Applies a single-input ModelFunction to an image struct column."""
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFunction=None,
-                 outputMode="vector", batchSize=64):
+                 outputMode="vector", batchSize=64, useMesh=False):
         super().__init__()
-        self._setDefault(outputMode="vector", batchSize=64)
+        self._setDefault(outputMode="vector", batchSize=64, useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelFunction=modelFunction, outputMode=outputMode,
-                  batchSize=batchSize)
+                  batchSize=batchSize, useMesh=useMesh)
         self.metrics = RunnerMetrics()
 
     def _input_hwc(self):
@@ -60,8 +62,9 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
         in_col = self.getInputCol()
         out_col = self.getOutputCol()
         mode = self.getOutputMode()
-        runner = BatchRunner(mf, self.getBatchSize(),
-                             metrics=self.metrics)
+        runner = tfr_utils.make_runner(mf, self.getBatchSize(),
+                                       use_mesh=self.getUseMesh(),
+                                       metrics=self.metrics)
 
         def pack(batch: pa.RecordBatch) -> pa.RecordBatch:
             from sparkdl_tpu.data.frame import column_index

@@ -22,10 +22,11 @@ from sparkdl_tpu.params import (
     HasKerasModel,
     HasOutputCol,
     HasOutputMode,
+    HasUseMesh,
     Transformer,
     keyword_only,
 )
-from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.runtime.runner import RunnerMetrics
 from sparkdl_tpu.transformers import utils as tfr_utils
 
 _LOADED_COL = "__sparkdl_tpu_loaded__"
@@ -33,15 +34,17 @@ _LOADED_COL = "__sparkdl_tpu_loaded__"
 
 class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                                 HasKerasModel, HasOutputMode, HasBatchSize,
-                                CanLoadImage):
+                                HasUseMesh, CanLoadImage):
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
-                 imageLoader=None, outputMode="vector", batchSize=64):
+                 imageLoader=None, outputMode="vector", batchSize=64,
+                 useMesh=False):
         super().__init__()
-        self._setDefault(outputMode="vector", batchSize=64)
+        self._setDefault(outputMode="vector", batchSize=64, useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelFile=modelFile, imageLoader=imageLoader,
-                  outputMode=outputMode, batchSize=batchSize)
+                  outputMode=outputMode, batchSize=batchSize,
+                  useMesh=useMesh)
         self.metrics = RunnerMetrics()
 
     def _transform(self, dataset):
@@ -50,7 +53,9 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         in_name, out_name = tfr_utils.single_io(mf)
         out_col = self.getOutputCol()
         mode = self.getOutputMode()
-        runner = BatchRunner(mf, self.getBatchSize(), metrics=self.metrics)
+        runner = tfr_utils.make_runner(mf, self.getBatchSize(),
+                                       use_mesh=self.getUseMesh(),
+                                       metrics=self.metrics)
 
         loaded = self.loadImagesInternal(dataset, self.getInputCol(),
                                          _LOADED_COL)

@@ -18,6 +18,7 @@ from sparkdl_tpu.params import (
     HasBatchSize,
     HasInputCol,
     HasOutputCol,
+    HasUseMesh,
     Param,
     Transformer,
     TypeConverters,
@@ -39,18 +40,19 @@ class _HasModelName(Transformer):
 
 
 class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
-                          HasBatchSize):
+                          HasBatchSize, HasUseMesh):
     """Image column → penultimate-layer feature vector of a named model,
     for transfer learning (reference ``DeepImageFeaturizer``; its output
     feeds e.g. a logistic regression)."""
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
-                 batchSize=64):
+                 batchSize=64, useMesh=False):
         super().__init__()
-        self._setDefault(batchSize=64)
+        self._setDefault(batchSize=64, useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
-                  modelName=modelName, batchSize=batchSize)
+                  modelName=modelName, batchSize=batchSize,
+                  useMesh=useMesh)
         self.metrics = None
 
     def _transform(self, dataset):
@@ -59,13 +61,13 @@ class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
         inner = ImageTransformer(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), useMesh=self.getUseMesh())
         self.metrics = inner.metrics
         return inner.transform(dataset)
 
 
 class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
-                         HasBatchSize):
+                         HasBatchSize, HasUseMesh):
     """Image column → class scores of a named model; optionally decoded
     to top-K (class, description, score) rows (reference
     ``DeepImagePredictor`` params ``decodePredictions``, ``topK``)."""
@@ -79,12 +81,14 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
-                 decodePredictions=False, topK=5, batchSize=64):
+                 decodePredictions=False, topK=5, batchSize=64,
+                 useMesh=False):
         super().__init__()
-        self._setDefault(decodePredictions=False, topK=5, batchSize=64)
+        self._setDefault(decodePredictions=False, topK=5, batchSize=64,
+                         useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelName=modelName, decodePredictions=decodePredictions,
-                  topK=topK, batchSize=batchSize)
+                  topK=topK, batchSize=batchSize, useMesh=useMesh)
         self.metrics = None
 
     def _transform(self, dataset):
@@ -96,7 +100,7 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
         inner = ImageTransformer(
             inputCol=self.getInputCol(), outputCol=raw_col,
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), useMesh=self.getUseMesh())
         self.metrics = inner.metrics
         result = inner.transform(dataset)
         if not decode:

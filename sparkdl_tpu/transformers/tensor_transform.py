@@ -19,21 +19,23 @@ from sparkdl_tpu.params import (
     HasInputMapping,
     HasModelFunction,
     HasOutputMapping,
+    HasUseMesh,
     Transformer,
     keyword_only,
 )
-from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+from sparkdl_tpu.runtime.runner import RunnerMetrics
 
 
 class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
-                        HasOutputMapping, HasBatchSize):
+                        HasOutputMapping, HasBatchSize, HasUseMesh):
     @keyword_only
     def __init__(self, *, modelFunction=None, inputMapping=None,
-                 outputMapping=None, batchSize=64):
+                 outputMapping=None, batchSize=64, useMesh=False):
         super().__init__()
-        self._setDefault(batchSize=64)
+        self._setDefault(batchSize=64, useMesh=False)
         self._set(modelFunction=modelFunction, inputMapping=inputMapping,
-                  outputMapping=outputMapping, batchSize=batchSize)
+                  outputMapping=outputMapping, batchSize=batchSize,
+                  useMesh=useMesh)
         self.metrics = RunnerMetrics()
 
     def _validate(self):
@@ -57,7 +59,10 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
 
     def _transform(self, dataset):
         mf, in_map, out_map = self._validate()
-        runner = BatchRunner(mf, self.getBatchSize(), metrics=self.metrics)
+        from sparkdl_tpu.transformers.utils import make_runner
+        runner = make_runner(mf, self.getBatchSize(),
+                             use_mesh=self.getUseMesh(),
+                             metrics=self.metrics)
         sig = mf.input_signature
 
         def apply(batch: pa.RecordBatch) -> pa.RecordBatch:

@@ -90,6 +90,35 @@ def appendModelOutput(batch: pa.RecordBatch, out_col: str,
     return append_tensor_column(batch, out_col, flat)
 
 
+def make_runner(model_fn, batch_size: int, use_mesh: bool = False,
+                metrics=None):
+    """Select the batch runner: ``ShardedBatchRunner`` over this host's
+    local devices when ``use_mesh`` (per-chip ``batch_size``), else the
+    single-device ``BatchRunner``. Warns when ``use_mesh`` is requested
+    but unusable (host-backend model or a single local device) rather
+    than silently degrading."""
+    from sparkdl_tpu.runtime.runner import BatchRunner
+
+    if use_mesh:
+        import jax
+        if model_fn.backend != "jax":
+            import logging
+            logging.getLogger(__name__).warning(
+                "useMesh requested for host-backend model %r; running "
+                "single-process on CPU instead (TF-era models can't be "
+                "retargeted to the mesh)", model_fn.name)
+        elif len(jax.local_devices()) > 1:
+            from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+            return ShardedBatchRunner(model_fn, batch_size=batch_size,
+                                      metrics=metrics)
+        else:
+            import logging
+            logging.getLogger(__name__).warning(
+                "useMesh requested but only one local device is "
+                "visible; running single-device")
+    return BatchRunner(model_fn, batch_size, metrics=metrics)
+
+
 def single_io(model_fn) -> Tuple[str, str]:
     """Validate single-input/single-output and return (in_name, out_name)."""
     ins = model_fn.input_names

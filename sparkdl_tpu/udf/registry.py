@@ -38,13 +38,15 @@ class ModelUDF:
     """
 
     def __init__(self, name: str, model_fn: ModelFunction,
-                 kind: str = "tensor", batch_size: int = 64):
+                 kind: str = "tensor", batch_size: int = 64,
+                 use_mesh: bool = False):
         if kind not in ("image", "tensor"):
             raise ValueError(f"kind must be 'image' or 'tensor', got {kind!r}")
         self.name = name
         self.model_fn = model_fn
         self.kind = kind
         self.batch_size = batch_size
+        self.use_mesh = use_mesh
 
     def apply(self, dataset, inputCol: str, outputCol: str,
               outputMode: str = "vector", batchSize: Optional[int] = None):
@@ -55,7 +57,8 @@ class ModelUDF:
                 ImageTransformer)
             t = ImageTransformer(inputCol=inputCol, outputCol=outputCol,
                                  modelFunction=self.model_fn,
-                                 outputMode=outputMode, batchSize=bs)
+                                 outputMode=outputMode, batchSize=bs,
+                                 useMesh=self.use_mesh)
         else:
             from sparkdl_tpu.transformers.tensor_transform import (
                 TensorTransformer)
@@ -64,14 +67,15 @@ class ModelUDF:
             t = TensorTransformer(modelFunction=self.model_fn,
                                   inputMapping={inputCol: in_name},
                                   outputMapping={out_name: outputCol},
-                                  batchSize=bs)
+                                  batchSize=bs, useMesh=self.use_mesh)
         return t.transform(dataset)
 
     def __call__(self, inputs):
         """Direct batched call on host arrays (single-input models take a
         bare ndarray; multi-input take ``{name: ndarray}``)."""
-        from sparkdl_tpu.runtime.runner import BatchRunner
-        runner = BatchRunner(self.model_fn, self.batch_size)
+        from sparkdl_tpu.transformers.utils import make_runner
+        runner = make_runner(self.model_fn, self.batch_size,
+                             use_mesh=self.use_mesh)
         if not isinstance(inputs, dict):
             (in_name,) = self.model_fn.input_names
             shape, dtype = self.model_fn.input_signature[in_name]
@@ -104,12 +108,14 @@ def registerUDF(udf: ModelUDF, replace: bool = False) -> ModelUDF:
 
 def makeModelUDF(model_fn: ModelFunction, udf_name: str,
                  kind: str = "tensor", batch_size: int = 64,
+                 use_mesh: bool = False,
                  register: bool = True, replace: bool = False) -> ModelUDF:
     """Wrap + (optionally) register a ModelFunction as a named UDF —
     signature shape mirrors the reference's ``makeGraphUDF(graph,
     udf_name, fetches, ..., register)``; fetches/feeds maps are subsumed
     by the ModelFunction's named IO."""
-    udf = ModelUDF(udf_name, model_fn, kind=kind, batch_size=batch_size)
+    udf = ModelUDF(udf_name, model_fn, kind=kind, batch_size=batch_size,
+                   use_mesh=use_mesh)
     if register:
         registerUDF(udf, replace=replace)
     return udf

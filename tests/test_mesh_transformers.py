@@ -1,0 +1,110 @@
+"""useMesh pipeline-surface tests (multi-chip DP inference through the
+transformers; tests run on the 8 simulated CPU devices) and the Spark
+binding seam."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.data.frame import Stage
+from sparkdl_tpu.data.spark_binding import (
+    SparkEngine,
+    plan_to_map_in_arrow,
+)
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+from sparkdl_tpu.runtime.runner import BatchRunner
+from sparkdl_tpu.transformers import (
+    DeepImageFeaturizer,
+    ImageTransformer,
+    TensorTransformer,
+)
+
+
+@pytest.fixture(scope="module")
+def image_df(tmp_path_factory):
+    from PIL import Image
+    rng = np.random.default_rng(21)
+    d = tmp_path_factory.mktemp("meshimgs")
+    for i in range(7):
+        arr = rng.integers(0, 255, (20, 24, 3), dtype=np.uint8)
+        Image.fromarray(arr, "RGB").save(d / f"m{i}.png")
+    return imageIO.readImages(str(d), numPartitions=2)
+
+
+class TestUseMesh:
+    def test_featurizer_mesh_matches_single_device(self, image_df):
+        single = DeepImageFeaturizer(modelName="TestNet", inputCol="image",
+                                     outputCol="f", batchSize=2)
+        sharded = DeepImageFeaturizer(modelName="TestNet", inputCol="image",
+                                      outputCol="f", batchSize=2,
+                                      useMesh=True)
+        a = single.transform(image_df).tensor("f")
+        b = sharded.transform(image_df).tensor("f")
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_tensor_transformer_mesh(self):
+        mf = ModelFunction.fromSingle(
+            lambda x: x * 3.0, None, input_shape=(4,), name="triple")
+        rows = [{"x": [float(i)] * 4} for i in range(10)]
+        df = DataFrame.from_pylist(rows, num_partitions=2)
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"x": "input"},
+                              outputMapping={"output": "y"},
+                              batchSize=2, useMesh=True)
+        got = t.transform(df).tensor("y")
+        np.testing.assert_allclose(got[:, 0], np.arange(10) * 3.0,
+                                   rtol=1e-6)
+
+    def test_make_runner_selects_sharded(self):
+        from sparkdl_tpu.transformers.utils import make_runner
+        mf = ModelFunction.fromSingle(lambda x: x, None, input_shape=(2,))
+        assert isinstance(make_runner(mf, 4, use_mesh=True),
+                          ShardedBatchRunner)
+        assert isinstance(make_runner(mf, 4, use_mesh=False), BatchRunner)
+
+    def test_host_backend_falls_back_with_warning(self, caplog):
+        import logging
+        from sparkdl_tpu.transformers.utils import make_runner
+        mf = ModelFunction(lambda p, i: i, None, {"x": ((2,), np.float32)},
+                           output_names=["x"], backend="host")
+        with caplog.at_level(logging.WARNING):
+            r = make_runner(mf, 4, use_mesh=True)
+        assert isinstance(r, BatchRunner)
+        assert any("useMesh" in rec.message for rec in caplog.records)
+
+    def test_sharded_program_cached_across_runners(self):
+        """Two sharded runners over one model share the compiled program
+        and the replicated weights (regression: per-runner re-jit and
+        re-transfer)."""
+        mf = ModelFunction.fromSingle(lambda x: x + 1.0, None,
+                                      input_shape=(2,))
+        r1 = ShardedBatchRunner(mf, batch_size=2)
+        r2 = ShardedBatchRunner(mf, batch_size=4)
+        x = np.zeros((8, 2), np.float32)
+        r1.run({"input": x})
+        r2.run({"input": x})
+        assert r1.mesh == r2.mesh
+        assert mf.sharded_jitted(r1.mesh) is mf.sharded_jitted(r2.mesh)
+
+
+class TestSparkBinding:
+    def test_plan_compiles_and_applies_without_spark(self):
+        """plan_to_map_in_arrow is pure: it must run the stage chain
+        over an Arrow batch iterator with no pyspark present."""
+        def add_one(batch):
+            vals = [v + 1 for v in batch.column(0).to_pylist()]
+            return pa.RecordBatch.from_pydict({"x": pa.array(vals)})
+
+        fn = plan_to_map_in_arrow([Stage(add_one, name="inc"),
+                                   Stage(add_one, name="inc2")])
+        batches = [pa.RecordBatch.from_pydict({"x": pa.array([1, 2])}),
+                   pa.RecordBatch.from_pydict({"x": pa.array([10])})]
+        out = list(fn(iter(batches)))
+        assert [b.column(0).to_pylist() for b in out] == [[3, 4], [12]]
+
+    def test_spark_engine_requires_pyspark(self):
+        with pytest.raises(RuntimeError, match="pyspark"):
+            SparkEngine()
