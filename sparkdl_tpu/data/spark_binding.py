@@ -44,8 +44,11 @@ def plan_to_map_in_arrow(plan: Sequence) -> Callable[
         fn = plan_to_map_in_arrow(df_tpu._plan)
         out = spark_df.mapInArrow(fn, schema=arrow_schema_ddl)
 
-    Device stages are serialized per executor process by the runner's
-    own locking; host stages run inline on the Spark task thread.
+    All stages run inline on the Spark task's Python worker. Executors
+    that own an exclusive accelerator (TPU) must run ONE task at a time
+    (``spark.task.cpus`` = executor cores, the standard accelerator
+    config) — concurrent Python workers would each try to initialize
+    the same device.
     """
     stages = list(plan)
 
@@ -78,14 +81,14 @@ class SparkEngine:
 
     def execute(self, sources: Sequence, plan: Sequence
                 ) -> Iterator[pa.RecordBatch]:
-        import pickle
-
         apply_plan = plan_to_map_in_arrow(plan)
         sc = self.spark.sparkContext
-        payload = [pickle.dumps(s.load) for s in sources]
+        # Ship the load callables in the task closure — Spark serializes
+        # tasks with cloudpickle, which handles the local closures every
+        # Source in this codebase uses (stdlib pickle does not).
+        loads = [s.load for s in sources]
 
-        def run_partition(blob: bytes) -> bytes:
-            load = pickle.loads(blob)
+        def run_partition(load) -> bytes:
             out = list(apply_plan(iter([load()])))
             sink = pa.BufferOutputStream()
             with pa.ipc.new_stream(sink, out[0].schema) as w:
@@ -93,7 +96,7 @@ class SparkEngine:
                     w.write_batch(b)
             return sink.getvalue().to_pybytes()
 
-        results = sc.parallelize(payload, len(payload)) \
+        results = sc.parallelize(loads, len(loads)) \
             .map(run_partition).collect()
         for raw in results:
             with pa.ipc.open_stream(pa.BufferReader(raw)) as r:

@@ -190,6 +190,12 @@ class ModelFunction:
     def _cached_device_params(self, key, put: Callable):
         entry = self._params_cache.get(key)
         if entry is None or entry[0] is not self.params:
+            # params changed: purge EVERY stale placement, not just this
+            # key — dead replicated copies would otherwise hold HBM on
+            # all devices for the ModelFunction's lifetime
+            self._params_cache = {
+                k: v for k, v in self._params_cache.items()
+                if v[0] is self.params}
             entry = (self.params, put(self.params))
             self._params_cache[key] = entry
         return entry[1]
@@ -209,22 +215,23 @@ class ModelFunction:
         mesh (the sharded-inference analogue of :meth:`device_params`)."""
         if self.backend != "jax" or self.params is None:
             return self.params
-        from jax.sharding import NamedSharding, PartitionSpec
-        sharding = NamedSharding(mesh, PartitionSpec())
+        from sparkdl_tpu.parallel.mesh import replicated
+        sharding = replicated(mesh)
         return self._cached_device_params(
             ("replicated", mesh), lambda p: jax.device_put(p, sharding))
 
     def sharded_jitted(self, mesh) -> Callable:
         """Jit compiled against ``mesh``: params replicated, every named
-        input/output batch-sharded over the ``data`` axis (cached per
-        mesh, like :meth:`jitted`)."""
+        input/output batch-sharded over the ``data`` axis — the same
+        axis name ShardedBatchRunner sizes its global batches by
+        (cached per mesh, like :meth:`jitted`)."""
         if self.backend != "jax":
             raise ValueError(f"cannot jit backend '{self.backend}'")
         key = ("sharded", mesh)
         if key not in self._jit_cache:
-            from jax.sharding import NamedSharding, PartitionSpec
-            rep = NamedSharding(mesh, PartitionSpec())
-            dat = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+            from sparkdl_tpu.parallel.mesh import data_sharding, replicated
+            rep = replicated(mesh)
+            dat = data_sharding(mesh)
             self._jit_cache[key] = jax.jit(
                 self.apply_fn,
                 in_shardings=(rep, {k: dat for k in self.input_names}),

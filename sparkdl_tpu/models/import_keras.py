@@ -1,0 +1,312 @@
+"""Import Keras-applications weights into the Flax zoo.
+
+The reference shipped pretrained graphs per model name (Scala
+``ModelFetcher`` downloading frozen ``.pb``s; Python side loaded
+``keras.applications`` weights). This build's zoo re-implements the
+architectures in Flax, so pretrained weights arrive by CONVERSION: build
+the matching ``keras.applications`` model (with its ImageNet weights,
+wherever the user obtained them), walk both models in execution order,
+and copy kernels/stats across.
+
+Mechanism: a flax ``intercept_methods`` hook records every
+``nn.Conv``/``nn.Dense``/``nn.BatchNorm`` call path during a traced
+``init`` — the module's true execution order — while the Keras side
+walks ``model.layers`` (creation order, which for the applications'
+functional graphs equals execution order). The two sequences are paired
+per kind and copied with shape validation. Because pairing is by order,
+this doubles as an architecture-fidelity oracle: if our Flax model
+diverged from Keras anywhere, shapes stop lining up and the import
+fails loudly (and the conversion tests compare outputs numerically).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+
+def flax_layer_order(module, input_shape: Tuple[int, ...],
+                     ) -> List[Tuple[Tuple[str, ...], str]]:
+    """Execution-ordered ``(path, kind)`` for every Conv/Dense/BatchNorm
+    call in ``module``; kind ∈ {"conv", "dense", "bn"}."""
+    records: List[Tuple[Tuple[str, ...], str]] = []
+    seen = set()
+
+    def interceptor(next_fn, args, kwargs, context):
+        m = context.module
+        kind = None
+        if isinstance(m, nn.Conv):
+            kind = "conv"
+        elif isinstance(m, nn.Dense):
+            kind = "dense"
+        elif isinstance(m, nn.BatchNorm):
+            kind = "bn"
+        if kind is not None:
+            path = tuple(m.path)
+            if path not in seen:
+                seen.add(path)
+                records.append((path, kind))
+        return next_fn(*args, **kwargs)
+
+    x = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+    with nn.intercept_methods(interceptor):
+        jax.eval_shape(lambda: module.init(jax.random.PRNGKey(0), x))
+    return records
+
+
+def _collect(model) -> Dict[str, Any]:
+    """name → (layer, kind) for weight-bearing layers."""
+    import keras
+    out = {}
+    for layer in model.layers:
+        if isinstance(layer, keras.layers.SeparableConv2D):
+            out[layer.name] = (layer, "sepconv")
+        elif isinstance(layer, keras.layers.Conv2D):
+            out[layer.name] = (layer, "conv")
+        elif isinstance(layer, keras.layers.Dense):
+            out[layer.name] = (layer, "dense")
+        elif isinstance(layer, keras.layers.BatchNormalization):
+            out[layer.name] = (layer, "bn")
+    return out
+
+
+def _counter_key(name: str) -> int:
+    """Auto-name counter: "conv2d" → 0, "conv2d_7" → 7."""
+    tail = name.rsplit("_", 1)[-1]
+    return int(tail) if tail.isdigit() else 0
+
+
+def _resnet_key(name: str):
+    """Creation order of keras-apps ResNet names: conv1 first, then
+    (stage, block, branch) — branch 0 (shortcut) is created first in
+    each projecting block, matching the Flax Bottleneck."""
+    if name.startswith("conv1_"):
+        return (0, 0, 0)
+    m = __import__("re").fullmatch(
+        r"conv(\d+)_block(\d+)_(\d+)_(?:conv|bn)", name)
+    if not m:
+        raise ValueError(f"unrecognized resnet layer name {name!r}")
+    return tuple(int(g) for g in m.groups())
+
+
+def keras_layer_order(model) -> List[Tuple[Any, str]]:
+    """``(layer, kind)`` for weight-bearing layers in CREATION order —
+    which equals the Flax modules' execution order.
+
+    ``model.layers`` is depth-sorted (BFS), NOT creation-sorted, so each
+    architecture family needs its ordering recovered from names:
+    auto-named models (InceptionV3) via the per-class name counter,
+    explicitly-named models (VGG/ResNet) via their structured names,
+    Xception via its documented block layout.
+    """
+    layers = _collect(model)
+    names = list(layers)
+
+    if any(n.startswith("block1_sepconv") or n.startswith("block2_sepconv")
+           for n in names) and any(n.startswith("conv2d") for n in names):
+        ordered = _xception_name_order(names)
+    elif any(__import__("re").fullmatch(r"conv\d+_block\d+_\d+_(conv|bn)",
+                                        n) for n in names):
+        def key(n):
+            if n == "predictions":
+                return (99, 0, 0)
+            return _resnet_key(n)
+        ordered = sorted(names, key=key)
+    elif all(_is_auto_name(n) or n == "predictions" for n in names):
+        # auto-named (InceptionV3): counter per class prefix
+        ordered = sorted(names, key=lambda n: (0 if n != "predictions"
+                                               else 1, _counter_key(n)))
+    else:
+        # explicit sequential names (VGG: block{i}_conv{j}, fc1, fc2)
+        ordered = sorted(names)
+    return [layers[n] for n in ordered]
+
+
+def _is_auto_name(name: str) -> bool:
+    base = name.rsplit("_", 1)[0] if name.rsplit("_", 1)[-1].isdigit() \
+        else name
+    return base in ("conv2d", "batch_normalization", "dense",
+                    "separable_conv2d")
+
+
+def _xception_name_order(names: List[str]) -> List[str]:
+    """Creation order of keras-apps Xception weight layers. Shortcut
+    convs are auto-named conv2d/_1/_2/_3 (+ matching auto-named BNs) and
+    are created BEFORE their block's sepconvs, exactly like the Flax
+    modules."""
+    order = ["block1_conv1", "block1_conv1_bn",
+             "block1_conv2", "block1_conv2_bn"]
+    auto_conv = sorted([n for n in names if _is_auto_name(n)
+                        and n.startswith("conv2d")], key=_counter_key)
+    auto_bn = sorted([n for n in names if _is_auto_name(n)
+                      and n.startswith("batch_normalization")],
+                     key=_counter_key)
+    shortcut = list(zip(auto_conv, auto_bn))
+    for i, block in enumerate((2, 3, 4)):
+        order += list(shortcut[i])
+        for j in (1, 2):
+            order += [f"block{block}_sepconv{j}",
+                      f"block{block}_sepconv{j}_bn"]
+    for block in range(5, 13):
+        for j in (1, 2, 3):
+            order += [f"block{block}_sepconv{j}",
+                      f"block{block}_sepconv{j}_bn"]
+    order += list(shortcut[3])
+    for block, js in ((13, (1, 2)), (14, (1, 2))):
+        for j in js:
+            order += [f"block{block}_sepconv{j}",
+                      f"block{block}_sepconv{j}_bn"]
+    order.append("predictions")
+    missing = set(order) - set(names)
+    extra = set(names) - set(order)
+    if missing or extra:
+        raise ValueError(
+            f"xception layout mismatch: missing {sorted(missing)[:4]}, "
+            f"unexpected {sorted(extra)[:4]}")
+    return order
+
+
+def _set(flat: Dict, path: Tuple[str, ...], name: str, value: np.ndarray):
+    key = path + (name,)
+    if key not in flat:
+        raise KeyError(f"no flax param at {'/'.join(key)}")
+    have = tuple(flat[key].shape)
+    if have != tuple(value.shape):
+        raise ValueError(
+            f"shape mismatch at {'/'.join(key)}: flax {have} vs keras "
+            f"{tuple(value.shape)} — architectures out of sync")
+    flat[key] = jnp.asarray(value, dtype=flat[key].dtype)
+
+
+def import_keras_weights(module, keras_model,
+                         input_shape: Tuple[int, ...]) -> Dict[str, Any]:
+    """Convert ``keras_model``'s weights into variables for ``module``
+    (``{"params": ..., "batch_stats": ...}``), pairing layers by
+    execution order per kind and validating every shape."""
+    variables = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1,) + tuple(input_shape),
+                                      jnp.float32)))
+    variables = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), variables)
+    flat_params = flatten_dict(variables["params"])
+    flat_stats = (flatten_dict(variables["batch_stats"])
+                  if "batch_stats" in variables else {})
+
+    forder = flax_layer_order(module, input_shape)
+    korder = keras_layer_order(keras_model)
+
+    fq = {"conv": [p for p, k in forder if k == "conv"],
+          "dense": [p for p, k in forder if k == "dense"],
+          "bn": [p for p, k in forder if k == "bn"]}
+    # expand keras SeparableConv2D into (depthwise, pointwise) kernel
+    # entries — the Flax side is two nn.Conv calls
+    kconv: List[Tuple[Any, str]] = []
+    for layer, kind in korder:
+        if kind == "sepconv":
+            kconv += [(layer, "dw"), (layer, "pw")]
+        elif kind == "conv":
+            kconv.append((layer, "full"))
+    kq = {"conv": kconv,
+          "dense": [l for l, k in korder if k == "dense"],
+          "bn": [l for l, k in korder if k == "bn"]}
+    for kind in ("conv", "dense", "bn"):
+        if len(fq[kind]) != len(kq[kind]):
+            raise ValueError(
+                f"{kind} count mismatch: flax has {len(fq[kind])}, keras "
+                f"has {len(kq[kind])} — architectures out of sync")
+
+    for path, (layer, part) in zip(fq["conv"], kq["conv"]):
+        weights = layer.get_weights()
+        if part == "dw":
+            # keras depthwise kernel (h, w, in, mult) → flax grouped-conv
+            # kernel (h, w, 1, in) for mult == 1
+            dw = weights[0]
+            if dw.shape[-1] != 1:
+                raise ValueError(
+                    f"depth multiplier {dw.shape[-1]} != 1 unsupported")
+            _set(flat_params, path, "kernel",
+                 np.transpose(dw, (0, 1, 3, 2)))
+            continue
+        if part == "pw":
+            _set(flat_params, path, "kernel", weights[1])
+            continue
+        _set(flat_params, path, "kernel", weights[0])
+        if getattr(layer, "use_bias", False):
+            _set(flat_params, path, "bias", weights[1])
+
+    for path, layer in zip(fq["dense"], kq["dense"]):
+        weights = layer.get_weights()
+        _set(flat_params, path, "kernel", weights[0])
+        if getattr(layer, "use_bias", True):
+            _set(flat_params, path, "bias", weights[1])
+
+    for path, layer in zip(fq["bn"], kq["bn"]):
+        gamma = beta = mean = var = None
+        idx = 0
+        weights = layer.get_weights()
+        if layer.scale:
+            gamma = weights[idx]; idx += 1
+        if layer.center:
+            beta = weights[idx]; idx += 1
+        mean, var = weights[idx], weights[idx + 1]
+        if gamma is None:
+            gamma = np.ones_like(mean)
+        if beta is None:
+            beta = np.zeros_like(mean)
+        _set(flat_params, path, "scale", gamma)
+        _set(flat_params, path, "bias", beta)
+        _set(flat_stats, path, "mean", mean)
+        _set(flat_stats, path, "var", var)
+
+    out = {"params": unflatten_dict(flat_params)}
+    if flat_stats:
+        out["batch_stats"] = unflatten_dict(flat_stats)
+    return out
+
+
+_KERAS_BUILDERS = {
+    "InceptionV3": ("inception_v3", "InceptionV3"),
+    "Xception": ("xception", "Xception"),
+    "ResNet50": ("resnet50", "ResNet50"),
+    "VGG16": ("vgg16", "VGG16"),
+    "VGG19": ("vgg19", "VGG19"),
+}
+
+
+def import_named_model(name: str, keras_model=None,
+                       weights: Optional[str] = "imagenet",
+                       fetcher=None) -> Dict[str, Any]:
+    """Convert a named zoo model's Keras-applications weights and store
+    them in the :class:`~sparkdl_tpu.models.fetcher.ModelFetcher` cache
+    so ``zoo.getModelFunction(name)`` picks them up.
+
+    ``keras_model`` overrides the auto-built ``keras.applications``
+    model (e.g. one loaded from a local ``.h5``); ``weights`` is passed
+    through to the keras builder otherwise.
+    """
+    from sparkdl_tpu.models.fetcher import ModelFetcher
+    from sparkdl_tpu.models.zoo import getKerasApplicationModel
+
+    spec = getKerasApplicationModel(name)
+    if name not in _KERAS_BUILDERS:
+        raise ValueError(
+            f"no keras.applications counterpart for {name!r}")
+    if keras_model is None:
+        import importlib
+        mod_name, cls_name = _KERAS_BUILDERS[name]
+        mod = importlib.import_module(f"keras.applications.{mod_name}")
+        keras_model = getattr(mod, cls_name)(weights=weights)
+
+    module = spec.module_fn()
+    variables = import_keras_weights(
+        module, keras_model, (spec.height, spec.width, 3))
+
+    fetcher = fetcher or ModelFetcher()
+    fetcher.put(f"{name}.msgpack", variables)
+    return variables

@@ -24,15 +24,17 @@ class ConvBN(nn.Module):
     strides: Tuple[int, int] = (1, 1)
     padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
     relu: bool = True
+    bn_epsilon: float = 1e-3   # keras-apps default; ResNet uses 1.001e-5
+    use_bias: bool = False     # keras-apps ResNet convs carry biases
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                    padding=self.padding, use_bias=False,
+                    padding=self.padding, use_bias=self.use_bias,
                     dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-3, dtype=jnp.float32,
+                         epsilon=self.bn_epsilon, dtype=jnp.float32,
                          param_dtype=jnp.float32)(x)
         x = x.astype(self.dtype)
         if self.relu:

@@ -13,7 +13,13 @@ from typing import Any, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from sparkdl_tpu.models.layers import ConvBN, global_avg_pool, max_pool
+import functools
+
+from sparkdl_tpu.models.layers import ConvBN as _ConvBN, global_avg_pool, max_pool
+
+# keras-apps ResNet: BN epsilon 1.001e-5 and biased convs
+# (resnet.py in keras.applications)
+ConvBN = functools.partial(_ConvBN, bn_epsilon=1.001e-5, use_bias=True)
 
 
 class Bottleneck(nn.Module):

@@ -83,3 +83,21 @@ class TestBatchRunner:
 
         mf.params = {"scale": np.float32(5.0)}
         np.testing.assert_allclose(r.run({"input": x})["output"], 5.0)
+
+    def test_params_cache_purges_all_placements(self):
+        """Reassigning .params purges every cached placement, not just
+        the next-accessed key (regression: dead replicated copies held
+        device memory)."""
+        from sparkdl_tpu.parallel.mesh import make_mesh
+        mf = ModelFunction.fromSingle(
+            lambda p, x: x * p["s"], {"s": np.float32(2.0)},
+            input_shape=(2,))
+        mesh = make_mesh()
+        mf.device_params()
+        mf.replicated_params(mesh)
+        assert len(mf._params_cache) == 2
+        mf.params = {"s": np.float32(3.0)}
+        mf.device_params()   # triggers purge of the stale replicated copy
+        assert len(mf._params_cache) == 1
+        np.testing.assert_allclose(
+            np.asarray(mf.replicated_params(mesh)["s"]), 3.0)
