@@ -1,0 +1,78 @@
+"""Parallel hyperparameter search with CrossValidator.
+
+Reference: ``KerasImageFileEstimator`` + ``pyspark.ml.tuning
+.CrossValidator`` (one Spark task per ParamMap); here trials run
+concurrently on the estimator's thread pool, each a jax/optax train
+loop, optionally data-parallel over the device mesh.
+
+Run:  KERAS_BACKEND=jax python examples/hyperparameter_search.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+from PIL import Image
+
+import sparkdl_tpu
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.estimators import ClassificationEvaluator
+
+
+def main():
+    import keras
+    keras.utils.set_random_seed(0)
+    size = 16
+
+    model = keras.Sequential([
+        keras.layers.Input((size, size, 3)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    model_file = os.path.join(tempfile.mkdtemp(), "model.keras")
+    model.save(model_file)
+
+    d = tempfile.mkdtemp(prefix="sparkdl_tpu_hpo_")
+    rng = np.random.default_rng(2)
+    rows = []
+    for i in range(24):
+        label = i % 2
+        base = 60 if label == 0 else 190
+        arr = np.clip(rng.normal(base, 25, (size, size, 3)), 0,
+                      255).astype(np.uint8)
+        p = os.path.join(d, f"h{i}.png")
+        Image.fromarray(arr, "RGB").save(p)
+        rows.append({"uri": p, "label": label})
+    df = DataFrame.from_pylist(rows, num_partitions=3)
+
+    def loader(uri):
+        return np.asarray(Image.open(uri).convert("RGB"),
+                          np.float32) / 255.0
+
+    est = sparkdl_tpu.KerasImageFileEstimator(
+        inputCol="uri", outputCol="prediction", labelCol="label",
+        modelFile=model_file, imageLoader=loader,
+        kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+        parallelism=2)
+
+    grid = (sparkdl_tpu.ParamGridBuilder()
+            .addGrid(est.getParam("kerasFitParams"), [
+                {"epochs": 2, "batch_size": 8, "learning_rate": 1e-3},
+                {"epochs": 8, "batch_size": 8, "learning_rate": 1e-2},
+            ])
+            .build())
+
+    cv = sparkdl_tpu.CrossValidator(
+        estimator=est, estimatorParamMaps=grid,
+        evaluator=ClassificationEvaluator(predictionCol="prediction",
+                                          labelCol="label"),
+        numFolds=2)
+    cv_model = cv.fit(df)
+    print("fold-averaged accuracies per config:",
+          [round(m, 3) for m in cv_model.avgMetrics])
+    preds = cv_model.transform(df).tensor("prediction")
+    print("best model prediction matrix:", preds.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Expose a Keras model as a named batch UDF.
+
+Reference: ``registerKerasImageUDF`` +
+``spark.sql("SELECT my_udf(image) FROM images")``.
+
+Run:  KERAS_BACKEND=jax python examples/keras_udf.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+from PIL import Image
+
+import sparkdl_tpu
+from sparkdl_tpu.udf import callUDF
+
+
+def main():
+    import keras
+    keras.utils.set_random_seed(0)
+
+    # a user model (normally loaded from .h5/.keras)
+    model = keras.Sequential([
+        keras.layers.Input((32, 32, 3)),
+        keras.layers.Conv2D(8, 3, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+
+    d = tempfile.mkdtemp(prefix="sparkdl_tpu_udf_")
+    rng = np.random.default_rng(1)
+    for i in range(6):
+        Image.fromarray(
+            rng.integers(0, 255, (48, 40, 3), dtype=np.uint8),
+            "RGB").save(os.path.join(d, f"u{i}.png"))
+
+    sparkdl_tpu.registerKerasImageUDF(
+        "my_model_udf", model, preprocessor=lambda x: x / 255.0)
+
+    df = sparkdl_tpu.readImages(d, numPartitions=2)
+    out = callUDF("my_model_udf", df, "image", "probs")
+    probs = out.tensor("probs")
+    print("UDF output:", probs.shape, "row sums:",
+          np.round(probs.sum(-1), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -192,13 +192,18 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     useMesh = Param("KerasImageFileEstimator", "useMesh",
                     "jit train steps data-parallel over the device mesh",
                     TypeConverters.toBoolean)
+    checkpointDir = Param(
+        "KerasImageFileEstimator", "checkpointDir",
+        "orbax checkpoint directory: training state saves per epoch and "
+        "an interrupted fit resumes from the last epoch (the reference "
+        "restarted from scratch, SURVEY §5)", TypeConverters.toString)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, labelCol=None,
                  modelFile=None, imageLoader=None, kerasOptimizer="adam",
                  kerasLoss="categorical_crossentropy", kerasFitParams=None,
                  outputMode="vector", batchSize=64, parallelism=2,
-                 useMesh=True):
+                 useMesh=True, checkpointDir=None):
         super().__init__()
         self._setDefault(kerasOptimizer="adam",
                          kerasLoss="categorical_crossentropy",
@@ -210,7 +215,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                   kerasOptimizer=kerasOptimizer, kerasLoss=kerasLoss,
                   kerasFitParams=kerasFitParams, outputMode=outputMode,
                   batchSize=batchSize, parallelism=parallelism,
-                  useMesh=useMesh)
+                  useMesh=useMesh, checkpointDir=checkpointDir)
 
     # -- validation (reference _validateParams) -----------------------------
 
@@ -244,10 +249,33 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
     # -- one trial ----------------------------------------------------------
 
-    def _trainOne(self, X: np.ndarray, y: np.ndarray, paramMap: dict
-                  ) -> KerasImageFileModel:
+    @staticmethod
+    def _trial_fingerprint(est, X: np.ndarray, y: np.ndarray) -> str:
+        """Checkpoint identity for one trial: hyperparameters AND data.
+        Resume must only ever continue a run with the same config on the
+        same (X, y) — CrossValidator folds and different param maps get
+        distinct fingerprints, so they can never adopt each other's
+        weights."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(repr(sorted(est.getKerasFitParams().items())).encode())
+        h.update(repr(est.getKerasLoss()).encode())
+        h.update(repr(est.getOrDefault("kerasOptimizer")).encode())
+        h.update(est.getModelFile().encode())
+        h.update(repr((X.shape, str(X.dtype))).encode())
+        h.update(np.ascontiguousarray(y).tobytes())
+        stride = max(1, len(X) // 16)
+        h.update(np.ascontiguousarray(X[::stride]).tobytes())
+        return h.hexdigest()[:16]
+
+    def _trainOne(self, X: np.ndarray, y: np.ndarray, paramMap: dict,
+                  checkpoint_tag: str = "fit") -> KerasImageFileModel:
         """Train one configuration with a pure jax/optax loop (the
-        reference ran ``model.fit`` on one machine per Spark task)."""
+        reference ran ``model.fit`` on one machine per Spark task).
+        With ``checkpointDir`` set, state saves each epoch (async) under
+        ``dir/<tag>_<fingerprint>`` and a re-run with the same config
+        and data resumes at the last saved epoch, producing the same
+        final model as an uninterrupted run."""
         import jax
         import jax.numpy as jnp
         import keras
@@ -298,7 +326,38 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         steps_per_epoch = max(1, math.ceil(n / batch_size))
         rng = np.random.default_rng(seed)
         history: List[float] = []
-        for _ in range(epochs):
+
+        checkpointer = None
+        start_epoch = 0
+        if est.isDefined("checkpointDir"):
+            import os as _os
+
+            from sparkdl_tpu.parallel.checkpoint import PytreeCheckpointer
+            trial_dir = _os.path.join(
+                est.getOrDefault("checkpointDir"),
+                f"{checkpoint_tag}_{self._trial_fingerprint(est, X, y)}")
+            checkpointer = PytreeCheckpointer(trial_dir)
+            # resume from the newest step still on disk that fits this
+            # run's epoch budget (older steps may have been pruned)
+            usable = [s for s in checkpointer.all_steps() if s <= epochs]
+            if usable:
+                start_epoch = max(usable)
+                template = {"trainable": trainable,
+                            "non_trainable": non_trainable,
+                            "opt_state": opt_state,
+                            "history": np.zeros(start_epoch, np.float64)}
+                restored = checkpointer.restore(template, step=start_epoch)
+                trainable = restored["trainable"]
+                non_trainable = restored["non_trainable"]
+                opt_state = restored["opt_state"]
+                history = [float(h) for h in restored["history"]]
+                # burn the skipped epochs' shuffles so a resumed run
+                # sees the same batch order as an uninterrupted one
+                for _ in range(start_epoch):
+                    if shuffle:
+                        rng.permutation(n)
+
+        for _ in range(start_epoch, epochs):
             order = rng.permutation(n) if shuffle else np.arange(n)
             # wrap indices so every step sees a full static-shape batch
             # (XLA: no dynamic shapes; a padded+masked tail costs more
@@ -314,6 +373,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     jnp.asarray(X[sel]), jnp.asarray(targets[sel]))
                 losses.append(loss)
             history.append(float(np.mean(jax.device_get(losses))))
+            if checkpointer is not None:
+                checkpointer.save(
+                    len(history),
+                    {"trainable": jax.device_get(trainable),
+                     "non_trainable": jax.device_get(non_trainable),
+                     "opt_state": jax.device_get(opt_state),
+                     "history": np.asarray(history, np.float64)})
+        if checkpointer is not None:
+            checkpointer.close()
 
         trained = {
             "trainable": jax.device_get(trainable),
@@ -409,19 +477,21 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         one-Spark-task-per-ParamMap)."""
         shared = self._getNumpyFeaturesAndLabels(dataset)
         parallelism = max(1, self.getOrDefault("parallelism"))
+
         if parallelism == 1 or len(paramMaps) <= 1:
             for i, pm in enumerate(paramMaps):
                 X, y = self._trialData(dataset, pm, shared)
-                yield i, self._trainOne(X, y, pm)
+                yield i, self._trainOne(X, y, pm,
+                                        checkpoint_tag=f"trial_{i}")
             return
 
-        def trial(pm):
+        def trial(i, pm):
             X, y = self._trialData(dataset, pm, shared)
-            return self._trainOne(X, y, pm)
+            return self._trainOne(X, y, pm, checkpoint_tag=f"trial_{i}")
 
         with ThreadPoolExecutor(max_workers=parallelism,
                                 thread_name_prefix="sparkdl-tpu-trial") as ex:
-            futs = {ex.submit(trial, pm): i
+            futs = {ex.submit(trial, i, pm): i
                     for i, pm in enumerate(paramMaps)}
             from concurrent.futures import as_completed
             for fut in as_completed(futs):

@@ -21,6 +21,7 @@ fails loudly (and the conversion tests compare outputs numerically).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import flax.linen as nn
@@ -87,7 +88,7 @@ def _resnet_key(name: str):
     each projecting block, matching the Flax Bottleneck."""
     if name.startswith("conv1_"):
         return (0, 0, 0)
-    m = __import__("re").fullmatch(
+    m = re.fullmatch(
         r"conv(\d+)_block(\d+)_(\d+)_(?:conv|bn)", name)
     if not m:
         raise ValueError(f"unrecognized resnet layer name {name!r}")
@@ -110,8 +111,8 @@ def keras_layer_order(model) -> List[Tuple[Any, str]]:
     if any(n.startswith("block1_sepconv") or n.startswith("block2_sepconv")
            for n in names) and any(n.startswith("conv2d") for n in names):
         ordered = _xception_name_order(names)
-    elif any(__import__("re").fullmatch(r"conv\d+_block\d+_\d+_(conv|bn)",
-                                        n) for n in names):
+    elif any(re.fullmatch(r"conv\d+_block\d+_\d+_(conv|bn)", n)
+             for n in names):
         def key(n):
             if n == "predictions":
                 return (99, 0, 0)

@@ -32,18 +32,52 @@ def _as_saveable(state: TrainState) -> dict:
     }
 
 
+class PytreeCheckpointer:
+    """One CheckpointManager held open across a training loop — per-step
+    saves are async (overlapping the next step's compute) and the
+    manager is torn down once at ``close()``/context exit."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep))
+
+    def save(self, step: int, tree: Any):
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        abstract = jax.tree.map(_abstract_leaf, template)
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def save_checkpoint(directory: str, state: TrainState, step: int,
                     keep: int = 3) -> str:
     """Save the state under ``directory/step_{step}``; prunes to the
     newest ``keep`` checkpoints. Returns the checkpoint path."""
-    directory = os.path.abspath(directory)
-    with ocp.CheckpointManager(
-            directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
-    ) as mgr:
-        mgr.save(step, args=ocp.args.StandardSave(_as_saveable(state)))
-        mgr.wait_until_finished()
-    return os.path.join(directory, str(step))
+    return save_pytree(directory, _as_saveable(state), step, keep)
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -66,21 +100,29 @@ def _abstract_leaf(leaf):
     return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
 
+def save_pytree(directory: str, tree: Any, step: int, keep: int = 3) -> str:
+    """One-shot save of an arbitrary array pytree under
+    ``directory/step_{step}`` (loops should hold a
+    :class:`PytreeCheckpointer` instead)."""
+    with PytreeCheckpointer(directory, keep=keep) as ck:
+        ck.save(step, tree)
+    return os.path.join(os.path.abspath(directory), str(step))
+
+
+def restore_pytree(directory: str, template: Any,
+                   step: Optional[int] = None) -> Any:
+    """Restore a pytree saved by :func:`save_pytree` into ``template``'s
+    structure/shapes. ``step=None`` → latest."""
+    with PytreeCheckpointer(directory) as ck:
+        return ck.restore(template, step)
+
+
 def restore_checkpoint(directory: str, state: TrainState,
                        step: Optional[int] = None) -> TrainState:
     """Restore into the structure of ``state`` (shapes/dtypes/shardings
     taken from it; pass a freshly-built state). ``step=None`` →
     latest."""
-    directory = os.path.abspath(directory)
-    template = jax.tree.map(_abstract_leaf, _as_saveable(state))
-    with ocp.CheckpointManager(directory) as mgr:
-        if step is None:
-            step = mgr.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found under {directory}")
-        restored = mgr.restore(
-            step, args=ocp.args.StandardRestore(template))
+    restored = restore_pytree(directory, _as_saveable(state), step)
     return state.replace(
         step=restored["step"],
         params=restored["params"],

@@ -131,6 +131,64 @@ class TestKerasImageFileEstimator:
         assert len(seen) == 20  # override decoded the trial's data
         assert got[0].getImageLoader() is tagged_loader
 
+    def test_checkpoint_resume_matches_uninterrupted(self, keras_cls_file,
+                                                     uri_label_df,
+                                                     tmp_path):
+        """A 2-epoch run + resumed 4-epoch run must equal one
+        uninterrupted 4-epoch run (weights and loss history)."""
+        fit = {"epochs": 4, "batch_size": 8, "learning_rate": 0.01,
+               "seed": 3}
+        full = make_estimator(keras_cls_file,
+                              kerasFitParams=fit).fit(uri_label_df)
+
+        ckpt = str(tmp_path / "ckpt")
+        part = dict(fit, epochs=2)
+        make_estimator(keras_cls_file, kerasFitParams=part,
+                       checkpointDir=ckpt).fit(uri_label_df)
+        resumed = make_estimator(keras_cls_file, kerasFitParams=fit,
+                                 checkpointDir=ckpt).fit(uri_label_df)
+
+        assert resumed.history == pytest.approx(full.history, rel=1e-5)
+        import jax
+        for a, b in zip(jax.tree.leaves(resumed.modelFunction.params),
+                        jax.tree.leaves(full.modelFunction.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_checkpoint_not_shared_across_data(self, keras_cls_file,
+                                               uri_label_df, tmp_path):
+        """Different data (e.g. CV folds) must never adopt each other's
+        checkpoints (regression: identity was only dir+trial index)."""
+        fit = {"epochs": 2, "batch_size": 8, "learning_rate": 0.01,
+               "seed": 3}
+        ckpt = str(tmp_path / "shared")
+        est = make_estimator(keras_cls_file, kerasFitParams=fit,
+                             checkpointDir=ckpt)
+        est.fit(uri_label_df)
+
+        half = uri_label_df.filter_rows(
+            np.arange(20) < 10)  # a "fold": different data
+        m = est.fit(half)
+        # must have actually trained 2 epochs on the fold, not resumed
+        # the full-data run's final state
+        assert len(m.history) == 2
+
+    def test_checkpoint_config_change_trains_fresh(self, keras_cls_file,
+                                                   uri_label_df, tmp_path):
+        """Changing the config (here: epochs) changes the fingerprint,
+        so the run trains fresh instead of restoring a state from a
+        different configuration (and can never hit a pruned step of the
+        old run — regression: min(last, epochs) restored a GC'd step)."""
+        ckpt = str(tmp_path / "prune")
+        base = {"batch_size": 8, "learning_rate": 0.01, "seed": 3}
+        make_estimator(keras_cls_file,
+                       kerasFitParams=dict(base, epochs=6),
+                       checkpointDir=ckpt).fit(uri_label_df)
+        m = make_estimator(keras_cls_file,
+                           kerasFitParams=dict(base, epochs=2),
+                           checkpointDir=ckpt).fit(uri_label_df)
+        assert len(m.history) == 2
+
     def test_missing_required_param_raises(self, keras_cls_file,
                                            uri_label_df):
         est = KerasImageFileEstimator(inputCol="uri", outputCol="p",
