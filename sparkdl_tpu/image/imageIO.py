@@ -114,6 +114,37 @@ def _decodeImage(imageData: bytes, origin: str = "") -> Optional[dict]:
     return imageArrayToStruct(arr, origin=origin)
 
 
+_JPEG_MAGIC = b"\xff\xd8\xff"
+
+
+def _decodeBatch(origins: Sequence[str],
+                 blobs: Sequence[bytes]) -> List[Optional[dict]]:
+    """Decode a partition's files: JPEGs in ONE native libjpeg call
+    (OpenMP over images, GIL released — the C++ infeed shim), everything
+    else (PNG etc.) and any native failure through PIL. Failures → None
+    (dropped or kept null by the caller, reference ``_decodeImage``
+    semantics)."""
+    structs: List[Optional[dict]] = [None] * len(blobs)
+    jpeg_idx = [i for i, b in enumerate(blobs)
+                if b[:3] == _JPEG_MAGIC]
+    decoded = None
+    if jpeg_idx:
+        try:
+            from sparkdl_tpu import native
+            decoded = native.decode_jpeg_batch(
+                [blobs[i] for i in jpeg_idx])
+        except Exception:  # any native failure → full PIL fallback
+            decoded = None
+    if decoded is not None:
+        for i, arr in zip(jpeg_idx, decoded):
+            if arr is not None:
+                structs[i] = imageArrayToStruct(arr, origin=origins[i])
+    for i in range(len(blobs)):
+        if structs[i] is None:   # non-JPEG, native-failed, or no native
+            structs[i] = _decodeImage(blobs[i], origin=origins[i])
+    return structs
+
+
 # ---------------------------------------------------------------------------
 # Arrow batch helpers
 # ---------------------------------------------------------------------------
@@ -286,7 +317,7 @@ def readImages(imageDirectory: str, numPartitions: int = 8,
     def _decode(batch: pa.RecordBatch) -> pa.RecordBatch:
         fp = batch.column(0).to_pylist()
         data = batch.column(1).to_pylist()
-        structs = [_decodeImage(d, origin=p) for p, d in zip(fp, data)]
+        structs = _decodeBatch(fp, data)
         out = pa.RecordBatch.from_pydict({
             "filePath": pa.array(fp, type=pa.string()),
             "image": pa.array(structs, type=imageType),
@@ -300,3 +331,70 @@ def readImages(imageDirectory: str, numPartitions: int = 8,
                 batch.column(batch.schema.get_field_index("image")))
         df = df.filter(_valid)
     return df
+
+
+def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
+                     nChannels: int = 3, numPartitions: int = 8,
+                     dropImageFailures: bool = True,
+                     engine=None) -> DataFrame:
+    """Infeed fast path: read images directly into a fixed-size uint8
+    tensor column ``image`` ([h, w, c] per row) — for pipelines that
+    feed one model size, this fuses decode → resize → NHWC pack into a
+    single native call per partition (C++ shim with libjpeg + OpenMP;
+    per-row PIL fallback for non-JPEGs or when the shim is absent).
+    Consume with ``TensorTransformer(inputMapping={"image": ...})`` or a
+    runner; ``readImages`` remains the general (original-size, image
+    struct) reader.
+    """
+    height, width = int(size[0]), int(size[1])
+    paths = listImageFiles(imageDirectory)
+    df = filesToDF(paths, numPartitions=numPartitions, engine=engine)
+
+    def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        fp = batch.column(0).to_pylist()
+        blobs = batch.column(1).to_pylist()
+        n = len(blobs)
+        out = np.zeros((n, height, width, nChannels), np.uint8)
+        ok = np.zeros(n, bool)
+
+        jpeg_idx = [i for i, b in enumerate(blobs)
+                    if b[:3] == _JPEG_MAGIC]
+        fused = None
+        if jpeg_idx:
+            try:
+                from sparkdl_tpu import native
+                fused = native.decode_resize_pack(
+                    [blobs[i] for i in jpeg_idx], height, width,
+                    nChannels)
+            except Exception:
+                fused = None
+        if fused is not None:
+            packed, okm = fused
+            for j, i in enumerate(jpeg_idx):
+                if okm[j]:
+                    out[i] = packed[j]
+                    ok[i] = True
+        for i in range(n):
+            if ok[i]:
+                continue
+            s = _decodeImage(blobs[i], origin=fp[i])
+            if s is None:
+                continue
+            arr = imageStructToArray(s)
+            out[i] = resizeImageArray(arr, height, width, nChannels)
+            ok[i] = True
+
+        res = pa.RecordBatch.from_pydict(
+            {"filePath": pa.array(fp, type=pa.string())})
+        res = append_tensor_column(res, "image", out)
+        if dropImageFailures:
+            res = res.filter(pa.array(ok))
+        else:
+            # a zeroed tensor row would look like real data; keep an
+            # explicit validity column instead
+            res = res.append_column("imageOk", pa.array(ok))
+        return res
+
+    return df.map_batches(_stage, name="decodeResizePack",
+                          row_preserving=not dropImageFailures)

@@ -35,22 +35,31 @@ _tried = False
 
 def _build() -> bool:
     # Compile to a temp path and rename into place: rename is atomic, so
-    # a concurrent process never dlopens a partially written .so.
+    # a concurrent process never dlopens a partially written .so. First
+    # try with libjpeg (wherever the toolchain's search paths find it);
+    # on failure retry without JPEG support rather than probing one
+    # hardcoded header location.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
-           _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception as e:  # missing g++, compile error, read-only dir...
-        logger.warning("native shim build failed (%s); using Python host "
-                       "path", e)
+    base = ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
+            _SRC, "-o", tmp]
+    attempts = [base[:1] + ["-DSDL_HAVE_JPEG"] + base[1:] + ["-ljpeg"],
+                base]
+    err = None
+    for cmd in attempts:
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, _LIB)
+            return True
+        except Exception as e:
+            err = e
+    logger.warning("native shim build failed (%s); using Python host "
+                   "path", err)
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -66,6 +75,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32,                                   # num_threads
     ]
     lib.sdl_version.restype = ctypes.c_int
+    # JPEG symbols are OPTIONAL: a binary-only .so from an older build
+    # may lack them — the resize path must keep working regardless.
+    try:
+        _pp = ctypes.POINTER(ctypes.c_void_p)
+        _pi64 = ctypes.POINTER(ctypes.c_int64)
+        _pi32 = ctypes.POINTER(ctypes.c_int32)
+        _pu8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.sdl_has_jpeg.restype = ctypes.c_int
+        lib.sdl_jpeg_batch_dims.restype = ctypes.c_int
+        lib.sdl_jpeg_batch_dims.argtypes = [
+            _pp, _pi64, ctypes.c_int64, _pi32, _pi32, _pi32,
+            ctypes.c_int32]
+        lib.sdl_jpeg_batch_decode.restype = ctypes.c_int
+        lib.sdl_jpeg_batch_decode.argtypes = [
+            _pp, _pi64, ctypes.c_int64, _pp, _pi32, _pi32, _pu8,
+            ctypes.c_int32]
+        lib.sdl_decode_resize_pack.restype = ctypes.c_int
+        lib.sdl_decode_resize_pack.argtypes = [
+            _pp, _pi64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, _pu8,
+            ctypes.c_int32]
+        lib._sdl_jpeg_bound = True
+    except AttributeError:
+        lib._sdl_jpeg_bound = False
     return lib
 
 
@@ -100,6 +133,97 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+# Matches PIL's decompression-bomb threshold order of magnitude: refuse
+# to trust a header claiming more pixels than this.
+MAX_DECODE_PIXELS = 100_000_000
+
+
+def has_jpeg() -> bool:
+    lib = get_lib()
+    return bool(lib and getattr(lib, "_sdl_jpeg_bound", False)
+                and lib.sdl_has_jpeg())
+
+
+def _blob_ptrs(blobs: Sequence[bytes]):
+    n = len(blobs)
+    ptrs = (ctypes.c_void_p * n)()
+    lens = np.empty(n, np.int64)
+    refs = []
+    for i, b in enumerate(blobs):
+        buf = np.frombuffer(b, np.uint8)
+        refs.append(buf)
+        ptrs[i] = buf.ctypes.data
+        lens[i] = len(b)
+    return ptrs, lens, refs
+
+
+def decode_jpeg_batch(blobs: Sequence[bytes]
+                      ) -> Optional[List[Optional[np.ndarray]]]:
+    """Decode COLOR JPEG byte blobs to RGB HWC uint8 arrays in one
+    native call (OpenMP over images, GIL released). Per-image failures —
+    parse errors, header dims over :data:`MAX_DECODE_PIXELS`, and
+    grayscale sources (left to the PIL path so the image struct's
+    nChannels stays identical with and without the shim) — come back as
+    None; returns None overall when the native path or libjpeg is
+    unavailable."""
+    if not has_jpeg():
+        return None
+    lib = get_lib()
+    n = len(blobs)
+    if n == 0:
+        return []
+    ptrs, lens, refs = _blob_ptrs(blobs)
+    hs = np.empty(n, np.int32)
+    ws = np.empty(n, np.int32)
+    cs = np.empty(n, np.int32)
+    lib.sdl_jpeg_batch_dims(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 0)
+    outs: List[Optional[np.ndarray]] = [None] * n
+    dsts = (ctypes.c_void_p * n)()
+    for i in range(n):
+        if (hs[i] > 0 and ws[i] > 0 and cs[i] == 3
+                and int(hs[i]) * int(ws[i]) <= MAX_DECODE_PIXELS):
+            arr = np.empty((hs[i], ws[i], 3), np.uint8)
+            dsts[i] = arr.ctypes.data
+            outs[i] = arr
+        else:
+            hs[i] = -1  # tell the decode pass to skip this row
+            dsts[i] = None
+    ok = np.zeros(n, np.uint8)
+    lib.sdl_jpeg_batch_decode(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        dsts, hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 0)
+    return [outs[i] if ok[i] else None for i in range(n)]
+
+
+def decode_resize_pack(blobs: Sequence[bytes], height: int, width: int,
+                       nChannels: int = 3, num_threads: int = 0
+                       ) -> Optional[tuple]:
+    """Fused infeed path: JPEG decode → bilinear resize → channel
+    convert → contiguous [N,H,W,C] uint8, one native call (the product
+    consumer is ``imageIO.readImagesPacked``). Returns
+    ``(batch, ok_mask)`` or None when unavailable."""
+    if not has_jpeg():
+        return None
+    lib = get_lib()
+    n = len(blobs)
+    out = np.zeros((n, height, width, nChannels), np.uint8)
+    ok = np.zeros(n, np.uint8)
+    if n == 0:
+        return out, ok.astype(bool)
+    ptrs, lens, refs = _blob_ptrs(blobs)
+    lib.sdl_decode_resize_pack(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data, height, width, nChannels,
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads)
+    return out, ok.astype(bool)
 
 
 def resize_pack_batch(images: Sequence[np.ndarray], height: int,

@@ -16,12 +16,18 @@
 // (java.awt) resize and PIL resize paths likewise disagreed per-pixel.
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <algorithm>
 #include <vector>
 
 #ifdef _OPENMP
 #include <omp.h>
+#endif
+
+#ifdef SDL_HAVE_JPEG
+#include <csetjmp>
+#include <jpeglib.h>
 #endif
 
 namespace {
@@ -139,9 +145,177 @@ int resize_one(const uint8_t* src, int h, int w, int c_in,
     return 0;
 }
 
+#ifdef SDL_HAVE_JPEG
+
+struct JpegErr {
+    jpeg_error_mgr mgr;
+    jmp_buf jump;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+    JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+    longjmp(err->jump, 1);
+}
+
+// Decode one JPEG to RGB into dst (h*w*3, dims from a prior header
+// parse). Returns 0 on success.
+int jpeg_decode_rgb(const uint8_t* data, size_t len, uint8_t* dst,
+                    int expect_h, int expect_w) {
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    if (setjmp(jerr.jump)) {
+        jpeg_destroy_decompress(&cinfo);
+        return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, len);
+    jpeg_read_header(&cinfo, TRUE);
+    cinfo.out_color_space = JCS_RGB;   // libjpeg converts gray/YCbCr
+    jpeg_start_decompress(&cinfo);
+    if (static_cast<int>(cinfo.output_height) != expect_h ||
+        static_cast<int>(cinfo.output_width) != expect_w ||
+        cinfo.output_components != 3) {
+        jpeg_abort_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        return 2;
+    }
+    while (cinfo.output_scanline < cinfo.output_height) {
+        JSAMPROW row = dst +
+            static_cast<size_t>(cinfo.output_scanline) * expect_w * 3;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+int jpeg_dims(const uint8_t* data, size_t len, int32_t* h, int32_t* w,
+              int32_t* src_components) {
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    if (setjmp(jerr.jump)) {
+        jpeg_destroy_decompress(&cinfo);
+        return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, len);
+    jpeg_read_header(&cinfo, TRUE);
+    jpeg_calc_output_dimensions(&cinfo);
+    *h = cinfo.output_height;
+    *w = cinfo.output_width;
+    if (src_components != nullptr)
+        *src_components = cinfo.num_components;
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+#endif  // SDL_HAVE_JPEG
+
 }  // namespace
 
 extern "C" {
+
+int sdl_has_jpeg() {
+#ifdef SDL_HAVE_JPEG
+    return 1;
+#else
+    return 0;
+#endif
+}
+
+// Header-parse n JPEG blobs: fills h/w and the SOURCE component count
+// (1 = grayscale, 3 = color; -1 on parse failure).
+int sdl_jpeg_batch_dims(const uint8_t** blobs, const int64_t* lens,
+                        int64_t n, int32_t* h, int32_t* w, int32_t* c,
+                        int32_t num_threads) {
+#ifdef SDL_HAVE_JPEG
+#ifdef _OPENMP
+    if (num_threads > 0) omp_set_num_threads(num_threads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        if (jpeg_dims(blobs[i], static_cast<size_t>(lens[i]),
+                      &h[i], &w[i], &c[i]) != 0) {
+            h[i] = -1;
+            w[i] = -1;
+            c[i] = -1;
+        }
+    }
+    return 0;
+#else
+    (void)blobs; (void)lens; (void)n; (void)h; (void)w; (void)c;
+    (void)num_threads;
+    return 3;
+#endif
+}
+
+// Decode n JPEGs to RGB into caller buffers dsts[i] (sized h[i]*w[i]*3
+// from sdl_jpeg_batch_dims). ok[i]=1 on success. Parallel over images.
+int sdl_jpeg_batch_decode(const uint8_t** blobs, const int64_t* lens,
+                          int64_t n, uint8_t** dsts, const int32_t* h,
+                          const int32_t* w, uint8_t* ok,
+                          int32_t num_threads) {
+#ifdef SDL_HAVE_JPEG
+#ifdef _OPENMP
+    if (num_threads > 0) omp_set_num_threads(num_threads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        ok[i] = (h[i] > 0 && w[i] > 0 &&
+                 jpeg_decode_rgb(blobs[i], static_cast<size_t>(lens[i]),
+                                 dsts[i], h[i], w[i]) == 0) ? 1 : 0;
+    }
+    return 0;
+#else
+    (void)blobs; (void)lens; (void)n; (void)dsts; (void)h; (void)w;
+    (void)ok; (void)num_threads;
+    return 3;
+#endif
+}
+
+// Fused infeed path: decode n JPEGs, bilinear-resize, channel-convert,
+// and pack into one contiguous [n, H, W, C] uint8 buffer. Failed rows
+// get ok[i]=0 (their dst slot is zeroed). This is the C++ host shim of
+// SURVEY §2.3: the whole decode→resize→layout chain in one native call.
+int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
+                           int64_t n, uint8_t* dst, int32_t H, int32_t W,
+                           int32_t C, uint8_t* ok, int32_t num_threads) {
+#ifdef SDL_HAVE_JPEG
+    const size_t row_stride = static_cast<size_t>(H) * W * C;
+#ifdef _OPENMP
+    if (num_threads > 0) omp_set_num_threads(num_threads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        ok[i] = 0;
+        int32_t h = 0, w = 0;
+        uint8_t* out = dst + i * row_stride;
+        if (jpeg_dims(blobs[i], static_cast<size_t>(lens[i]),
+                      &h, &w, nullptr) != 0 || h <= 0 || w <= 0 ||
+            static_cast<int64_t>(h) * w > (int64_t)100000000) {
+            std::memset(out, 0, row_stride);
+            continue;
+        }
+        std::vector<uint8_t> tmp(static_cast<size_t>(h) * w * 3);
+        if (jpeg_decode_rgb(blobs[i], static_cast<size_t>(lens[i]),
+                            tmp.data(), h, w) != 0 ||
+            resize_one(tmp.data(), h, w, 3, out, H, W, C) != 0) {
+            std::memset(out, 0, row_stride);
+            continue;
+        }
+        ok[i] = 1;
+    }
+    return 0;
+#else
+    (void)blobs; (void)lens; (void)n; (void)dst; (void)H; (void)W;
+    (void)C; (void)ok; (void)num_threads;
+    return 3;
+#endif
+}
 
 // Resize + channel-convert + pack n images into a contiguous
 // [n, H, W, C] uint8 buffer. srcs[i] points at an src_h[i]*src_w[i]*

@@ -132,3 +132,157 @@ class TestPackImageBatchIntegration:
         out = packImageBatch(col, 8, 8, 3)
         np.testing.assert_array_equal(
             out[0], imageIO.resizeImageArray(imgs[0], 8, 8, 3))
+
+
+class TestNativeJpeg:
+    def _jpeg_bytes(self, arr, quality=95):
+        import io
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(arr, "RGB").save(buf, format="JPEG",
+                                         quality=quality)
+        return buf.getvalue()
+
+    def test_decode_matches_pil(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        import io
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        arrs = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                for h, w in [(24, 32), (17, 9)]]
+        blobs = [self._jpeg_bytes(a) for a in arrs]
+        got = native.decode_jpeg_batch(blobs)
+        for blob, out in zip(blobs, got):
+            pil = np.asarray(Image.open(io.BytesIO(blob)).convert("RGB"))
+            assert out.shape == pil.shape
+            # both decode through libjpeg; tiny IDCT variations allowed
+            assert np.abs(out.astype(int) - pil.astype(int)).max() <= 1
+
+    def test_corrupt_jpeg_returns_none(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        good = self._jpeg_bytes(
+            np.zeros((8, 8, 3), np.uint8))
+        out = native.decode_jpeg_batch(
+            [b"\xff\xd8\xffgarbage", good])
+        assert out[0] is None
+        assert out[1] is not None
+
+    def test_fused_decode_resize_pack(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        rng = np.random.default_rng(1)
+        arrs = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                for h, w in [(40, 40), (20, 28)]]
+        blobs = [self._jpeg_bytes(a) for a in arrs]
+        batch, ok = native.decode_resize_pack(blobs, 16, 16, 3)
+        assert batch.shape == (2, 16, 16, 3) and ok.all()
+        # oracle: two-step native decode then resize
+        two_step = native.resize_pack_batch(
+            native.decode_jpeg_batch(blobs), 16, 16, 3)
+        np.testing.assert_array_equal(batch, two_step)
+
+    def test_fused_marks_failures(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        good = self._jpeg_bytes(np.zeros((8, 8, 3), np.uint8))
+        batch, ok = native.decode_resize_pack(
+            [good, b"\xff\xd8\xffbroken"], 8, 8, 3)
+        assert ok.tolist() == [True, False]
+        assert (batch[1] == 0).all()
+
+    def test_read_images_jpeg_native_path(self, built, tmp_path):
+        """readImages over JPEGs decodes through the native batch call
+        and matches the PIL fallback exactly enough to be
+        interchangeable."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from PIL import Image
+        rng = np.random.default_rng(2)
+        for i in range(4):
+            arr = rng.integers(0, 255, (30, 22, 3), dtype=np.uint8)
+            Image.fromarray(arr, "RGB").save(tmp_path / f"j{i}.jpg",
+                                             quality=92)
+        df = imageIO.readImages(str(tmp_path), numPartitions=2)
+        rows = df.collect_rows()
+        assert len(rows) == 4
+        for r in rows:
+            arr = imageIO.imageStructToArray(r["image"])
+            assert arr.shape == (30, 22, 3)
+
+    def test_grayscale_jpeg_schema_matches_pil_path(self, built,
+                                                    tmp_path):
+        """Grayscale JPEGs must produce the SAME nChannels with and
+        without the shim (regression: native forced RGB while PIL kept
+        1 channel)."""
+        import os
+        from PIL import Image
+        arr = np.linspace(0, 255, 12 * 12).reshape(12, 12).astype(
+            np.uint8)
+        Image.fromarray(arr, "L").save(tmp_path / "g.jpg", quality=95)
+        df = imageIO.readImages(str(tmp_path))
+        row_native = df.collect_rows()[0]["image"]
+        os.environ["SPARKDL_TPU_NO_NATIVE"] = "1"
+        try:
+            row_pil = imageIO.readImages(
+                str(tmp_path)).collect_rows()[0]["image"]
+        finally:
+            del os.environ["SPARKDL_TPU_NO_NATIVE"]
+        assert row_native["nChannels"] == row_pil["nChannels"]
+
+    def test_oversized_header_rejected(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        # hand-build a JPEG SOI+SOF0 claiming absurd dimensions
+        import struct
+        sof = (b"\xff\xd8"                       # SOI
+               b"\xff\xc0" + struct.pack(">HBHHB", 11, 8, 65000, 65000, 3)
+               + b"\x01\x11\x00\x02\x11\x00\x03\x11\x00")
+        out = native.decode_jpeg_batch([sof])
+        assert out == [None]
+
+
+class TestReadImagesPacked:
+    def test_packed_reader_matches_general_reader(self, built, tmp_path):
+        from PIL import Image
+        rng = np.random.default_rng(5)
+        for i in range(4):
+            arr = rng.integers(0, 255, (30, 26, 3), dtype=np.uint8)
+            Image.fromarray(arr, "RGB").save(tmp_path / f"p{i}.jpg",
+                                             quality=92)
+        # smooth PNG: its fallback resize is PIL (triangle filter) while
+        # the oracle resizes natively — only close on smooth content
+        smooth = np.repeat(np.repeat(
+            np.linspace(0, 255, 18)[:, None, None], 18, axis=1),
+            3, axis=2).astype(np.uint8)
+        Image.fromarray(smooth, "RGB").save(tmp_path / "x.png")
+
+        df = imageIO.readImagesPacked(str(tmp_path), (16, 16),
+                                      numPartitions=2)
+        packed = df.tensor("image")
+        assert packed.shape == (5, 16, 16, 3)
+
+        # oracle: general reader + per-row resize
+        from sparkdl_tpu.transformers.utils import packImageBatch
+        gen = imageIO.readImages(str(tmp_path), numPartitions=2)
+        expected = packImageBatch(gen.collect().column("image"),
+                                  16, 16, 3)
+        assert np.abs(packed.astype(int)
+                      - expected.astype(int)).max() <= 2
+
+    def test_packed_reader_failure_handling(self, built, tmp_path):
+        from PIL import Image
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8), "RGB").save(
+            tmp_path / "good.jpg")
+        (tmp_path / "bad.jpg").write_bytes(b"\xff\xd8\xffnope")
+        df = imageIO.readImagesPacked(str(tmp_path), (8, 8))
+        assert df.tensor("image").shape == (1, 8, 8, 3)
+
+        kept = imageIO.readImagesPacked(str(tmp_path), (8, 8),
+                                        dropImageFailures=False)
+        rows = kept.collect_rows()
+        assert len(rows) == 2
+        ok_by_name = {r["filePath"].rsplit("/", 1)[-1]: r["imageOk"]
+                      for r in rows}
+        assert ok_by_name == {"good.jpg": True, "bad.jpg": False}
