@@ -107,7 +107,8 @@ class LocalEngine:
                     while (next_to_submit < n
                            and len(pending) < self.max_inflight):
                         fut = self._pool.submit(
-                            self._run_partition, sources[next_to_submit], plan)
+                            self._run_partition, sources[next_to_submit],
+                            plan, next_to_submit)
                         pending[next_to_submit] = fut
                         next_to_submit += 1
                     fut = pending.pop(next_to_yield)

@@ -34,11 +34,14 @@ def column_index(data, name: str) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class Stage:
-    """One plan step: RecordBatch → RecordBatch."""
-    fn: Callable[[pa.RecordBatch], pa.RecordBatch]
+    """One plan step: RecordBatch → RecordBatch. With ``with_index``,
+    ``fn(batch, partition_index)`` — for per-partition determinism
+    (sampling, sharded IO), the mapPartitionsWithIndex affordance."""
+    fn: Callable[..., pa.RecordBatch]
     kind: str = "host"            # "host" (thread-parallel) | "device" (serial)
     name: str = "stage"
     row_preserving: bool = True
+    with_index: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,12 +107,15 @@ class DataFrame:
 
     # -- plan building ------------------------------------------------------
 
-    def map_batches(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
+    def map_batches(self, fn: Callable[..., pa.RecordBatch],
                     kind: str = "host", name: str = "map_batches",
-                    row_preserving: bool = True) -> "DataFrame":
-        return DataFrame(self._sources,
-                         self._plan + [Stage(fn, kind, name, row_preserving)],
-                         self._engine)
+                    row_preserving: bool = True,
+                    with_index: bool = False) -> "DataFrame":
+        return DataFrame(
+            self._sources,
+            self._plan + [Stage(fn, kind, name, row_preserving,
+                                with_index)],
+            self._engine)
 
     def with_column(self, name: str,
                     fn: Callable[[pa.RecordBatch], pa.Array],
@@ -167,6 +173,115 @@ class DataFrame:
         return DataFrame.from_table(self.collect(), num_partitions,
                                     self._engine)
 
+    def limit(self, n: int) -> "DataFrame":
+        """First ``n`` rows (across partitions, in order), lazily:
+        partitions past the cutoff are never loaded."""
+        if n < 0:
+            raise ValueError(f"limit must be >= 0, got {n}")
+        if any(not st.row_preserving for st in self._plan):
+            # a filter in the plan changes row counts — the cutoff must
+            # apply to FINAL rows, so materialize just enough
+            rows = self.take(n)
+            return DataFrame.from_table(
+                pa.Table.from_pylist(rows, schema=self.schema), 1,
+                self._engine)
+        out_sources: List[Source] = []
+        remaining = n
+        for s in self._sources:
+            if remaining <= 0:
+                break
+            if s.num_rows is not None and s.num_rows <= remaining:
+                out_sources.append(s)
+                remaining -= s.num_rows
+            else:
+                take = remaining
+
+                def _load(s=s, take=take) -> pa.RecordBatch:
+                    return s.load().slice(0, take)
+
+                rows = (min(take, s.num_rows)
+                        if s.num_rows is not None else None)
+                out_sources.append(Source(_load, rows))
+                remaining = 0
+        if not out_sources:  # keep the schema even with zero rows
+            return DataFrame.from_table(
+                pa.Table.from_pylist([], schema=self.schema), 1,
+                self._engine)
+        return DataFrame(out_sources, self._plan, self._engine)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        """Concatenate two frames' rows (self's first). Stays fully lazy
+        when both share the same plan; otherwise each side materializes
+        lazily (once, at first execution) through its own engine path so
+        device-stage serialization is preserved."""
+        if self.schema != other.schema:
+            raise ValueError(
+                f"union schema mismatch: {self.schema.names} vs "
+                f"{other.schema.names}")
+        if self._plan == other._plan:
+            return DataFrame(self._sources + other._sources, self._plan,
+                             self._engine)
+
+        def deferred(df: "DataFrame") -> List[Source]:
+            import threading
+            cache: dict = {}
+            lock = threading.Lock()
+
+            def load_part(i):
+                def _load() -> pa.RecordBatch:
+                    with lock:
+                        if "batches" not in cache:
+                            cache["batches"] = list(df.stream())
+                    return cache["batches"][i]
+                return _load
+
+            preserving = all(st.row_preserving for st in df._plan)
+            return [Source(load_part(i),
+                           s.num_rows if preserving else None)
+                    for i, s in enumerate(df._sources)]
+
+        return DataFrame(deferred(self) + deferred(other),
+                         engine=self._engine)
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        """Bernoulli row sample (per-row coin flip, like Spark's).
+        Deterministic per (seed, partition): re-materializations return
+        the same rows, and concurrent partitions each use their own
+        generator."""
+        if not (0.0 <= fraction <= 1.0):
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def _stage(batch: pa.RecordBatch, index: int) -> pa.RecordBatch:
+            rng = np.random.default_rng((seed, index))
+            keep = rng.random(batch.num_rows) < fraction
+            return batch.filter(pa.array(keep))
+
+        return self.map_batches(_stage, name=f"sample({fraction})",
+                                row_preserving=False, with_index=True)
+
+    def show(self, n: int = 20, truncate: int = 40) -> None:
+        """Print the first ``n`` rows as a simple table (Spark
+        ``df.show`` affordance)."""
+        rows = self.take(n)
+        cols = self.columns
+        def fmt(v):
+            s = repr(v)
+            return s if len(s) <= truncate else s[:truncate - 1] + "…"
+        widths = {c: len(c) for c in cols}
+        rendered = [{c: fmt(r.get(c)) for c in cols} for r in rows]
+        for r in rendered:
+            for c in cols:
+                widths[c] = max(widths[c], len(r[c]))
+        line = "+" + "+".join("-" * (widths[c] + 2) for c in cols) + "+"
+        print(line)
+        print("|" + "|".join(f" {c.ljust(widths[c])} " for c in cols)
+              + "|")
+        print(line)
+        for r in rendered:
+            print("|" + "|".join(f" {r[c].ljust(widths[c])} "
+                                 for c in cols) + "|")
+        print(line)
+
     def filter_rows(self, mask: np.ndarray) -> "DataFrame":
         """Keep rows where the GLOBAL boolean mask is true (mask indexed in
         collected row order). Used by CrossValidator k-fold splits."""
@@ -192,7 +307,8 @@ class DataFrame:
             return pa.schema([])
         proto = self._sources[0].load().slice(0, 0)
         for stage in self._plan:
-            proto = stage.fn(proto)
+            proto = (stage.fn(proto, 0) if stage.with_index
+                     else stage.fn(proto))
         return proto.schema
 
     @property

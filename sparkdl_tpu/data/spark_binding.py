@@ -54,9 +54,19 @@ def plan_to_map_in_arrow(plan: Sequence) -> Callable[
 
     def apply_batches(batches: Iterator[pa.RecordBatch]
                       ) -> Iterator[pa.RecordBatch]:
+        index = 0
+        try:  # Spark partition id for with_index stages, when available
+            from pyspark import TaskContext
+            ctx = TaskContext.get()
+            if ctx is not None:
+                index = ctx.partitionId()
+        except ImportError:
+            pass
         for batch in batches:
             for stage in stages:
-                batch = stage.fn(batch)
+                batch = (stage.fn(batch, index)
+                         if getattr(stage, "with_index", False)
+                         else stage.fn(batch))
             yield batch
 
     return apply_batches

@@ -239,3 +239,31 @@ class TestKerasTransformers:
         expected = m.predict(np.stack([loader(p) for p in paths]),
                              verbose=0)
         np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestTensorTransformerMultiIO:
+    def test_multi_input_multi_output(self):
+        """Explicit column↔tensor mappings over a 2-in/2-out model
+        (reference TFTransformer's core contract)."""
+        def apply_fn(params, inputs):
+            return {"sum": inputs["a"] + inputs["b"],
+                    "diff": inputs["a"] - inputs["b"]}
+
+        mf = ModelFunction(apply_fn, None,
+                           {"a": ((3,), np.float32),
+                            "b": ((3,), np.float32)},
+                           output_names=["sum", "diff"])
+        rows = [{"left": [float(i)] * 3, "right": [1.0] * 3}
+                for i in range(7)]
+        df = DataFrame.from_pylist(rows, num_partitions=2)
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"left": "a", "right": "b"},
+                              outputMapping={"sum": "s", "diff": "d"},
+                              batchSize=3)
+        out = t.transform(df)
+        s = out.tensor("s")
+        d = out.tensor("d")
+        np.testing.assert_allclose(s[:, 0], np.arange(7) + 1.0)
+        np.testing.assert_allclose(d[:, 0], np.arange(7) - 1.0)
+        # inputs stay in the frame alongside outputs
+        assert set(out.columns) == {"left", "right", "s", "d"}
