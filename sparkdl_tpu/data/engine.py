@@ -53,17 +53,19 @@ class LocalEngine:
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
 
-    def _run_stage(self, stage, batch, timings) -> pa.RecordBatch:
+    def _run_stage(self, stage, batch, index, timings) -> pa.RecordBatch:
         if timings is None:
-            return stage.fn(batch)
+            return (stage.fn(batch, index) if stage.with_index
+                    else stage.fn(batch))
         import time
         t0 = time.perf_counter()
-        out = stage.fn(batch)
+        out = (stage.fn(batch, index) if stage.with_index
+               else stage.fn(batch))
         timings.append((stage.name, time.perf_counter() - t0,
                         batch.num_rows))
         return out
 
-    def _run_once(self, source, plan) -> pa.RecordBatch:
+    def _run_once(self, source, plan, index) -> pa.RecordBatch:
         # Buffer stage timings locally and flush only on success, so a
         # retried partition doesn't double-count its completed stages.
         timings = [] if self.stage_metrics is not None else None
@@ -71,19 +73,19 @@ class LocalEngine:
         for stage in plan:
             if stage.kind == "device":
                 with self._device_lock:
-                    batch = self._run_stage(stage, batch, timings)
+                    batch = self._run_stage(stage, batch, index, timings)
             else:
-                batch = self._run_stage(stage, batch, timings)
+                batch = self._run_stage(stage, batch, index, timings)
         if timings:
             for name, seconds, rows in timings:
                 self.stage_metrics.add(name, seconds, rows)
         return batch
 
-    def _run_partition(self, source, plan) -> pa.RecordBatch:
+    def _run_partition(self, source, plan, index) -> pa.RecordBatch:
         attempts = 1 + max(0, self.max_retries)
         for attempt in range(attempts):
             try:
-                return self._run_once(source, plan)
+                return self._run_once(source, plan, index)
             except OSError as e:
                 if attempt + 1 >= attempts:
                     raise

@@ -61,6 +61,7 @@ class DataFrame:
         self._sources: List[Source] = list(sources)
         self._plan: List[Stage] = list(plan)
         self._engine = engine or default_engine()
+        self._schema: Optional[pa.Schema] = None
 
     # -- constructors -------------------------------------------------------
 
@@ -301,15 +302,19 @@ class DataFrame:
 
     @property
     def schema(self) -> pa.Schema:
-        """Schema after the plan, computed on the first partition's batch
-        sliced to zero rows (stages must tolerate empty batches)."""
-        if not self._sources:
-            return pa.schema([])
-        proto = self._sources[0].load().slice(0, 0)
-        for stage in self._plan:
-            proto = (stage.fn(proto, 0) if stage.with_index
-                     else stage.fn(proto))
-        return proto.schema
+        """Schema after the plan, computed once on the first partition's
+        batch sliced to zero rows (stages must tolerate empty batches)
+        and cached — ``limit``/``union``/``show`` all consult it, and a
+        decode-bearing plan must not re-load partition 0 per access."""
+        if self._schema is None:
+            if not self._sources:
+                return pa.schema([])
+            proto = self._sources[0].load().slice(0, 0)
+            for stage in self._plan:
+                proto = (stage.fn(proto, 0) if stage.with_index
+                         else stage.fn(proto))
+            self._schema = proto.schema
+        return self._schema
 
     @property
     def columns(self) -> List[str]:

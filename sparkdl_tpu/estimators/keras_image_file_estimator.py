@@ -416,11 +416,22 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     @staticmethod
     def _prepare_targets(y: np.ndarray, loss, n_out: int) -> np.ndarray:
         """Integer class labels one-hot to the model's output width for
-        categorical losses; everything else passes through as float32."""
+        categorical losses; everything else passes through as float32,
+        with 1-D targets lifted to [N, 1] so elementwise losses align
+        with a 2-D model output — without the reshape, [N,1] preds
+        against [N] targets broadcast to [N,N] and BCE silently
+        minimizes a wrong objective."""
         if (loss == "categorical_crossentropy"
                 and y.ndim == 1 and np.issubdtype(y.dtype, np.integer)):
             return np.eye(n_out, dtype=np.float32)[y]
-        return np.asarray(y, dtype=np.float32)
+        y = np.asarray(y, dtype=np.float32)
+        if y.ndim == 1:
+            y = y.reshape(len(y), 1)
+            if n_out != 1:
+                raise ValueError(
+                    f"1-D targets against a {n_out}-wide model output; "
+                    "provide targets shaped [N, n_out] explicitly")
+        return y
 
     @staticmethod
     def _as_model_function(model, trained: Dict[str, Any]) -> ModelFunction:

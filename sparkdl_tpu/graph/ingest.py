@@ -9,8 +9,11 @@ mappings. The TPU-era source matrix:
 ==============================  ============================================
 reference source                TPU-native source
 ==============================  ============================================
-tf.Graph in a session           ``fromFunction`` (jax fn + params pytree)
-frozen GraphDef bytes           ``fromExport`` (serialized StableHLO bytes)
+tf.Graph in a session           ``fromGraph`` (host-executed, frozen graph);
+                                jax users: ``fromFunction`` (fn + params)
+frozen GraphDef bytes           ``fromGraphDef`` (host-executed);
+                                TPU broadcast form: ``fromExport``
+                                (serialized StableHLO bytes)
 Keras .h5 model file            ``fromKerasFile`` / ``fromKerasModel``
                                 (Keras 3, JAX backend → jittable)
 SavedModel + signature          ``fromSavedModelWithSignature``
@@ -137,6 +140,74 @@ class ModelIngest:
             model, name=name or f"keras:{os.path.basename(path)}")
 
     # -- TF-era sources (host-executed; see module docstring) ---------------
+
+    @staticmethod
+    def fromGraphDef(graph_def, feed_names: Sequence[str],
+                     fetch_names: Sequence[str],
+                     name: Optional[str] = None) -> ModelFunction:
+        """Frozen TF GraphDef (proto or serialized bytes, the TF1-era
+        artifact format) → host-backend ModelFunction executing the
+        pruned graph on CPU via the TF runtime, exactly like the
+        SavedModel path (reference ``TFInputGraph.fromGraphDef``).
+
+        ``feed_names``/``fetch_names`` are tensor names (``"x:0"``; a
+        bare op name means its output 0). Input/output keys on the
+        resulting ModelFunction are the clean op names — use
+        ``rename_io`` to remap.
+        """
+        tf = _tf()
+        if isinstance(graph_def, (bytes, bytearray)):
+            proto = tf.compat.v1.GraphDef()
+            proto.ParseFromString(bytes(graph_def))
+            graph_def = proto
+
+        def _tensor_name(n: str) -> str:
+            return n if ":" in n else n + ":0"
+
+        def _import():
+            tf.compat.v1.import_graph_def(graph_def, name="")
+
+        wrapped = tf.compat.v1.wrap_function(_import, [])
+        feeds = [wrapped.graph.get_tensor_by_name(_tensor_name(n))
+                 for n in feed_names]
+        fetches = [wrapped.graph.get_tensor_by_name(_tensor_name(n))
+                   for n in fetch_names]
+        pruned = wrapped.prune(feeds=feeds, fetches=fetches)
+
+        in_keys = [_tensor_name(n).split(":")[0] for n in feed_names]
+        out_keys = [_tensor_name(n).split(":")[0] for n in fetch_names]
+        input_signature: Signature = {}
+        for key, t in zip(in_keys, feeds):
+            shape = tuple(int(d) if d is not None else None
+                          for d in t.shape.as_list()[1:]) \
+                if t.shape.rank is not None else ()
+            input_signature[key] = (shape, np.dtype(t.dtype.name))
+
+        def apply_fn(_params, inputs: Dict[str, np.ndarray]):
+            args = [tf.constant(np.asarray(inputs[k])) for k in in_keys]
+            out = pruned(*args)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            return {k: np.asarray(v) for k, v in zip(out_keys, out)}
+
+        mf = ModelFunction(
+            apply_fn, params=None, input_signature=input_signature,
+            output_names=out_keys, backend="host",
+            name=name or "graphdef")
+        mf._keras_loaded = pruned  # keep the ConcreteFunction alive
+        return mf
+
+    @staticmethod
+    def fromGraph(graph, feed_names: Sequence[str],
+                  fetch_names: Sequence[str],
+                  name: Optional[str] = None) -> ModelFunction:
+        """A live ``tf.Graph`` (frozen: variables already constants) →
+        host-backend ModelFunction (reference ``TFInputGraph.fromGraph``,
+        which froze the session's graph; freeze first if yours holds
+        variables)."""
+        return ModelIngest.fromGraphDef(
+            graph.as_graph_def(), feed_names, fetch_names,
+            name=name or "graph")
 
     @staticmethod
     def fromSavedModel(saved_model_dir: str,

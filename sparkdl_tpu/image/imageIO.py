@@ -126,7 +126,8 @@ def _decodeBatch(origins: Sequence[str],
     semantics)."""
     structs: List[Optional[dict]] = [None] * len(blobs)
     jpeg_idx = [i for i, b in enumerate(blobs)
-                if b[:3] == _JPEG_MAGIC]
+                if isinstance(b, (bytes, bytearray))
+                and b[:3] == _JPEG_MAGIC]
     decoded = None
     if jpeg_idx:
         try:
@@ -166,29 +167,66 @@ def batchToStructs(column) -> List[Optional[dict]]:
     return column.to_pylist()
 
 
+def imageColumnViews(column):
+    """Zero-copy views over an image struct column's Arrow buffers:
+    ``(heights, widths, channels, offsets, values)`` where the first
+    four are int32/int64 numpy views and ``values`` is the uint8 view of
+    the shared binary data region (row ``i``'s pixels are
+    ``values[offsets[i]:offsets[i+1]]``). No per-row Python objects are
+    created — this is the contract the native shim and the NHWC packers
+    build on (the reference's equivalent invariant: SURVEY §3.2 "no
+    Python on the hot path"). Null rows raise: a silent zero image would
+    featurize like real data (drop failures upstream, e.g.
+    ``readImages(dropImageFailures=True)`` or ``df.filter``)."""
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if column.null_count:
+        nulls = np.flatnonzero(
+            ~np.asarray(pa.compute.is_valid(column)))
+        raise ValueError(
+            f"row {int(nulls[0])}: null image in batch; drop failed/null "
+            "image rows before converting to NHWC (e.g. readImages(..., "
+            "dropImageFailures=True) or df.filter)")
+    # flatten() propagates the struct's own offset/length to children
+    children = dict(zip([f.name for f in column.type], column.flatten()))
+    heights = children["height"].to_numpy(zero_copy_only=False)
+    widths = children["width"].to_numpy(zero_copy_only=False)
+    channels = children["nChannels"].to_numpy(zero_copy_only=False)
+    data_arr = children["data"]
+    n = len(data_arr)
+    off_buf = data_arr.buffers()[1]
+    offsets = np.frombuffer(off_buf, np.int32)[
+        data_arr.offset:data_arr.offset + n + 1].astype(np.int64)
+    values = np.frombuffer(data_arr.buffers()[2], np.uint8)
+    return heights, widths, channels, offsets, values
+
+
 def imageColumnToNHWC(column, height: int, width: int,
                       nChannels: int = 3) -> np.ndarray:
-    """Image struct column (all rows already h×w×c) → contiguous
-    [N,H,W,C] uint8 array. The fast path the runner feeds to the TPU.
-    Null rows raise: a silent zero image would featurize like real data
-    (drop failures upstream, e.g. ``readImages(dropImageFailures=True)``
-    or ``df.filter``)."""
-    structs = batchToStructs(column)
-    out = np.zeros((len(structs), height, width, nChannels), dtype=np.uint8)
-    for i, s in enumerate(structs):
-        if s is None:
-            raise ValueError(
-                f"row {i}: null image in batch; drop failed/null image "
-                "rows before converting to NHWC (e.g. readImages(..., "
-                "dropImageFailures=True) or df.filter)")
-        if s["height"] != height or s["width"] != width \
-                or s["nChannels"] != nChannels:
-            raise ValueError(
-                f"row {i}: image is {s['height']}x{s['width']}x"
-                f"{s['nChannels']}, expected {height}x{width}x{nChannels}; "
-                "resize first")
-        out[i] = imageStructToArray(s)
-    return out
+    """Image struct column (all rows already h×w×c) → [N,H,W,C] uint8.
+
+    Zero-copy: Arrow binary rows are stored back-to-back, so when every
+    row is the target size the batch is literally a reshaped view of the
+    column's data buffer — no per-row Python, no memcpy. The returned
+    array may be read-only (it aliases the Arrow buffer)."""
+    heights, widths, channels, offsets, values = imageColumnViews(column)
+    n = len(heights)
+    bad = np.flatnonzero((heights != height) | (widths != width)
+                         | (channels != nChannels))
+    if bad.size:
+        i = int(bad[0])
+        raise ValueError(
+            f"row {i}: image is {heights[i]}x{widths[i]}x"
+            f"{channels[i]}, expected {height}x{width}x{nChannels}; "
+            "resize first")
+    row = height * width * nChannels
+    sizes = offsets[1:] - offsets[:-1]
+    if n and not (sizes == row).all():
+        i = int(np.flatnonzero(sizes != row)[0])
+        raise ValueError(
+            f"row {i}: data size {int(sizes[i])} != h*w*c = {row}")
+    block = values[offsets[0]:offsets[0] + n * row]
+    return block.reshape(n, height, width, nChannels)
 
 
 # ---------------------------------------------------------------------------
@@ -359,7 +397,8 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
         ok = np.zeros(n, bool)
 
         jpeg_idx = [i for i, b in enumerate(blobs)
-                    if b[:3] == _JPEG_MAGIC]
+                    if isinstance(b, (bytes, bytearray))
+                    and b[:3] == _JPEG_MAGIC]
         fused = None
         if jpeg_idx:
             try:

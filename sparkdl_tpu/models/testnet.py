@@ -16,6 +16,27 @@ import jax.numpy as jnp
 from sparkdl_tpu.models.layers import ConvBN, global_avg_pool, max_pool
 
 
+def synthetic_testnet_dataset(n: int, seed: int = 0,
+                              noise: float = 40.0,
+                              proto_seed: int = 1234):
+    """Deterministic synthetic 10-class dataset for training/evaluating
+    the committed TestNet artifact: each class is a fixed random 32×32×3
+    prototype pattern (from ``proto_seed``, shared across splits),
+    samples are the prototype plus Gaussian pixel noise (from ``seed`` —
+    vary it for disjoint train/eval splits over the same classes).
+    Returns ``(images uint8 [n,32,32,3], labels int32 [n])``. The exact
+    generator parameters are recorded in the artifact's provenance
+    sidecar — the 'committed dataset' of the reference's TestNet
+    fixture, generated instead of stored."""
+    import numpy as np
+    protos = np.random.default_rng(proto_seed).integers(
+        0, 255, size=(10, 32, 32, 3)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    imgs = protos[labels] + rng.normal(0.0, noise, size=(n, 32, 32, 3))
+    return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+
 class TestNet(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32

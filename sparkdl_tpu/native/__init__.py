@@ -226,6 +226,53 @@ def decode_resize_pack(blobs: Sequence[bytes], height: int, width: int,
     return out, ok.astype(bool)
 
 
+def resize_pack_buffers(values: np.ndarray, offsets: np.ndarray,
+                        heights: np.ndarray, widths: np.ndarray,
+                        channels: np.ndarray, height: int, width: int,
+                        nChannels: int = 3,
+                        num_threads: int = 0) -> Optional[np.ndarray]:
+    """Zero-copy variant of :func:`resize_pack_batch`: sources are given
+    as one shared uint8 buffer plus per-row offsets/dims (numpy views
+    over an Arrow binary column — see ``imageIO.imageColumnViews``), so
+    no per-row Python objects or copies are made; the pointer table is
+    computed vectorized as ``base + offsets``. Returns None when the
+    native path is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(heights)
+    out = np.empty((n, height, width, nChannels), dtype=np.uint8)
+    if n == 0:
+        return out
+    values = np.ascontiguousarray(values)
+    expected = (heights.astype(np.int64) * widths.astype(np.int64)
+                * channels.astype(np.int64))
+    sizes = np.asarray(offsets[1:]) - np.asarray(offsets[:-1])
+    if not (sizes == expected).all():
+        i = int(np.flatnonzero(sizes != expected)[0])
+        raise ValueError(
+            f"row {i}: data size {int(sizes[i])} != h*w*c = "
+            f"{int(expected[i])}")
+    if int(offsets[-1]) > values.size:
+        raise ValueError("offsets overrun the shared data buffer")
+    ptr_table = (np.asarray(offsets[:-1], np.uint64)
+                 + np.uint64(values.ctypes.data))
+    hs = np.ascontiguousarray(heights, np.int32)
+    ws = np.ascontiguousarray(widths, np.int32)
+    cs = np.ascontiguousarray(channels, np.int32)
+    rc = lib.sdl_resize_pack_batch(
+        ptr_table.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, out.ctypes.data, height, width, nChannels, num_threads)
+    if rc != 0:
+        raise ValueError(
+            "native resize/pack failed: unsupported channel conversion "
+            f"in batch (target {nChannels} channels)")
+    return out
+
+
 def resize_pack_batch(images: Sequence[np.ndarray], height: int,
                       width: int, nChannels: int = 3,
                       num_threads: int = 0) -> Optional[np.ndarray]:

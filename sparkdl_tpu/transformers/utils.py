@@ -22,36 +22,41 @@ def packImageBatch(column, height: int, width: int, nChannels: int = 3,
     the host as needed (the JVM-side ``ImageUtils.resizeImage`` step of
     the reference's Scala featurizer, reference call stack §3.2).
 
-    Prefers the C++ shim (one native call per batch, OpenMP over rows,
-    GIL released — the reference's equivalent step was likewise native);
-    falls back to per-row PIL. The two resamplers differ by a few counts
-    when downscaling (bilinear vs PIL's triangle filter), just as the
-    reference's JVM and PIL paths did.
+    Zero-copy hot path: row dims and pixel bytes are read as numpy views
+    straight off the column's Arrow buffers (``imageColumnViews``) — no
+    per-row Python objects anywhere. Already-sized batches return a
+    reshaped view of the Arrow data buffer outright; mixed-size batches
+    feed per-row *pointers into that buffer* to the C++ shim (one native
+    call, OpenMP over rows, GIL released — the reference's equivalent
+    step was likewise native). Per-row PIL only as fallback without the
+    shim; the two resamplers differ by a few counts when downscaling
+    (bilinear vs PIL's triangle filter), as the reference's JVM and PIL
+    paths did.
     """
-    structs = imageIO.batchToStructs(column)
-    arrays = []
-    for i, s in enumerate(structs):
-        if s is None:
-            # A silent zero image would featurize like real data; fail
-            # loudly instead (readImages(dropImageFailures=True) or a
-            # filter removes nulls upstream).
-            raise ValueError(
-                f"row {i}: null image in batch; drop failed/null image "
-                "rows before applying a model (e.g. readImages(..., "
-                "dropImageFailures=True) or df.filter)")
-        arr = imageIO.imageStructToArray(s)
-        if not resize and arr.shape != (height, width, nChannels):
-            raise ValueError(
-                f"row {i}: image {arr.shape} != {(height, width, nChannels)}")
-        arrays.append(arr)
+    heights, widths, channels, offsets, values = \
+        imageIO.imageColumnViews(column)
+    n = len(heights)
+    same = ((heights == height) & (widths == width)
+            & (channels == nChannels))
+    if same.all():
+        return imageIO.imageColumnToNHWC(column, height, width, nChannels)
+    if not resize:
+        i = int(np.flatnonzero(~same)[0])
+        raise ValueError(
+            f"row {i}: image ({heights[i]}, {widths[i]}, {channels[i]})"
+            f" != {(height, width, nChannels)}")
 
     from sparkdl_tpu import native
-    packed = native.resize_pack_batch(arrays, height, width, nChannels)
+    packed = native.resize_pack_buffers(
+        values, offsets, heights, widths, channels,
+        height, width, nChannels)
     if packed is not None:
         return packed
 
-    out = np.zeros((len(arrays), height, width, nChannels), np.uint8)
-    for i, arr in enumerate(arrays):
+    out = np.zeros((n, height, width, nChannels), np.uint8)
+    for i in range(n):
+        arr = values[offsets[i]:offsets[i + 1]].reshape(
+            heights[i], widths[i], channels[i])
         if arr.shape != (height, width, nChannels):
             arr = imageIO.resizeImageArray(arr, height, width, nChannels)
         out[i] = arr

@@ -196,6 +196,45 @@ class TestFrameUsability:
         out = capsys.readouterr().out
         assert "| x" in out and "| 2" in out
 
+    def test_schema_cached_across_accesses(self):
+        """Repeated schema accesses (limit/union/show all consult it)
+        must not re-load partition 0 or re-run plan stages."""
+        loads, stage_runs = [], []
+
+        def _load():
+            loads.append(1)
+            return pa.RecordBatch.from_pydict({"x": pa.array([1, 2])})
+
+        def _probe(batch):
+            stage_runs.append(1)
+            return batch
+
+        df = DataFrame([Source(_load, 2)]).map_batches(_probe, name="probe")
+        for _ in range(5):
+            _ = df.schema
+            _ = df.columns
+        assert len(loads) == 1
+        assert len(stage_runs) == 1
+        # materialization still runs the stage (on the real batch)
+        assert df.count() == 2
+
+    def test_sample_partition_index_determinism(self):
+        """sample() must see the true partition index on every engine
+        path: same frame re-materialized gives identical rows, and
+        distinct partitions don't all reuse index 0's coin flips."""
+        df = self._df(400, 4)
+        s = df.sample(0.5, seed=11)
+        first = [r["x"] for r in s.collect_rows()]
+        second = [r["x"] for r in s.collect_rows()]
+        assert first == second
+        # partitions hold disjoint value ranges (0-99, 100-199, ...); if
+        # every partition were sampled with the same rng the kept row
+        # *offsets* within each partition would coincide — astronomically
+        # unlikely with per-index seeding.
+        offsets = [sorted(v % 100 for v in first if v // 100 == p)
+                   for p in range(4)]
+        assert not all(o == offsets[0] for o in offsets[1:])
+
 
 class TestEngineScale:
     def test_many_partitions_stream_bounded(self):

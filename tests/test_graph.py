@@ -194,6 +194,43 @@ class TestIngestionMatrix:
         out = mf({"x": x_batch})
         np.testing.assert_allclose(out["y"], expected, atol=1e-5)
 
+    def _frozen_graph_def(self, mlp_weights):
+        """The TF1-era artifact: a frozen (constants-only) GraphDef with
+        named feed/fetch tensors, as serialized bytes."""
+        import tensorflow as tf
+
+        def _import():
+            x = tf.compat.v1.placeholder(tf.float32, [None, IN_DIM],
+                                         name="x")
+            h = tf.nn.relu(
+                tf.matmul(x, tf.constant(mlp_weights["W1"]))
+                + tf.constant(mlp_weights["b1"]))
+            tf.add(tf.matmul(h, tf.constant(mlp_weights["W2"])),
+                   tf.constant(mlp_weights["b2"]), name="y")
+
+        g = tf.compat.v1.wrap_function(_import, []).graph
+        return g.as_graph_def().SerializeToString()
+
+    def test_from_graphdef_bytes(self, mlp_weights, x_batch, expected):
+        blob = self._frozen_graph_def(mlp_weights)
+        mf = ModelIngest.fromGraphDef(blob, ["x:0"], ["y:0"])
+        assert mf.backend == "host"
+        assert mf.input_signature["x"][0] == (IN_DIM,)
+        out = mf({"x": x_batch})
+        np.testing.assert_allclose(out["y"], expected, atol=1e-5)
+
+    def test_from_graph_live(self, mlp_weights, x_batch, expected):
+        import tensorflow as tf
+        blob = self._frozen_graph_def(mlp_weights)
+        proto = tf.compat.v1.GraphDef()
+        proto.ParseFromString(blob)
+        graph = tf.Graph()
+        with graph.as_default():
+            tf.compat.v1.import_graph_def(proto, name="")
+        mf = ModelIngest.fromGraph(graph, ["x"], ["y"])  # bare op names
+        out = mf({"x": x_batch})
+        np.testing.assert_allclose(out["y"], expected, atol=1e-5)
+
     def test_from_saved_model_bad_signature(self, mlp_weights, tmp_path):
         d = self._saved_model(mlp_weights, tmp_path)
         with pytest.raises(KeyError):

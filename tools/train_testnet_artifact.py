@@ -1,0 +1,108 @@
+"""Train the committed TestNet artifact.
+
+The reference shipped a tiny committed model (``Models.scala::TestNet``)
+so the full featurizer path could run in seconds without downloads. Our
+equivalent is a *genuinely trained* artifact: TestNet trained on the
+deterministic synthetic dataset (``testnet.synthetic_testnet_dataset``)
+to high held-out accuracy, stored through the same hash-verified
+``ModelFetcher`` layout the zoo loads from, with a provenance sidecar
+recording the dataset spec and the measured accuracy.
+
+Run from the repo root (CPU is fine, ~1 min):
+
+    python tools/train_testnet_artifact.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+DATASET = dict(n_train=4096, n_eval=1024, seed=0, eval_seed=1, noise=40.0, proto_seed=1234)
+STEPS = 300
+BATCH = 128
+LR = 0.05
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from sparkdl_tpu.models.fetcher import ModelFetcher
+    from sparkdl_tpu.models.testnet import TestNet, synthetic_testnet_dataset
+    from sparkdl_tpu.models.zoo import ARTIFACTS_DIR, getKerasApplicationModel
+    from sparkdl_tpu.parallel.train import (
+        create_train_state,
+        make_eval_step,
+        make_train_step,
+    )
+
+    spec = getKerasApplicationModel("TestNet")
+    module = TestNet()
+
+    x_train, y_train = synthetic_testnet_dataset(
+        DATASET["n_train"], DATASET["seed"], DATASET["noise"],
+        DATASET["proto_seed"])
+    x_eval, y_eval = synthetic_testnet_dataset(
+        DATASET["n_eval"], DATASET["eval_seed"], DATASET["noise"],
+        DATASET["proto_seed"])
+
+    variables = module.init(
+        jax.random.PRNGKey(0),
+        spec.preprocess(jnp.zeros((1, 32, 32, 3), jnp.uint8)))
+    state = create_train_state(module, variables,
+                               optax.sgd(LR, momentum=0.9))
+    step = jax.jit(make_train_step(module, spec.preprocess,
+                                   num_classes=spec.num_classes))
+    eval_step = jax.jit(make_eval_step(module, spec.preprocess,
+                                       num_classes=spec.num_classes))
+
+    rng = np.random.default_rng(7)
+    for i in range(STEPS):
+        idx = rng.integers(0, len(x_train), size=BATCH)
+        state, metrics = step(state, {"image": jnp.asarray(x_train[idx]),
+                                      "label": jnp.asarray(y_train[idx])})
+        if (i + 1) % 50 == 0:
+            print(f"step {i+1}: loss={float(metrics['loss']):.4f} "
+                  f"acc={float(metrics['accuracy']):.3f}")
+
+    ev = eval_step(state, {"image": jnp.asarray(x_eval),
+                           "label": jnp.asarray(y_eval)})
+    acc = float(ev["accuracy"])
+    print(f"held-out accuracy: {acc:.4f}")
+    if acc < 0.95:
+        raise SystemExit(f"trained accuracy {acc:.4f} < 0.95; not writing "
+                         "the artifact")
+
+    trained = {"params": jax.device_get(state.params)}
+    if state.batch_stats is not None:
+        trained["batch_stats"] = jax.device_get(state.batch_stats)
+
+    digest = ModelFetcher(cache_dir=ARTIFACTS_DIR).put(
+        "TestNet.msgpack", trained)
+    with open(os.path.join(ARTIFACTS_DIR, "TestNet.provenance.json"),
+              "w") as f:
+        json.dump({
+            "model": "TestNet",
+            "sha256": digest,
+            "dataset": {"generator": "synthetic_testnet_dataset",
+                        **DATASET},
+            "train": {"steps": STEPS, "batch_size": BATCH, "lr": LR,
+                      "optimizer": "sgd(momentum=0.9)"},
+            "held_out_accuracy": acc,
+            "trained_by": "tools/train_testnet_artifact.py",
+        }, f, indent=2)
+    print(f"wrote {ARTIFACTS_DIR}/TestNet.msgpack (sha256 {digest[:12]}…)")
+
+
+if __name__ == "__main__":
+    main()
