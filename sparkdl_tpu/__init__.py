@@ -46,6 +46,10 @@ _EXPORTS = {
     "Pipeline": ("sparkdl_tpu.params.pipeline", "Pipeline"),
     "CrossValidator": ("sparkdl_tpu.params.tuning", "CrossValidator"),
     "ParamGridBuilder": ("sparkdl_tpu.params.tuning", "ParamGridBuilder"),
+    "ClassificationEvaluator": ("sparkdl_tpu.estimators.evaluators",
+                                "ClassificationEvaluator"),
+    "LossEvaluator": ("sparkdl_tpu.estimators.evaluators",
+                      "LossEvaluator"),
 }
 
 __all__ = list(_EXPORTS)

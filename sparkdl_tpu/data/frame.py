@@ -232,7 +232,16 @@ class DataFrame:
                 def _load() -> pa.RecordBatch:
                     with lock:
                         if "batches" not in cache:
-                            cache["batches"] = list(df.stream())
+                            # Run this side's plan inline in the calling
+                            # thread (engine _run_once: device stages
+                            # still serialize on the engine's lock).
+                            # df.stream() here would re-enter the SAME
+                            # thread pool from a pool worker and
+                            # deadlock once outer partitions saturate it
+                            # (max_inflight >= num_workers always).
+                            cache["batches"] = [
+                                df._engine._run_partition(s, df._plan, j)
+                                for j, s in enumerate(df._sources)]
                     return cache["batches"][i]
                 return _load
 

@@ -52,7 +52,12 @@ class ClassificationEvaluator(Evaluator):
         if preds.ndim > 1 and preds.shape[-1] == 1:
             preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
         if preds.ndim == 1:
-            hit = (preds > 0.5).astype(np.int64) == labels
+            if np.all(preds == np.round(preds)):
+                # integral values: already class labels (e.g.
+                # LogisticRegressionModel's predictionCol)
+                hit = preds.astype(np.int64) == labels
+            else:
+                hit = (preds > 0.5).astype(np.int64) == labels
         else:
             hit = preds.argmax(-1) == labels
         return float(np.mean(hit))
@@ -81,6 +86,17 @@ class LossEvaluator(Evaluator):
         preds, labels = _collect_pred_and_labels(
             dataset, self.getOrDefault("predictionCol"),
             self.getOrDefault("labelCol"))
+        if (preds.ndim == 1 and len(preds)
+                and np.all(preds == np.round(preds))
+                and preds.max(initial=0.0) > 1.0):
+            # class-label column (e.g. LogisticRegressionModel's
+            # predictionCol) — cross-entropy on labels is meaningless;
+            # fail loudly instead of returning a plausible number
+            raise ValueError(
+                f"column {self.getOrDefault('predictionCol')!r} holds "
+                "integer class labels, not probabilities; point "
+                "LossEvaluator(predictionCol=...) at the probability "
+                "vector column (e.g. 'probability')")
         preds = np.clip(preds, 1e-7, 1.0 - 1e-7)
         if preds.ndim > 1 and preds.shape[-1] == 1:
             preds = preds[..., 0]  # (N,1) sigmoid outputs → binary

@@ -21,25 +21,32 @@ from sparkdl_tpu.params.shared import HasLabelCol
 
 
 class LogisticRegressionModel(Model):
-    """Fitted coefficients; transform appends softmax probabilities.
+    """Fitted coefficients; transform appends the predicted class label
+    (``predictionCol``, float64 — Spark MLlib's convention) and the
+    softmax probability vector (``probabilityCol``).
 
-    ``featuresCol``/``predictionCol`` are real Params so transform-time
-    overrides (``model.transform(df, {"predictionCol": ...})``) apply.
+    All column Params are real Params so transform-time overrides
+    (``model.transform(df, {"predictionCol": ...})``) apply.
     """
 
     featuresCol = Param("LogisticRegressionModel", "featuresCol",
                         "features vector column", TypeConverters.toString)
     predictionCol = Param("LogisticRegressionModel", "predictionCol",
-                          "output probability-vector column",
+                          "predicted class label column (float64)",
                           TypeConverters.toString)
+    probabilityCol = Param("LogisticRegressionModel", "probabilityCol",
+                           "output probability-vector column",
+                           TypeConverters.toString)
 
     def __init__(self, coefficients: np.ndarray, intercept: np.ndarray,
                  featuresCol: str, predictionCol: str,
+                 probabilityCol: str = "probability",
                  objectiveHistory: Optional[List[float]] = None):
         super().__init__()
         self.coefficients = np.asarray(coefficients)   # [D, C]
         self.intercept = np.asarray(intercept)         # [C]
-        self._set(featuresCol=featuresCol, predictionCol=predictionCol)
+        self._set(featuresCol=featuresCol, predictionCol=predictionCol,
+                  probabilityCol=probabilityCol)
         self.objectiveHistory = objectiveHistory or []
 
     @property
@@ -55,7 +62,8 @@ class LogisticRegressionModel(Model):
         )
         W, b = self.coefficients, self.intercept
         feat = self.getOrDefault("featuresCol")
-        out = self.getOrDefault("predictionCol")
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
 
         def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
             idx = column_index(batch, feat)
@@ -66,7 +74,9 @@ class LogisticRegressionModel(Model):
             logits -= logits.max(-1, keepdims=True)
             e = np.exp(logits)
             probs = (e / e.sum(-1, keepdims=True)).astype(np.float32)
-            return append_tensor_column(batch, out, probs)
+            batch = append_tensor_column(batch, prob_col, probs)
+            labels = probs.argmax(-1).astype(np.float64)
+            return batch.append_column(pred_col, pa.array(labels))
 
         return dataset.map_batches(apply, name=f"logreg({feat})")
 
@@ -89,8 +99,11 @@ class LogisticRegression(Estimator, HasLabelCol):
     featuresCol = Param("LogisticRegression", "featuresCol",
                         "features vector column", TypeConverters.toString)
     predictionCol = Param("LogisticRegression", "predictionCol",
-                          "output probability-vector column",
+                          "predicted class label column (float64)",
                           TypeConverters.toString)
+    probabilityCol = Param("LogisticRegression", "probabilityCol",
+                           "output probability-vector column",
+                           TypeConverters.toString)
     maxIter = Param("LogisticRegression", "maxIter",
                     "training iterations", TypeConverters.toInt)
     regParam = Param("LogisticRegression", "regParam",
@@ -102,14 +115,16 @@ class LogisticRegression(Estimator, HasLabelCol):
 
     @keyword_only
     def __init__(self, *, featuresCol="features", labelCol="label",
-                 predictionCol="prediction", maxIter=100, regParam=0.0,
-                 learningRate=0.1, seed=0):
+                 predictionCol="prediction", probabilityCol="probability",
+                 maxIter=100, regParam=0.0, learningRate=0.1, seed=0):
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
-                         predictionCol="prediction", maxIter=100,
+                         predictionCol="prediction",
+                         probabilityCol="probability", maxIter=100,
                          regParam=0.0, learningRate=0.1, seed=0)
         self._set(featuresCol=featuresCol, labelCol=labelCol,
-                  predictionCol=predictionCol, maxIter=maxIter,
+                  predictionCol=predictionCol,
+                  probabilityCol=probabilityCol, maxIter=maxIter,
                   regParam=regParam, learningRate=learningRate, seed=seed)
 
     def _fit(self, dataset) -> LogisticRegressionModel:
@@ -178,4 +193,5 @@ class LogisticRegression(Estimator, HasLabelCol):
             np.asarray(params["W"]), np.asarray(params["b"]),
             featuresCol=feat,
             predictionCol=self.getOrDefault("predictionCol"),
+            probabilityCol=self.getOrDefault("probabilityCol"),
             objectiveHistory=history)

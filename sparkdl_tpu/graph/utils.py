@@ -73,6 +73,81 @@ def output_names(mf: ModelFunction) -> List[str]:
     return validated_model(mf).output_names
 
 
+def select_outputs(mf: ModelFunction, names: List[str],
+                   name: Optional[str] = None) -> ModelFunction:
+    """Prune a ModelFunction to a subset of its outputs — the TPU-era
+    remnant of the reference's graph pruning (``strip_and_freeze_until``
+    cut the TF graph at the requested fetches; here XLA's dead-code
+    elimination deletes the unused computation when the wrapped fn stops
+    returning it, so slicing the output dict IS the pruning)."""
+    validated_model(mf)
+    names = [validated_output(mf, n) for n in names]
+    if not names:
+        raise ValueError("select_outputs needs at least one output")
+
+    def apply_fn(params_, inputs):
+        out = mf.apply_fn(params_, inputs)
+        return {k: out[k] for k in names}
+
+    return ModelFunction(
+        apply_fn, params=mf.params, input_signature=mf.input_signature,
+        output_names=list(names), backend=mf.backend,
+        name=name or f"{mf.name}[{','.join(names)}]")
+
+
+def with_preprocessor(mf: ModelFunction, fn, input_signature=None,
+                      name: Optional[str] = None) -> ModelFunction:
+    """Prepend a pure per-input fn (``{name: array} → {name: array}``)
+    to the model; both run inside ONE jitted XLA program, so elementwise
+    preprocessing fuses into the model's first matmul/conv (the
+    reference stitched a preprocessor GraphFunction in front via
+    ``GraphFunction.fromList`` — reference ``udf/keras_image_model.py``)."""
+    validated_model(mf)
+
+    def apply_fn(params_, inputs):
+        return mf.apply_fn(params_, fn(inputs))
+
+    return ModelFunction(
+        apply_fn, params=mf.params,
+        input_signature=input_signature or mf.input_signature,
+        output_names=mf.output_names, backend=mf.backend,
+        name=name or f"pre+{mf.name}")
+
+
+def with_postprocessor(mf: ModelFunction, fn,
+                       output_names_out: Optional[List[str]] = None,
+                       name: Optional[str] = None) -> ModelFunction:
+    """Append a pure fn (``{name: array} → {name: array}``) after the
+    model inside the same XLA program (the reference's output flattener,
+    ``graph/pieces.py::buildFlattener``, was this composed at the graph
+    level)."""
+    validated_model(mf)
+
+    def apply_fn(params_, inputs):
+        return fn(mf.apply_fn(params_, inputs))
+
+    out_names = output_names_out
+    if out_names is None:
+        import jax
+        import numpy as np
+        probe = {
+            k: jax.ShapeDtypeStruct((1,) + tuple(
+                d if d is not None else 1 for d in shape), dtype)
+            for k, (shape, dtype) in mf.input_signature.items()}
+        if mf.backend == "jax":
+            out = jax.eval_shape(lambda p, x: apply_fn(p, x),
+                                 mf.params, probe)
+        else:  # host models can't be traced; run a 1-row zero batch
+            out = apply_fn(mf.params, {
+                k: np.zeros(s.shape, s.dtype) for k, s in probe.items()})
+        out_names = list(out)
+
+    return ModelFunction(
+        apply_fn, params=mf.params, input_signature=mf.input_signature,
+        output_names=out_names, backend=mf.backend,
+        name=name or f"{mf.name}+post")
+
+
 def strip_and_freeze(mf: ModelFunction,
                      batch_size: Optional[int] = None) -> bytes:
     """Params baked in, computation serialized to StableHLO bytes — the

@@ -1,0 +1,62 @@
+"""Worker for test_distributed_multiproc: one process of a 2-process
+``jax.distributed`` CPU cluster (4 virtual devices each → 8 global).
+
+Spawned with a sanitized environment (the parent strips the axon
+sitecustomize and TPU tunnel vars) so jax initializes a plain CPU
+backend; cross-process collectives ride Gloo. Prints one ``RESULT {...}``
+JSON line the parent asserts on.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    num_partitions = int(sys.argv[3])
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu.parallel import distributed as dist
+    from sparkdl_tpu.parallel.mesh import DATA_AXIS, MeshSpec
+
+    # Explicit join (the TPU-pod path auto-detects; tests pass params).
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=2, process_id=pid)
+    info = dist.host_info()
+
+    # Global-mesh psum: every process contributes its local shard of a
+    # global ("data",)-sharded array; the jitted sum needs a
+    # cross-process collective (Gloo here, ICI/DCN on a pod).
+    mesh = dist.global_mesh(MeshSpec(data=-1, model=1))
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    local = np.arange(info.local_device_count, dtype=np.float64) + 10 * pid
+    garr = jax.make_array_from_process_local_data(
+        sharding, local, (info.global_device_count,))
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+
+    # host_shard_dataframe end-to-end: each host materializes only its
+    # own partitions of the same logical frame.
+    from sparkdl_tpu.data.frame import DataFrame
+    rows = [{"x": i} for i in range(4 * num_partitions - 1)]
+    df = DataFrame.from_pylist(rows, num_partitions=num_partitions)
+    mine = dist.host_shard_dataframe(df)
+    xs = sorted(r["x"] for r in mine.collect_rows())
+
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "process_count": info.process_count,
+        "local_devices": info.local_device_count,
+        "global_devices": info.global_device_count,
+        "shard_indices": dist.host_shard_indices(num_partitions),
+        "psum_total": float(total),
+        "rows": xs,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Two-process ``jax.distributed`` test (VERDICT r1 missing #3).
+
+The reference delegated inter-host behavior to Spark and never tested it
+beyond local-mode; this build owns its DCN layer, so multi-process is
+exercised for real: two coordinator-joined CPU processes with 4 virtual
+devices each form one 8-device global mesh, run a cross-process
+collective, and shard one logical DataFrame's partitions disjointly
+(reference role: SURVEY §2.5 Spark RPC between hosts).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_distmp_worker.py")
+NUM_PARTITIONS = 5
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _clean_env() -> dict:
+    """Strip the axon TPU-tunnel sitecustomize and device overrides so
+    the workers get a plain multi-process CPU runtime."""
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith("PALLAS_") or k.startswith("AXON")
+                   or k.startswith("TPU_") or k == "PYTHONPATH")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO_ROOT
+    return env
+
+
+@pytest.fixture(scope="module")
+def worker_results():
+    port = _free_port()
+    env = _clean_env()
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), str(port), str(NUM_PARTITIONS)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO_ROOT) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+            assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line in worker output:\n{out[-3000:]}"
+        results.append(json.loads(lines[0][len("RESULT "):]))
+    return sorted(results, key=lambda r: r["pid"])
+
+
+def test_global_runtime_topology(worker_results):
+    for r in worker_results:
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 4
+        assert r["global_devices"] == 8
+
+
+def test_cross_process_collective(worker_results):
+    # process 0 contributes 0+1+2+3, process 1 contributes 10+11+12+13;
+    # both observe the same global sum — proof the psum crossed processes.
+    for r in worker_results:
+        assert r["psum_total"] == pytest.approx(52.0)
+
+
+def test_host_shard_indices_disjoint_covering(worker_results):
+    a, b = (set(r["shard_indices"]) for r in worker_results)
+    assert a.isdisjoint(b)
+    assert a | b == set(range(NUM_PARTITIONS))
+
+
+def test_host_shard_dataframe_partitions_rows(worker_results):
+    n_rows = 4 * NUM_PARTITIONS - 1
+    a, b = (set(r["rows"]) for r in worker_results)
+    assert a and b
+    assert a.isdisjoint(b)
+    assert a | b == set(range(n_rows))

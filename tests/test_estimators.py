@@ -263,6 +263,22 @@ class TestEvaluators:
         assert ev.evaluate(self._df()) == pytest.approx(expected, rel=1e-5)
         assert not ev.isLargerBetter()
 
+    def test_loss_evaluator_rejects_class_label_column(self):
+        """Pointing LossEvaluator at a class-label column (e.g.
+        LogisticRegressionModel's predictionCol) must error, not return
+        a plausible-looking garbage loss."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.frame import DataFrame
+        batch = pa.RecordBatch.from_pylist(
+            [{"prediction": 2.0, "label": 2},
+             {"prediction": 0.0, "label": 0},
+             {"prediction": 1.0, "label": 2}])
+        df = DataFrame.from_batches([batch])
+        ev = LossEvaluator(predictionCol="prediction", labelCol="label")
+        with pytest.raises(ValueError, match="class labels"):
+            ev.evaluate(df)
+
 
 class TestTargetPrep:
     def test_int_labels_one_hot(self):

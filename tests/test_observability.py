@@ -103,10 +103,15 @@ class TestLogisticRegression:
         model = lr.fit(df)
         assert model.numClasses == 2
         assert model.objectiveHistory[-1] < model.objectiveHistory[0]
-        probs = model.transform(df).tensor("prediction")
+        out = model.transform(df)
+        probs = out.tensor("probability")
         acc = np.mean(probs.argmax(-1) == y)
         assert acc >= 0.95
         assert np.allclose(probs.sum(-1), 1.0, atol=1e-5)
+        # predictionCol is the class label as float64 (Spark convention)
+        preds = np.asarray([r["prediction"] for r in out.collect_rows()])
+        assert preds.dtype == np.float64
+        np.testing.assert_array_equal(preds, probs.argmax(-1))
 
     def test_transform_time_param_override(self):
         """model.transform(df, {param: value}) must honor the override
@@ -202,7 +207,7 @@ class TestTransferLearningPipeline:
         ])
         model = pipe.fit(labeled)
         out = model.transform(labeled)
-        probs = out.tensor("prediction")
+        probs = out.tensor("probability")
         assert probs.shape == (n, 2)
         ev = ClassificationEvaluator(predictionCol="prediction",
                                      labelCol="label")

@@ -212,3 +212,45 @@ class TestGraphUtils:
         x = np.arange(6, dtype=np.float32).reshape(2, 3)
         np.testing.assert_allclose(
             np.asarray(back({"inp": x})["out"]), x * 2.0)
+
+    def test_select_outputs_prunes(self):
+        def two_headed(x):
+            return {"a": x + 1.0, "b": x * 3.0}
+
+        mf = ModelFunction(
+            lambda p, d: two_headed(d["inp"]), None,
+            input_signature={"inp": ((3,), np.dtype(np.float32))},
+            output_names=["a", "b"], name="two")
+        pruned = tfx.select_outputs(mf, ["b"])
+        assert pruned.output_names == ["b"]
+        x = np.ones((2, 3), np.float32)
+        out = pruned({"inp": x})
+        assert set(out) == {"b"}
+        np.testing.assert_allclose(np.asarray(out["b"]), x * 3.0)
+        with pytest.raises(ValueError, match="not in model"):
+            tfx.select_outputs(mf, ["bogus"])
+        with pytest.raises(ValueError, match="at least one"):
+            tfx.select_outputs(mf, [])
+
+    def test_with_preprocessor_fuses(self):
+        mf = self._mf()
+        pre = tfx.with_preprocessor(
+            mf, lambda ins: {"inp": ins["inp"] + 10.0})
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(pre({"inp": x})["out"]), (x + 10.0) * 2.0)
+        # composed program still exports to StableHLO (deploy form)
+        blob = tfx.strip_and_freeze(pre)
+        back = tfx.load_frozen(blob)
+        np.testing.assert_allclose(
+            np.asarray(back({"inp": x})["out"]), (x + 10.0) * 2.0)
+
+    def test_with_postprocessor_infers_names(self):
+        mf = self._mf()
+        post = tfx.with_postprocessor(
+            mf, lambda outs: {"flat": outs["out"].reshape(
+                outs["out"].shape[0], -1).sum(axis=1)})
+        assert post.output_names == ["flat"]
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(post({"inp": x})["flat"]), (x * 2.0).sum(axis=1))

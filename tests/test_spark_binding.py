@@ -1,0 +1,91 @@
+"""Contract test for the Spark binding's pure half (VERDICT r1 missing #4).
+
+pyspark is absent in this environment, but ``plan_to_map_in_arrow`` is
+pure: it compiles a stage plan into the exact
+``iterator[RecordBatch] → iterator[RecordBatch]`` function Spark's
+``DataFrame.mapInArrow`` calls on each executor. These tests drive that
+function with a hand-built iterator — the executor's calling
+convention — over a real decode→pack→apply plan and assert row-level
+parity with ``LocalEngine`` output (reference role: the whole upstream
+repo WAS this binding; SURVEY §7 "the seam must be clean enough that the
+Spark binding is mechanical").
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data.frame import DataFrame, Stage
+from sparkdl_tpu.data.spark_binding import SparkEngine, plan_to_map_in_arrow
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.transformers.image_transform import ImageTransformer
+
+
+@pytest.fixture(scope="module")
+def featurized(image_dir):
+    """An images frame with the full production plan: decode (host) →
+    pack/resize (host) → jitted model apply (device)."""
+    from sparkdl_tpu.models.zoo import getModelFunction
+
+    df = imageIO.readImages(image_dir, numPartitions=3,
+                            dropImageFailures=True)
+    mf = getModelFunction("TestNet", featurize=True)
+    out = ImageTransformer(
+        inputCol="image", outputCol="features",
+        modelFunction=mf).transform(df)
+    return out
+
+
+def _executor_outputs(df: DataFrame) -> list:
+    """Run df's plan the way a Spark executor would: one mapInArrow
+    function instance per task, fed an iterator of the task's batches."""
+    fn = plan_to_map_in_arrow(df._plan)
+    outs = []
+    for source in df._sources:
+        outs.extend(fn(iter([source.load()])))
+    return outs
+
+
+def test_binding_matches_local_engine(featurized):
+    expected = featurized.collect()
+    got = pa.Table.from_batches(_executor_outputs(featurized))
+    assert got.schema.equals(expected.schema)
+    a = np.stack(got.column("features").to_pylist())
+    b = np.stack(expected.column("features").to_pylist())
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    assert got.column("filePath").to_pylist() == \
+        expected.column("filePath").to_pylist()
+
+
+def test_binding_streams_multiple_batches_per_task(featurized):
+    """Spark hands mapInArrow MANY batches per task; the compiled fn must
+    apply the plan per batch and preserve order within the iterator."""
+    fn = plan_to_map_in_arrow(featurized._plan)
+    batches = [s.load() for s in featurized._sources]
+    outs = list(fn(iter(batches)))
+    assert len(outs) == len(batches)
+    expected = featurized.collect()
+    got = pa.Table.from_batches(outs)
+    assert got.column("filePath").to_pylist() == \
+        expected.column("filePath").to_pylist()
+
+
+def test_binding_honors_with_index_stages():
+    """with_index stages receive the Spark partition id (0 without a
+    TaskContext — exactly what a driver-local plan sees)."""
+    batch = pa.RecordBatch.from_pylist([{"x": 1}, {"x": 2}])
+
+    seen = []
+
+    def tag(b, index):
+        seen.append(index)
+        return b
+
+    fn = plan_to_map_in_arrow([Stage(tag, with_index=True, name="tag")])
+    list(fn(iter([batch])))
+    assert seen == [0]
+
+
+def test_spark_engine_requires_pyspark():
+    with pytest.raises(RuntimeError, match="pyspark"):
+        SparkEngine()
