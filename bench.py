@@ -1,15 +1,27 @@
 """North-star benchmark: InceptionV3 DeepImageFeaturizer throughput.
 
-Measures images/sec/chip for the full device program (uint8 NHWC infeed
-→ fused preprocess → InceptionV3 → 2048-d features) through the
-production ``BatchRunner`` on whatever accelerator is attached (the one
-real TPU chip under the driver; CPU as fallback).
+Reports, in ONE JSON line (driver contract):
 
-``vs_baseline`` compares against the BASELINE.json north-star of 10,000
-images/sec aggregate on v5e-8 == 1,250 images/sec/chip under linear DP
-scaling (see BASELINE.md "Unit note").
+* ``value`` — end-to-end host-fed images/sec/chip through the
+  production ``BatchRunner`` (uint8 NHWC host arrays in, 2048-d
+  features out; preprocess fused into the same XLA program). This is
+  the north-star metric's shape.
+* ``device_resident_ips`` / ``device_tflops`` — the same program timed
+  with device-resident input and a forced-sync readback: the chip's
+  compute-side capability with host↔device transfer excluded.
+* ``link_h2d_MBps`` / ``link_d2h_MBps`` — measured host↔device
+  bandwidth, and ``host_fed_ceiling_ips`` — the hard upper bound the
+  link imposes on ANY host-fed pipeline (bandwidth ÷ bytes/image).
 
-Prints exactly ONE JSON line.
+Separating these is the point (round-1 lesson): on a tunneled TPU the
+link moves ~10-25 MB/s, capping end-to-end at ~40-90 img/s regardless
+of the device program, while the device program itself runs thousands
+of img/s. ``vs_baseline`` stays honest (end-to-end vs the 1,250
+img/s/chip target = 10k/s ÷ 8 chips, BASELINE.md) and the extra keys
+attribute any gap to link vs compute.
+
+Sync methodology: ``jax.block_until_ready`` returns at enqueue on the
+tunneled platform, so timing forces a tiny dependent readback instead.
 """
 
 from __future__ import annotations
@@ -21,6 +33,55 @@ import time
 import numpy as np
 
 PER_CHIP_TARGET = 1250.0  # 10k img/s ÷ 8 chips (BASELINE.md)
+INCEPTION_GFLOPS = 11.5   # fwd FLOPs per 299x299 image (SURVEY §6)
+
+
+def _sync(x) -> float:
+    """Force completion of everything ``x`` depends on via a 1-element
+    dependent readback (reliable where block_until_ready is not)."""
+    import jax.numpy as jnp
+    return float(jnp.reshape(x, (-1,))[0].astype(jnp.float32))
+
+
+def measure_link(n_mb: int) -> dict:
+    import jax
+
+    x = np.random.default_rng(0).integers(
+        0, 255, size=(n_mb * 1024 * 1024,), dtype=np.uint8)
+    _sync(jax.device_put(x[:1024]).sum())  # warm the path
+    t0 = time.perf_counter()
+    d = jax.device_put(x)
+    _sync(d.sum())  # the sum can't run before the transfer lands
+    up = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    h = jax.device_get(d)
+    down = time.perf_counter() - t0
+    assert h[0] == x[0]
+    return {"h2d_MBps": round(n_mb / up, 1),
+            "d2h_MBps": round(n_mb / down, 1)}
+
+
+def measure_device_resident(mf, batch_size: int, n_batches: int) -> dict:
+    """Compute-side img/s with input already in HBM: no host transfer
+    inside the timed region."""
+    import jax
+
+    fn = mf.jitted()
+    params = mf.device_params()
+    x = np.random.default_rng(1).integers(
+        0, 255, size=(batch_size, 299, 299, 3), dtype=np.uint8)
+    dx = {"image": jax.device_put(x)}
+    _sync(fn(params, dx)["features"])  # compile + warm
+
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_batches):
+        out = fn(params, dx)
+    _sync(out["features"])
+    dt = time.perf_counter() - t0
+    ips = batch_size * n_batches / dt
+    return {"ips": round(ips, 1),
+            "tflops": round(ips * INCEPTION_GFLOPS / 1000.0, 2)}
 
 
 def main() -> None:
@@ -31,22 +92,23 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    batch_size = 256 if on_tpu else 16
-    n_rows = batch_size * (8 if on_tpu else 2)
+    batch_size = 256 if on_tpu else 8
+    n_rows = batch_size * (4 if on_tpu else 2)
+
+    mf = getModelFunction("InceptionV3", featurize=True)
+    link = measure_link(32 if on_tpu else 8)
+    device = measure_device_resident(mf, batch_size,
+                                     n_batches=4 if on_tpu else 2)
 
     rng = np.random.default_rng(0)
     images = rng.integers(0, 255, size=(n_rows, 299, 299, 3),
                           dtype=np.uint8)
-
-    mf = getModelFunction("InceptionV3", featurize=True)
     runner = BatchRunner(mf, batch_size=batch_size)
+    runner.run({"image": images[:batch_size]})  # steady-state warmup
 
-    # Warmup: compile + one full pass so caches/transfers are steady.
-    runner.run({"image": images[: batch_size * 2]})
-
-    # Median of 3 passes: host->device link throughput varies several-x
-    # between minutes in shared environments; the median is robust to
-    # one contended pass without overstating sustained throughput.
+    # Median of 3 passes: the tunneled link's throughput varies
+    # several-x between minutes; the median is robust to one contended
+    # pass without overstating sustained throughput.
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -55,12 +117,27 @@ def main() -> None:
         assert out["features"].shape == (n_rows, 2048), \
             out["features"].shape
         rates.append(n_rows / elapsed)
-    ips = float(np.median(rates))
+    e2e_ips = float(np.median(rates))
+
+    image_mb = 299 * 299 * 3 / (1024.0 * 1024.0)  # uint8 NHWC on the wire
+    ceiling = link["h2d_MBps"] / image_mb
     print(json.dumps({
-        "metric": f"images_per_sec_per_chip_inceptionv3_featurize[{platform}]",
-        "value": round(ips, 1),
+        "metric": (f"images_per_sec_per_chip_inceptionv3_featurize"
+                   f"[{platform}]"),
+        "value": round(e2e_ips, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips / PER_CHIP_TARGET, 3),
+        "vs_baseline": round(e2e_ips / PER_CHIP_TARGET, 3),
+        "device_resident_ips": device["ips"],
+        "device_tflops": device["tflops"],
+        "vs_baseline_device_resident": round(
+            device["ips"] / PER_CHIP_TARGET, 3),
+        "link_h2d_MBps": link["h2d_MBps"],
+        "link_d2h_MBps": link["d2h_MBps"],
+        "host_fed_ceiling_ips": round(ceiling, 1),
+        "runner_strategy": runner.strategy,
+        "note": ("end-to-end is host-link-bound when value ~= "
+                 "host_fed_ceiling_ips; device_resident_ips is the "
+                 "chip's compute capability with transfers excluded"),
     }))
 
 

@@ -87,11 +87,13 @@ class LossEvaluator(Evaluator):
             dataset, self.getOrDefault("predictionCol"),
             self.getOrDefault("labelCol"))
         if (preds.ndim == 1 and len(preds)
-                and np.all(preds == np.round(preds))
-                and preds.max(initial=0.0) > 1.0):
-            # class-label column (e.g. LogisticRegressionModel's
-            # predictionCol) — cross-entropy on labels is meaningless;
-            # fail loudly instead of returning a plausible number
+                and np.all(preds == np.round(preds))):
+            # All-integral 1-D values are a class-label column (e.g.
+            # LogisticRegressionModel's predictionCol) — including the
+            # BINARY case, where every value is 0.0/1.0: a real sigmoid
+            # output is never exactly integral across a whole column.
+            # Cross-entropy on labels is meaningless; fail loudly
+            # instead of returning a plausible number.
             raise ValueError(
                 f"column {self.getOrDefault('predictionCol')!r} holds "
                 "integer class labels, not probabilities; point "

@@ -128,18 +128,21 @@ def with_postprocessor(mf: ModelFunction, fn,
 
     out_names = output_names_out
     if out_names is None:
+        if mf.backend != "jax":
+            # A host model can't be shape-traced; inferring names would
+            # mean running the full model on a zero batch at wrap time
+            # (slow, and crashes models that reject all-zero input).
+            raise ValueError(
+                f"host-backend model {mf.name!r}: pass "
+                "output_names_out explicitly (name inference would "
+                "execute the model at wrap time)")
         import jax
-        import numpy as np
         probe = {
             k: jax.ShapeDtypeStruct((1,) + tuple(
                 d if d is not None else 1 for d in shape), dtype)
             for k, (shape, dtype) in mf.input_signature.items()}
-        if mf.backend == "jax":
-            out = jax.eval_shape(lambda p, x: apply_fn(p, x),
-                                 mf.params, probe)
-        else:  # host models can't be traced; run a 1-row zero batch
-            out = apply_fn(mf.params, {
-                k: np.zeros(s.shape, s.dtype) for k, s in probe.items()})
+        out = jax.eval_shape(lambda p, x: apply_fn(p, x),
+                             mf.params, probe)
         out_names = list(out)
 
     return ModelFunction(

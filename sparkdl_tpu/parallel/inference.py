@@ -23,7 +23,6 @@ from jax.sharding import Mesh
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from sparkdl_tpu.runtime.runner import (
-    MAX_INFLIGHT_BATCHES,
     RunnerMetrics,
     check_row_counts,
     drain_bounded,
@@ -41,7 +40,9 @@ class ShardedBatchRunner:
 
     def __init__(self, model_fn: ModelFunction, mesh: Optional[Mesh] = None,
                  batch_size: int = 64,
-                 metrics: Optional[RunnerMetrics] = None):
+                 metrics: Optional[RunnerMetrics] = None,
+                 strategy: Optional[str] = None,
+                 max_inflight: Optional[int] = None):
         if model_fn.backend != "jax":
             raise ValueError(
                 f"sharded execution requires a jax backend, got "
@@ -55,6 +56,12 @@ class ShardedBatchRunner:
             devices=jax.local_devices())
         self.batch_size = batch_size
         self.metrics = metrics or RunnerMetrics()
+        # same measured strategy selection + validation as BatchRunner
+        # (runner.py module docstring): immediate drain on tunneled
+        # devices, bounded async dispatch on direct-attached ones
+        from sparkdl_tpu.runtime.runner import resolve_strategy
+        self.strategy, self.max_inflight = resolve_strategy(
+            strategy, max_inflight)
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
 
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -78,7 +85,7 @@ class ShardedBatchRunner:
         for valid, chunk in iter_padded_chunks(inputs, n, gb):
             pending.append((valid, fn(params, chunk)))
             batches += 1
-            drain_bounded(pending, outs, MAX_INFLIGHT_BATCHES)
+            drain_bounded(pending, outs, self.max_inflight)
         drain_bounded(pending, outs, 0)
         out = {k: np.concatenate(v) for k, v in outs.items()}
         self.metrics.add(n, batches, time.perf_counter() - t0)

@@ -231,6 +231,16 @@ class TypeConverters:
         return value
 
     @staticmethod
+    def toIntPairOrNone(value):
+        if value is None:
+            return None
+        value = TypeConverters.toList(value)
+        if len(value) != 2:
+            raise TypeError(f"expected (h, w) pair, got {value!r}")
+        return (TypeConverters.toInt(value[0]),
+                TypeConverters.toInt(value[1]))
+
+    @staticmethod
     def toCallable(value):
         if callable(value):
             return value

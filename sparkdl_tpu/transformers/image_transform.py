@@ -22,7 +22,9 @@ from sparkdl_tpu.params import (
     HasOutputCol,
     HasOutputMode,
     HasUseMesh,
+    Param,
     Transformer,
+    TypeConverters,
     keyword_only,
 )
 from sparkdl_tpu.runtime.runner import RunnerMetrics
@@ -34,16 +36,33 @@ _PACKED_COL = "__sparkdl_tpu_packed__"
 class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
                        HasModelFunction, HasOutputMode, HasBatchSize,
                        HasUseMesh):
-    """Applies a single-input ModelFunction to an image struct column."""
+    """Applies a single-input ModelFunction to an image struct column.
+
+    ``deviceResizeFrom=(H, W)`` moves the resize onto the accelerator:
+    the host packs images at their uniform native H×W (zero-copy when
+    contiguous — no host resampling at all) and a bilinear
+    ``jax.image.resize`` to the model's input size is fused into the
+    SAME XLA program as cast/preprocess/model. Use it when the dataset
+    is uniformly sized; host CPUs then only decode. Default (None) keeps
+    the reference-equivalent host resize (C++ shim / PIL)."""
+
+    deviceResizeFrom = Param(
+        "ImageTransformer", "deviceResizeFrom",
+        "(h, w) the images actually have; pack at that size and resize "
+        "on-device inside the model's XLA program (None = resize on "
+        "host)", TypeConverters.toIntPairOrNone)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFunction=None,
-                 outputMode="vector", batchSize=64, useMesh=False):
+                 outputMode="vector", batchSize=64, useMesh=False,
+                 deviceResizeFrom=None):
         super().__init__()
-        self._setDefault(outputMode="vector", batchSize=64, useMesh=False)
+        self._setDefault(outputMode="vector", batchSize=64, useMesh=False,
+                         deviceResizeFrom=None)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelFunction=modelFunction, outputMode=outputMode,
-                  batchSize=batchSize, useMesh=useMesh)
+                  batchSize=batchSize, useMesh=useMesh,
+                  deviceResizeFrom=deviceResizeFrom)
         self.metrics = RunnerMetrics()
 
     def _input_hwc(self):
@@ -62,6 +81,14 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
         in_col = self.getInputCol()
         out_col = self.getOutputCol()
         mode = self.getOutputMode()
+        src_hw = self.getOrDefault("deviceResizeFrom")
+        if src_hw is not None:
+            wrapped = tfr_utils.deviceResizeModel(mf, src_hw)
+            if wrapped is mf:
+                src_hw = None  # (h, w) == model input: plain host path
+            else:
+                mf = wrapped
+                (h, w, c), in_dtype = mf.input_signature[in_name]
         runner = tfr_utils.make_runner(mf, self.getBatchSize(),
                                        use_mesh=self.getUseMesh(),
                                        metrics=self.metrics)
@@ -69,7 +96,10 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
         def pack(batch: pa.RecordBatch) -> pa.RecordBatch:
             from sparkdl_tpu.data.frame import column_index
             idx = column_index(batch, in_col)
-            arr = tfr_utils.packImageBatch(batch.column(idx), h, w, c)
+            # With device resize the host must NOT resample — rows are
+            # required to already be (h, w), loudly.
+            arr = tfr_utils.packImageBatch(batch.column(idx), h, w, c,
+                                           resize=src_hw is None)
             if np.dtype(in_dtype) != np.uint8:
                 arr = arr.astype(in_dtype)
             return append_tensor_column(batch, _PACKED_COL, arr)

@@ -45,14 +45,20 @@ class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
     for transfer learning (reference ``DeepImageFeaturizer``; its output
     feeds e.g. a logistic regression)."""
 
+    deviceResizeFrom = Param(
+        "DeepImageFeaturizer", "deviceResizeFrom",
+        "(h, w) of the (uniformly sized) input images; resize to the "
+        "model's input size on-device instead of on host",
+        TypeConverters.toIntPairOrNone)
+
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
-                 batchSize=64, useMesh=False):
+                 batchSize=64, useMesh=False, deviceResizeFrom=None):
         super().__init__()
-        self._setDefault(batchSize=64, useMesh=False)
+        self._setDefault(batchSize=64, useMesh=False, deviceResizeFrom=None)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelName=modelName, batchSize=batchSize,
-                  useMesh=useMesh)
+                  useMesh=useMesh, deviceResizeFrom=deviceResizeFrom)
         self.metrics = None
 
     def _transform(self, dataset):
@@ -61,7 +67,8 @@ class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
         inner = ImageTransformer(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize(), useMesh=self.getUseMesh())
+            batchSize=self.getBatchSize(), useMesh=self.getUseMesh(),
+            deviceResizeFrom=self.getOrDefault("deviceResizeFrom"))
         self.metrics = inner.metrics
         return inner.transform(dataset)
 

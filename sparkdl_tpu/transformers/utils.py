@@ -124,6 +124,44 @@ def make_runner(model_fn, batch_size: int, use_mesh: bool = False,
     return BatchRunner(model_fn, batch_size, metrics=metrics)
 
 
+def deviceResizeModel(model_fn, src_hw: Tuple[int, int]):
+    """Wrap a single-image-input ModelFunction so bilinear resize from
+    ``src_hw`` to the model's native input size runs ON DEVICE, fused
+    into the model's XLA program.
+
+    The host then packs images at their uniform native size (zero-copy
+    view when contiguous) and never resamples — the TPU-first inversion
+    of the reference's JVM-side ``ImageUtils.resizeImage`` host step.
+    Resize happens in float32, then rounds back to the model's declared
+    input dtype so the downstream preprocess sees exactly what a host
+    resize would have produced.
+    """
+    import jax.numpy as jnp
+
+    in_name, _ = single_io(model_fn)
+    (h, w, c), in_dtype = model_fn.input_signature[in_name]
+    sh, sw = int(src_hw[0]), int(src_hw[1])
+    if (sh, sw) == (h, w):
+        return model_fn
+
+    def resize(inputs):
+        import jax
+        x = inputs[in_name]
+        y = jax.image.resize(x.astype(jnp.float32),
+                             (x.shape[0], h, w, c), method="bilinear")
+        if np.dtype(in_dtype) == np.uint8:
+            y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
+        else:
+            y = y.astype(in_dtype)
+        return {in_name: y}
+
+    from sparkdl_tpu.graph.utils import with_preprocessor
+    return with_preprocessor(
+        model_fn, resize,
+        input_signature={in_name: ((sh, sw, c), in_dtype)},
+        name=f"resize({sh}x{sw})+{model_fn.name}")
+
+
 def single_io(model_fn) -> Tuple[str, str]:
     """Validate single-input/single-output and return (in_name, out_name)."""
     ins = model_fn.input_names

@@ -1,0 +1,41 @@
+"""Subprocess environment sanitization for CPU-only JAX workers.
+
+The build/test host reaches its TPU through a tunnel whose sitecustomize
+(injected via PYTHONPATH) registers the device plugin at interpreter
+start and overrides JAX_PLATFORMS through jax.config — so a subprocess
+that must run on plain CPU (virtual-device meshes, multi-process
+jax.distributed tests, the driver's multichip dryrun) needs the tunnel's
+environment stripped, not just JAX_PLATFORMS set. One shared helper so
+every spawner strips the same set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Prefixes/names that mark the device tunnel's environment; grown here
+# (only here) when the tunnel adds markers.
+_TUNNEL_PREFIXES = ("PALLAS_", "AXON", "TPU_")
+
+
+def sanitized_cpu_env(pythonpath: Optional[str] = None,
+                      n_devices: Optional[int] = None) -> Dict[str, str]:
+    """A copy of os.environ prepared for a CPU-only JAX subprocess:
+    tunnel vars and PYTHONPATH stripped, ``JAX_PLATFORMS=cpu``, and —
+    when ``n_devices`` is given — the virtual host-device-count XLA flag
+    (replacing any inherited one)."""
+    env = {k: v for k, v in os.environ.items()
+           if not (k == "PYTHONPATH"
+                   or any(k.startswith(p) for p in _TUNNEL_PREFIXES))}
+    if pythonpath is not None:
+        env["PYTHONPATH"] = pythonpath
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append(
+            f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

@@ -32,14 +32,10 @@ def _free_port() -> int:
 
 def _clean_env() -> dict:
     """Strip the axon TPU-tunnel sitecustomize and device overrides so
-    the workers get a plain multi-process CPU runtime."""
-    env = {k: v for k, v in os.environ.items()
-           if not (k.startswith("PALLAS_") or k.startswith("AXON")
-                   or k.startswith("TPU_") or k == "PYTHONPATH")}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["PYTHONPATH"] = REPO_ROOT
-    return env
+    the workers get a plain multi-process CPU runtime (shared helper —
+    the same sanitization the driver's multichip dryrun uses)."""
+    from sparkdl_tpu.utils.hostenv import sanitized_cpu_env
+    return sanitized_cpu_env(pythonpath=REPO_ROOT, n_devices=4)
 
 
 @pytest.fixture(scope="module")

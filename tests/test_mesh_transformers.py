@@ -45,6 +45,16 @@ class TestUseMesh:
         b = sharded.transform(image_df).tensor("f")
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
+    def test_device_resize_mesh_matches_single_device(self, image_df):
+        """deviceResizeFrom + useMesh: the fused resize+model program
+        shards over the data axis like any other model program."""
+        kw = dict(modelName="TestNet", inputCol="image", outputCol="f",
+                  batchSize=2, deviceResizeFrom=(20, 24))
+        a = DeepImageFeaturizer(**kw).transform(image_df).tensor("f")
+        b = DeepImageFeaturizer(useMesh=True, **kw) \
+            .transform(image_df).tensor("f")
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
     def test_tensor_transformer_mesh(self):
         mf = ModelFunction.fromSingle(
             lambda x: x * 3.0, None, input_shape=(4,), name="triple")

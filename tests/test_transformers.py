@@ -48,6 +48,57 @@ class TestImageTransformer:
         np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
         assert t.metrics.rows == 5
 
+    def test_device_resize_matches_device_oracle(self, tmp_path):
+        """deviceResizeFrom packs at the native size and resizes inside
+        the model's XLA program; output must equal applying the model to
+        jax.image.resize of the native batch (exact same math)."""
+        import jax
+        import jax.numpy as jnp
+        from PIL import Image
+
+        rng = np.random.default_rng(11)
+        d = tmp_path / "uniform"
+        d.mkdir()
+        native = rng.integers(0, 255, (6, 48, 64, 3), dtype=np.uint8)
+        for i, arr in enumerate(native):
+            Image.fromarray(arr, "RGB").save(d / f"u{i}.png")
+        df = imageIO.readImages(str(d), numPartitions=2)
+
+        mf = zoo.getModelFunction("TestNet")
+        t = ImageTransformer(inputCol="image", outputCol="features",
+                             modelFunction=mf, batchSize=3,
+                             deviceResizeFrom=(48, 64))
+        got = t.transform(df).tensor("features")
+
+        resized = jax.image.resize(
+            jnp.asarray(native, jnp.float32), (6, 32, 32, 3),
+            method="bilinear")
+        resized = np.asarray(
+            jnp.clip(jnp.round(resized), 0, 255).astype(jnp.uint8))
+        expected = np.asarray(mf(resized))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_device_resize_rejects_mixed_sizes(self, image_df):
+        mf = zoo.getModelFunction("TestNet")
+        t = ImageTransformer(inputCol="image", outputCol="features",
+                             modelFunction=mf,
+                             deviceResizeFrom=(48, 64))
+        with pytest.raises(ValueError, match="48, 64"):
+            t.transform(image_df).collect()
+
+    def test_device_resize_noop_when_sizes_match(self, image_df):
+        """(h, w) equal to the model input size degrades to the plain
+        host-packed path (still works on mixed-size input)."""
+        mf = zoo.getModelFunction("TestNet")
+        t = ImageTransformer(inputCol="image", outputCol="features",
+                             modelFunction=mf, deviceResizeFrom=(32, 32))
+        base = ImageTransformer(inputCol="image", outputCol="features",
+                                modelFunction=mf)
+        np.testing.assert_allclose(
+            t.transform(image_df).tensor("features"),
+            base.transform(image_df).tensor("features"),
+            rtol=1e-5, atol=1e-6)
+
     def test_image_output_mode(self, image_df):
         def invert(x):
             return 255.0 - x.astype("float32")
