@@ -88,17 +88,27 @@ class LossEvaluator(Evaluator):
             self.getOrDefault("labelCol"))
         if (preds.ndim == 1 and len(preds)
                 and np.all(preds == np.round(preds))):
-            # All-integral 1-D values are a class-label column (e.g.
-            # LogisticRegressionModel's predictionCol) — including the
-            # BINARY case, where every value is 0.0/1.0: a real sigmoid
-            # output is never exactly integral across a whole column.
-            # Cross-entropy on labels is meaningless; fail loudly
-            # instead of returning a plausible number.
-            raise ValueError(
-                f"column {self.getOrDefault('predictionCol')!r} holds "
-                "integer class labels, not probabilities; point "
-                "LossEvaluator(predictionCol=...) at the probability "
-                "vector column (e.g. 'probability')")
+            if preds.max(initial=0.0) > 1.0:
+                # Values above 1 are definitely class labels (e.g.
+                # LogisticRegressionModel's predictionCol) —
+                # cross-entropy on labels is meaningless; fail loudly
+                # instead of returning a plausible number.
+                raise ValueError(
+                    f"column {self.getOrDefault('predictionCol')!r} "
+                    "holds integer class labels, not probabilities; "
+                    "point LossEvaluator(predictionCol=...) at the "
+                    "probability vector column (e.g. 'probability')")
+            # All values exactly 0.0/1.0 is ambiguous: binary class
+            # labels (garbage loss) or a fully saturated sigmoid in
+            # float32 (legitimate). Warn instead of crashing a scoring
+            # loop.
+            import logging
+            logging.getLogger(__name__).warning(
+                "LossEvaluator: column %r contains only exact 0.0/1.0 "
+                "values — if these are class labels rather than "
+                "saturated probabilities, this loss is meaningless; "
+                "point predictionCol at the probability column",
+                self.getOrDefault("predictionCol"))
         preds = np.clip(preds, 1e-7, 1.0 - 1e-7)
         if preds.ndim > 1 and preds.shape[-1] == 1:
             preds = preds[..., 0]  # (N,1) sigmoid outputs → binary

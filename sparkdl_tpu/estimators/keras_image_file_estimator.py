@@ -88,6 +88,42 @@ def _resolve_loss(loss) -> Callable:
     return fn
 
 
+def _config_fingerprint_bytes(est) -> bytes:
+    """Hyperparameter identity for checkpoint fingerprints. ``epochs``
+    is deliberately EXCLUDED: it is the training budget, not the run's
+    identity — an interrupted 2-epoch run extended to 4 epochs must
+    resume the same checkpoints, not start a fresh directory."""
+    fit_params = {k: v for k, v in est.getKerasFitParams().items()
+                  if k != "epochs"}
+    return (repr(sorted(fit_params.items()))
+            + repr(est.getKerasLoss())
+            + repr(est.getOrDefault("kerasOptimizer"))
+            + est.getModelFile()).encode()
+
+
+def _make_step(model, loss_fn, tx):
+    """One SGD step over a static-shape batch (shared by the in-memory
+    and streaming trainers)."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(trainable, non_trainable, opt_state, xb, yb):
+        def scalar_loss(tr):
+            preds, new_nt = model.stateless_call(
+                tr, non_trainable, xb, training=True)
+            if isinstance(preds, (list, tuple)):
+                preds = preds[0]
+            return jnp.mean(loss_fn(preds, yb)), new_nt
+
+        (loss, new_nt), grads = jax.value_and_grad(
+            scalar_loss, has_aux=True)(trainable)
+        updates, opt_state2 = tx.update(grads, opt_state, trainable)
+        return (jax.tree.map(lambda p, u: p + u, trainable, updates),
+                new_nt, opt_state2, loss)
+
+    return step
+
+
 def _resolve_optimizer(opt, fit_params: dict):
     """Optimizer name/transform → optax GradientTransformation."""
     import optax
@@ -197,25 +233,32 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         "orbax checkpoint directory: training state saves per epoch and "
         "an interrupted fit resumes from the last epoch (the reference "
         "restarted from scratch, SURVEY §5)", TypeConverters.toString)
+    streaming = Param(
+        "KerasImageFileEstimator", "streaming",
+        "train by streaming decoded partitions through the engine "
+        "instead of collecting (X, y) into driver memory — removes the "
+        "reference's dataset-must-fit-in-driver cliff (SURVEY §3.4) at "
+        "the cost of re-decoding each epoch", TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, labelCol=None,
                  modelFile=None, imageLoader=None, kerasOptimizer="adam",
                  kerasLoss="categorical_crossentropy", kerasFitParams=None,
                  outputMode="vector", batchSize=64, parallelism=2,
-                 useMesh=True, checkpointDir=None):
+                 useMesh=True, checkpointDir=None, streaming=False):
         super().__init__()
         self._setDefault(kerasOptimizer="adam",
                          kerasLoss="categorical_crossentropy",
                          kerasFitParams={"epochs": 1, "batch_size": 32},
                          outputMode="vector", batchSize=64, parallelism=2,
-                         useMesh=True)
+                         useMesh=True, streaming=False)
         self._set(inputCol=inputCol, outputCol=outputCol, labelCol=labelCol,
                   modelFile=modelFile, imageLoader=imageLoader,
                   kerasOptimizer=kerasOptimizer, kerasLoss=kerasLoss,
                   kerasFitParams=kerasFitParams, outputMode=outputMode,
                   batchSize=batchSize, parallelism=parallelism,
-                  useMesh=useMesh, checkpointDir=checkpointDir)
+                  useMesh=useMesh, checkpointDir=checkpointDir,
+                  streaming=streaming)
 
     # -- validation (reference _validateParams) -----------------------------
 
@@ -258,15 +301,30 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         weights."""
         import hashlib
         h = hashlib.sha256()
-        h.update(repr(sorted(est.getKerasFitParams().items())).encode())
-        h.update(repr(est.getKerasLoss()).encode())
-        h.update(repr(est.getOrDefault("kerasOptimizer")).encode())
-        h.update(est.getModelFile().encode())
+        h.update(_config_fingerprint_bytes(est))
         h.update(repr((X.shape, str(X.dtype))).encode())
         h.update(np.ascontiguousarray(y).tobytes())
         stride = max(1, len(X) // 16)
         h.update(np.ascontiguousarray(X[::stride]).tobytes())
         return h.hexdigest()[:16]
+
+    def _setup_trial(self):
+        """Load the trial's own model copy (reference: each Spark task
+        deserialized the .h5, so concurrent trials never share state)
+        and build loss/optimizer/initial state."""
+        import keras
+
+        if keras.backend.backend() != "jax":
+            raise RuntimeError("KerasImageFileEstimator requires "
+                               "KERAS_BACKEND=jax")
+        model = keras.models.load_model(self.getModelFile(), compile=False)
+        loss_fn = _resolve_loss(self.getKerasLoss())
+        tx = _resolve_optimizer(self.getKerasOptimizer(),
+                                self.getKerasFitParams())
+        trainable = [v.value for v in model.trainable_variables]
+        non_trainable = [v.value for v in model.non_trainable_variables]
+        opt_state = tx.init(trainable)
+        return model, loss_fn, tx, trainable, non_trainable, opt_state
 
     def _trainOne(self, X: np.ndarray, y: np.ndarray, paramMap: dict,
                   checkpoint_tag: str = "fit") -> KerasImageFileModel:
@@ -288,36 +346,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
 
-        if keras.backend.backend() != "jax":
-            raise RuntimeError("KerasImageFileEstimator requires "
-                               "KERAS_BACKEND=jax")
-        # Each trial loads its own model copy (reference: each Spark task
-        # deserialized the .h5), so concurrent trials never share state.
-        model = keras.models.load_model(est.getModelFile(), compile=False)
-        loss_fn = _resolve_loss(est.getKerasLoss())
-        tx = _resolve_optimizer(est.getKerasOptimizer(), fit_params)
-
+        model, loss_fn, tx, trainable, non_trainable, opt_state = \
+            est._setup_trial()
         n_out = int(model.outputs[0].shape[-1])
         targets = self._prepare_targets(y, est.getKerasLoss(), n_out)
 
-        trainable = [v.value for v in model.trainable_variables]
-        non_trainable = [v.value for v in model.non_trainable_variables]
-        opt_state = tx.init(trainable)
-
-        def step(trainable, non_trainable, opt_state, xb, yb):
-            def scalar_loss(tr):
-                preds, new_nt = model.stateless_call(
-                    tr, non_trainable, xb, training=True)
-                if isinstance(preds, (list, tuple)):
-                    preds = preds[0]
-                return jnp.mean(loss_fn(preds, yb)), new_nt
-
-            (loss, new_nt), grads = jax.value_and_grad(
-                scalar_loss, has_aux=True)(trainable)
-            updates, opt_state2 = tx.update(grads, opt_state, trainable)
-            return (jax.tree.map(lambda p, u: p + u, trainable, updates),
-                    new_nt, opt_state2, loss)
-
+        step = _make_step(model, loss_fn, tx)
         jitted, batch_size = est._compile_step(step, batch_size)
 
         n = len(X)
@@ -461,9 +495,208 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             output_names=out_names,
             name=f"keras_trained:{model.name}")
 
+    # -- streaming training --------------------------------------------------
+
+    @staticmethod
+    def _streaming_fingerprint(est, uris, labels) -> str:
+        """Checkpoint identity for a streaming trial: hyperparameters
+        AND the (uri, label) manifest — images themselves are never
+        materialized whole, so the manifest stands in for the data."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(_config_fingerprint_bytes(est))
+        for u, l in zip(uris, labels):
+            h.update(str(u).encode())
+            h.update(repr(l).encode())
+        return h.hexdigest()[:16]
+
+    def _epoch_stream(self, loaded, label_col, batch_size,
+                      n_out, loss, epoch_seed, shuffle):
+        """Yield uniform (xb, yb) training batches from the loaded
+        frame's partition stream, one epoch's worth.
+
+        Partition order is permuted per epoch (shuffle) and rows are
+        permuted within each partition — an engine-friendly shuffle that
+        never holds more than a partition plus one batch in memory. A
+        partial final batch is filled cyclically from the epoch's first
+        rows, matching the in-memory trainer's np.resize(order) wrap so
+        every step sees a full static-shape batch.
+        """
+        import collections
+
+        from sparkdl_tpu.data.frame import DataFrame, column_index
+        from sparkdl_tpu.data.tensors import arrow_to_tensor
+
+        rng = np.random.default_rng(epoch_seed)
+        sources = list(loaded._sources)
+        if shuffle:
+            sources = [sources[i]
+                       for i in rng.permutation(len(sources))]
+        frame = DataFrame(sources, loaded._plan, loaded._engine)
+
+        # (xs, ys, offset) segments; emitting a batch slices views and
+        # copies exactly batch_size rows — never the whole remainder
+        parts: collections.deque = collections.deque()
+        buffered = 0
+        head_x = head_y = None  # first batch, kept for the cyclic tail
+
+        def targets(y):
+            return self._prepare_targets(np.asarray(y), loss, n_out)
+
+        def emit(n_rows: int):
+            nonlocal buffered
+            xs_out, ys_out = [], []
+            need = n_rows
+            while need:
+                xs, ys, off = parts[0]
+                take = min(need, len(xs) - off)
+                xs_out.append(xs[off:off + take])
+                ys_out.append(ys[off:off + take])
+                if off + take == len(xs):
+                    parts.popleft()
+                else:
+                    parts[0] = (xs, ys, off + take)
+                need -= take
+            buffered -= n_rows
+            return np.concatenate(xs_out), np.concatenate(ys_out)
+
+        for batch in frame.stream():
+            idx = column_index(batch, _LOADED_COL)
+            xs = np.asarray(arrow_to_tensor(batch.column(idx),
+                                            batch.schema.field(idx)),
+                            dtype=np.float32)
+            ys = np.asarray(
+                batch.column(column_index(batch, label_col)).to_pylist())
+            if shuffle and len(xs) > 1:
+                perm = rng.permutation(len(xs))
+                xs, ys = xs[perm], ys[perm]
+            if len(xs):
+                parts.append((xs, ys, 0))
+                buffered += len(xs)
+            while buffered >= batch_size:
+                xb, yb = emit(batch_size)
+                if head_x is None:
+                    head_x, head_y = xb, yb
+                yield xb, targets(yb)
+
+        if buffered:
+            X, y = emit(buffered)
+            if head_x is None:
+                # whole epoch smaller than one batch: tile it (the
+                # in-memory trainer's np.resize does the same)
+                reps = -(-batch_size // len(X))
+                X = np.concatenate([X] * reps)[:batch_size]
+                y = np.concatenate([y] * reps)[:batch_size]
+            else:
+                pad = batch_size - len(X)
+                X = np.concatenate([X, head_x[:pad]])
+                y = np.concatenate([y, head_y[:pad]])
+            yield X, targets(y)
+
+    def _trainStreaming(self, dataset, paramMap: dict,
+                        checkpoint_tag: str = "fit") -> KerasImageFileModel:
+        """Train one configuration by streaming decoded partitions
+        through the engine — no driver-memory materialization of the
+        image tensor (the reference's hard boundary, SURVEY §3.4: the
+        dataset had to fit in driver memory AND was broadcast whole).
+        Epochs re-decode; engine host threads pipeline decode ahead of
+        the device step."""
+        import jax
+
+        est = self.copy(paramMap) if paramMap else self
+        est._validateParams()
+        fit_params = est.getKerasFitParams()
+        epochs = int(fit_params.get("epochs", 1))
+        batch_size = int(fit_params.get("batch_size", 32))
+        shuffle = bool(fit_params.get("shuffle", True))
+        seed = int(fit_params.get("seed", 0))
+
+        in_col, label_col = est.getInputCol(), est.getLabelCol()
+        base = dataset.select(in_col, label_col)
+        loaded = est.loadImagesInternal(base, in_col, _LOADED_COL)
+
+        # cheap manifest (strings + labels), for sizing + fingerprint
+        meta = base.collect()
+        uris = meta.column(0).to_pylist()
+        labels_all = meta.column(1).to_pylist()
+        n = len(uris)
+        if n == 0:
+            raise ValueError("cannot fit on an empty dataset")
+
+        model, loss_fn, tx, trainable, non_trainable, opt_state = \
+            est._setup_trial()
+        n_out = int(model.outputs[0].shape[-1])
+        step = _make_step(model, loss_fn, tx)
+        jitted, batch_size = est._compile_step(step, batch_size)
+
+        rng = np.random.default_rng(seed)
+        history: List[float] = []
+        checkpointer = None
+        start_epoch = 0
+        if est.isDefined("checkpointDir"):
+            import os as _os
+
+            from sparkdl_tpu.parallel.checkpoint import PytreeCheckpointer
+            trial_dir = _os.path.join(
+                est.getOrDefault("checkpointDir"),
+                f"{checkpoint_tag}_"
+                f"{self._streaming_fingerprint(est, uris, labels_all)}")
+            checkpointer = PytreeCheckpointer(trial_dir)
+            usable = [s for s in checkpointer.all_steps() if s <= epochs]
+            if usable:
+                start_epoch = max(usable)
+                template = {"trainable": trainable,
+                            "non_trainable": non_trainable,
+                            "opt_state": opt_state,
+                            "history": np.zeros(start_epoch, np.float64)}
+                restored = checkpointer.restore(template, step=start_epoch)
+                trainable = restored["trainable"]
+                non_trainable = restored["non_trainable"]
+                opt_state = restored["opt_state"]
+                history = [float(h) for h in restored["history"]]
+
+        # one seed drawn per epoch (skipped epochs burn theirs, so a
+        # resumed run repeats the uninterrupted run's batch order)
+        epoch_seeds = [int(s) for s in
+                       rng.integers(0, 2**63 - 1, size=epochs)]
+
+        import jax.numpy as jnp
+        for epoch in range(start_epoch, epochs):
+            losses = []
+            for xb, yb in self._epoch_stream(
+                    loaded, label_col, batch_size, n_out,
+                    est.getKerasLoss(), epoch_seeds[epoch], shuffle):
+                trainable, non_trainable, opt_state, loss = jitted(
+                    trainable, non_trainable, opt_state,
+                    jnp.asarray(xb), jnp.asarray(yb))
+                losses.append(loss)
+            history.append(float(np.mean(jax.device_get(losses))))
+            if checkpointer is not None:
+                checkpointer.save(
+                    len(history),
+                    {"trainable": jax.device_get(trainable),
+                     "non_trainable": jax.device_get(non_trainable),
+                     "opt_state": jax.device_get(opt_state),
+                     "history": np.asarray(history, np.float64)})
+        if checkpointer is not None:
+            checkpointer.close()
+
+        trained = {
+            "trainable": jax.device_get(trainable),
+            "non_trainable": jax.device_get(non_trainable),
+        }
+        mf = self._as_model_function(model, trained)
+        return KerasImageFileModel(
+            mf, inputCol=est.getInputCol(), outputCol=est.getOutputCol(),
+            imageLoader=est.getImageLoader(), outputMode=est.getOutputMode(),
+            batchSize=est.getBatchSize(),
+            useMesh=est.getOrDefault("useMesh"), history=history)
+
     # -- Estimator interface -------------------------------------------------
 
     def _fit(self, dataset) -> KerasImageFileModel:
+        if self.getOrDefault("streaming"):
+            return self._trainStreaming(dataset, {})
         X, y = self._getNumpyFeaturesAndLabels(dataset)
         return self._trainOne(X, y, {})
 
@@ -485,20 +718,25 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         """Yield ``(index, model)`` as trials finish — data localized
         once (the reference's broadcast) unless a trial overrides a data
         param, trials dispatched concurrently (the reference's
-        one-Spark-task-per-ParamMap)."""
-        shared = self._getNumpyFeaturesAndLabels(dataset)
+        one-Spark-task-per-ParamMap). With ``streaming`` nothing is
+        localized; each trial streams partitions through the (shared,
+        thread-safe) engine, with the same ``parallelism`` bound."""
+        streaming = self.getOrDefault("streaming")
+        shared = (None if streaming
+                  else self._getNumpyFeaturesAndLabels(dataset))
         parallelism = max(1, self.getOrDefault("parallelism"))
+
+        def trial(i, pm):
+            if streaming:
+                return self._trainStreaming(dataset, pm,
+                                            checkpoint_tag=f"trial_{i}")
+            X, y = self._trialData(dataset, pm, shared)
+            return self._trainOne(X, y, pm, checkpoint_tag=f"trial_{i}")
 
         if parallelism == 1 or len(paramMaps) <= 1:
             for i, pm in enumerate(paramMaps):
-                X, y = self._trialData(dataset, pm, shared)
-                yield i, self._trainOne(X, y, pm,
-                                        checkpoint_tag=f"trial_{i}")
+                yield i, trial(i, pm)
             return
-
-        def trial(i, pm):
-            X, y = self._trialData(dataset, pm, shared)
-            return self._trainOne(X, y, pm, checkpoint_tag=f"trial_{i}")
 
         with ThreadPoolExecutor(max_workers=parallelism,
                                 thread_name_prefix="sparkdl-tpu-trial") as ex:

@@ -1,0 +1,13 @@
+"""TPU kernels for the hot ops (Pallas) with XLA fallbacks.
+
+The reference's native layer was libtensorflow C++ kernels reached over
+JNI; the TPU-era analogue for on-device hot loops is Pallas (Mosaic)
+kernels compiled into the same XLA program as the model. Import from
+here: each op exposes one public fn that auto-selects kernel vs
+fallback.
+"""
+
+from sparkdl_tpu.ops.infeed import (  # noqa: F401
+    bilinear_weight_matrix,
+    fused_resize_normalize,
+)

@@ -31,6 +31,7 @@ from sparkdl_tpu.params.tuning import (  # noqa: F401
 )
 from sparkdl_tpu.params.shared import (  # noqa: F401
     HasBatchSize,
+    HasDeviceResizeFrom,
     HasUseMesh,
     HasInputCol,
     HasInputMapping,
@@ -65,6 +66,7 @@ __all__ = [
     "HasLabelCol",
     "HasOutputMode",
     "HasBatchSize",
+    "HasDeviceResizeFrom",
     "HasUseMesh",
     "HasKerasModel",
     "HasKerasOptimizer",

@@ -100,6 +100,26 @@ class HasUseMesh(Params):
         return self.getOrDefault("useMesh")
 
 
+class HasDeviceResizeFrom(Params):
+    """Move the resample on-device: pack images at their uniform native
+    (h, w) — host CPUs only decode — and fuse a bilinear resize to the
+    model's input size into the model's XLA program (Pallas kernel on
+    real TPU; ``transformers/utils.py::deviceResizeModel``). None keeps
+    the reference-equivalent host resize."""
+
+    deviceResizeFrom = Param(
+        "HasDeviceResizeFrom", "deviceResizeFrom",
+        "(h, w) the images actually have; pack at that size and resize "
+        "on-device inside the model's XLA program (None = resize on "
+        "host)", TypeConverters.toIntPairOrNone)
+
+    def setDeviceResizeFrom(self, value):
+        return self._set(deviceResizeFrom=value)
+
+    def getDeviceResizeFrom(self):
+        return self.getOrDefault("deviceResizeFrom")
+
+
 class HasKerasModel(Params):
     """Path to a user Keras model file (.h5 / .keras), loaded with the JAX
     backend (reference ``HasKerasModel.modelFile`` + ``kerasFitParams``)."""

@@ -74,13 +74,29 @@ def resolve_strategy(strategy: Optional[str],
                      max_inflight: Optional[int]) -> Tuple[str, int]:
     """Validate/default the (strategy, max_inflight) pair — shared by
     BatchRunner and ShardedBatchRunner so both reject typos and agree on
-    the immediate == zero-queue equivalence."""
+    the immediate == zero-queue equivalence.
+
+    An explicit positive ``max_inflight`` with no explicit strategy
+    means the caller wants a queue — that selects ``deferred`` rather
+    than being silently discarded by the auto-default; combining it with
+    an explicit ``strategy='immediate'`` is a contradiction and raises.
+    """
+    if strategy is None and max_inflight is not None \
+            and not os.environ.get("SPARKDL_TPU_RUNNER_STRATEGY"):
+        # (an explicit env strategy still wins — a contradiction with
+        # max_inflight then errors below, loudly)
+        strategy = "deferred" if max_inflight > 0 else "immediate"
     strategy = strategy or _default_strategy()
     if strategy not in ("immediate", "deferred"):
         raise ValueError(
             f"strategy must be 'immediate' or 'deferred', "
             f"got {strategy!r}")
     if strategy == "immediate":
+        if max_inflight is not None and max_inflight > 0:
+            raise ValueError(
+                f"strategy='immediate' means a zero-length queue; "
+                f"max_inflight={max_inflight} contradicts it (use "
+                "strategy='deferred' for a bounded queue)")
         return strategy, 0
     return strategy, (max_inflight if max_inflight is not None
                       else MAX_INFLIGHT_BATCHES)

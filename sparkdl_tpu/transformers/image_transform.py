@@ -17,14 +17,13 @@ import pyarrow as pa
 from sparkdl_tpu.data.tensors import append_tensor_column
 from sparkdl_tpu.params import (
     HasBatchSize,
+    HasDeviceResizeFrom,
     HasInputCol,
     HasModelFunction,
     HasOutputCol,
     HasOutputMode,
     HasUseMesh,
-    Param,
     Transformer,
-    TypeConverters,
     keyword_only,
 )
 from sparkdl_tpu.runtime.runner import RunnerMetrics
@@ -35,7 +34,7 @@ _PACKED_COL = "__sparkdl_tpu_packed__"
 
 class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
                        HasModelFunction, HasOutputMode, HasBatchSize,
-                       HasUseMesh):
+                       HasUseMesh, HasDeviceResizeFrom):
     """Applies a single-input ModelFunction to an image struct column.
 
     ``deviceResizeFrom=(H, W)`` moves the resize onto the accelerator:
@@ -45,12 +44,6 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
     SAME XLA program as cast/preprocess/model. Use it when the dataset
     is uniformly sized; host CPUs then only decode. Default (None) keeps
     the reference-equivalent host resize (C++ shim / PIL)."""
-
-    deviceResizeFrom = Param(
-        "ImageTransformer", "deviceResizeFrom",
-        "(h, w) the images actually have; pack at that size and resize "
-        "on-device inside the model's XLA program (None = resize on "
-        "host)", TypeConverters.toIntPairOrNone)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFunction=None,

@@ -16,6 +16,7 @@ import pyarrow as pa
 
 from sparkdl_tpu.params import (
     HasBatchSize,
+    HasDeviceResizeFrom,
     HasInputCol,
     HasOutputCol,
     HasUseMesh,
@@ -40,16 +41,10 @@ class _HasModelName(Transformer):
 
 
 class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
-                          HasBatchSize, HasUseMesh):
+                          HasBatchSize, HasUseMesh, HasDeviceResizeFrom):
     """Image column → penultimate-layer feature vector of a named model,
     for transfer learning (reference ``DeepImageFeaturizer``; its output
     feeds e.g. a logistic regression)."""
-
-    deviceResizeFrom = Param(
-        "DeepImageFeaturizer", "deviceResizeFrom",
-        "(h, w) of the (uniformly sized) input images; resize to the "
-        "model's input size on-device instead of on host",
-        TypeConverters.toIntPairOrNone)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
@@ -74,7 +69,7 @@ class DeepImageFeaturizer(_HasModelName, HasInputCol, HasOutputCol,
 
 
 class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
-                         HasBatchSize, HasUseMesh):
+                         HasBatchSize, HasUseMesh, HasDeviceResizeFrom):
     """Image column → class scores of a named model; optionally decoded
     to top-K (class, description, score) rows (reference
     ``DeepImagePredictor`` params ``decodePredictions``, ``topK``)."""
@@ -89,13 +84,14 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  decodePredictions=False, topK=5, batchSize=64,
-                 useMesh=False):
+                 useMesh=False, deviceResizeFrom=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5, batchSize=64,
-                         useMesh=False)
+                         useMesh=False, deviceResizeFrom=None)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelName=modelName, decodePredictions=decodePredictions,
-                  topK=topK, batchSize=batchSize, useMesh=useMesh)
+                  topK=topK, batchSize=batchSize, useMesh=useMesh,
+                  deviceResizeFrom=deviceResizeFrom)
         self.metrics = None
 
     def _transform(self, dataset):
@@ -107,7 +103,8 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
         inner = ImageTransformer(
             inputCol=self.getInputCol(), outputCol=raw_col,
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize(), useMesh=self.getUseMesh())
+            batchSize=self.getBatchSize(), useMesh=self.getUseMesh(),
+            deviceResizeFrom=self.getOrDefault("deviceResizeFrom"))
         self.metrics = inner.metrics
         result = inner.transform(dataset)
         if not decode:

@@ -145,10 +145,12 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int]):
         return model_fn
 
     def resize(inputs):
-        import jax
+        from sparkdl_tpu.ops import fused_resize_normalize
         x = inputs[in_name]
-        y = jax.image.resize(x.astype(jnp.float32),
-                             (x.shape[0], h, w, c), method="bilinear")
+        # Pallas kernel on real TPU, identical XLA einsum chain
+        # elsewhere (ops/infeed.py; parity with jax.image.resize is
+        # kernel-tested)
+        y = fused_resize_normalize(x, (h, w))
         if np.dtype(in_dtype) == np.uint8:
             y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
         else:

@@ -11,6 +11,7 @@ import pytest
     "examples/transfer_learning.py",
     "examples/keras_udf.py",
     "examples/multi_chip.py",
+    "examples/fast_infeed.py",
 ])
 def test_example_runs(script, capsys):
     runpy.run_path(script, run_name="__main__")

@@ -1,0 +1,75 @@
+"""Pallas infeed kernel tests (interpret mode on CPU) + parity with
+jax.image.resize — the op must be a drop-in for the resize+normalize
+the image pipelines do on-device."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.ops import bilinear_weight_matrix, fused_resize_normalize
+
+
+@pytest.fixture(scope="module")
+def batch(rng):
+    return rng.integers(0, 255, (3, 40, 56, 3), dtype=np.uint8)
+
+
+class TestWeights:
+    def test_identity_when_same_size(self):
+        np.testing.assert_array_equal(bilinear_weight_matrix(32, 32),
+                                      np.eye(32, dtype=np.float32))
+
+    def test_rows_normalized(self):
+        for src, dst in [(40, 299), (299, 40), (17, 23), (64, 8)]:
+            w = bilinear_weight_matrix(src, dst)
+            assert w.shape == (dst, src)
+            np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-6)
+
+    @pytest.mark.parametrize("src,dst", [(40, 64), (64, 24), (56, 299)])
+    def test_matches_jax_image_resize(self, batch, src, dst):
+        """The separable-matmul resize must equal jax.image.resize's
+        anti-aliased bilinear (same triangle kernel)."""
+        import jax
+        import jax.numpy as jnp
+
+        x = batch.astype(np.float32)
+        got = fused_resize_normalize(x, (dst, dst), use_pallas=False)
+        ref = jax.image.resize(jnp.asarray(x),
+                               (x.shape[0], dst, dst, x.shape[3]),
+                               method="bilinear")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-3)
+
+
+class TestFusedOp:
+    def test_pallas_interpret_matches_xla(self, batch):
+        a = fused_resize_normalize(batch, (24, 32), scale=1 / 127.5,
+                                   offset=-1.0, use_pallas=False)
+        b = fused_resize_normalize(batch, (24, 32), scale=1 / 127.5,
+                                   offset=-1.0, use_pallas=True,
+                                   interpret=True)
+        assert np.asarray(a).shape == (3, 24, 32, 3)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_normalize_applied(self, batch):
+        plain = fused_resize_normalize(batch, (20, 20), use_pallas=False)
+        scaled = fused_resize_normalize(batch, (20, 20), scale=2.0,
+                                        offset=5.0, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(scaled),
+                                   np.asarray(plain) * 2.0 + 5.0,
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_output_dtype(self, batch):
+        import jax.numpy as jnp
+        out = fused_resize_normalize(batch, (16, 16), dtype=jnp.bfloat16,
+                                     use_pallas=False)
+        assert np.asarray(out).dtype == jnp.bfloat16
+
+    def test_jittable_inside_program(self, batch):
+        """The op composes under jit (how deviceResizeModel embeds it:
+        one XLA program with the model)."""
+        import jax
+
+        f = jax.jit(lambda x: fused_resize_normalize(
+            x, (16, 16), scale=1 / 255.0, use_pallas=False).sum())
+        assert np.isfinite(float(f(batch)))

@@ -60,6 +60,25 @@ class TestBatchRunner:
         with pytest.raises(ValueError):
             BatchRunner(_double_fn(), batch_size=0)
 
+    def test_strategy_resolution(self):
+        from sparkdl_tpu.runtime.runner import resolve_strategy
+
+        assert resolve_strategy("immediate", None) == ("immediate", 0)
+        assert resolve_strategy("deferred", 5) == ("deferred", 5)
+        # an explicit queue depth means the caller wants a queue — it
+        # must select deferred, not be silently dropped by the
+        # tunnel-env auto-default
+        import os
+        assert "SPARKDL_TPU_RUNNER_STRATEGY" not in os.environ
+        assert resolve_strategy(None, 8) == ("deferred", 8)
+        # contradictions and typos are loud
+        with pytest.raises(ValueError, match="contradicts"):
+            resolve_strategy("immediate", 8)
+        with pytest.raises(ValueError, match="immediate"):
+            resolve_strategy("immedaite", None)
+        r = BatchRunner(_double_fn(), strategy="immediate")
+        assert r.strategy == "immediate" and r.max_inflight == 0
+
     def test_host_backend(self):
         def host_apply(params, inputs):
             return {"y": np.asarray(inputs["x"]) + 1.0}
