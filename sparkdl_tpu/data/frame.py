@@ -210,6 +210,19 @@ class DataFrame:
                 self._engine)
         return DataFrame(out_sources, self._plan, self._engine)
 
+    def with_partition_order(self, indices: Sequence[int]) -> "DataFrame":
+        """A frame over the given subset/permutation of this frame's
+        partitions, same plan — the public seam for per-epoch partition
+        shuffles (streaming training) and host sharding (each index
+        selects one existing partition; repeats allowed)."""
+        n = len(self._sources)
+        bad = [i for i in indices if not (0 <= i < n)]
+        if bad:
+            raise IndexError(
+                f"partition index {bad[0]} out of range [0, {n})")
+        return DataFrame([self._sources[i] for i in indices],
+                         self._plan, self._engine)
+
     def union(self, other: "DataFrame") -> "DataFrame":
         """Concatenate two frames' rows (self's first). Stays fully lazy
         when both share the same plan; otherwise each side materializes

@@ -336,7 +336,6 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         final model as an uninterrupted run."""
         import jax
         import jax.numpy as jnp
-        import keras
 
         est = self.copy(paramMap) if paramMap else self
         est._validateParams()
@@ -524,15 +523,14 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         """
         import collections
 
-        from sparkdl_tpu.data.frame import DataFrame, column_index
+        from sparkdl_tpu.data.frame import column_index
         from sparkdl_tpu.data.tensors import arrow_to_tensor
 
         rng = np.random.default_rng(epoch_seed)
-        sources = list(loaded._sources)
+        frame = loaded
         if shuffle:
-            sources = [sources[i]
-                       for i in rng.permutation(len(sources))]
-        frame = DataFrame(sources, loaded._plan, loaded._engine)
+            frame = loaded.with_partition_order(
+                rng.permutation(loaded.num_partitions))
 
         # (xs, ys, offset) segments; emitting a batch slices views and
         # copies exactly batch_size rows — never the whole remainder

@@ -130,7 +130,7 @@ def host_shard_dataframe(df: DataFrame,
     lazy: partitions owned by other hosts are never loaded here."""
     idxs = host_shard_indices(df.num_partitions, process_index,
                               process_count)
-    return DataFrame([df._sources[i] for i in idxs], df._plan, df._engine)
+    return df.with_partition_order(idxs)
 
 
 def global_mesh(spec=None) -> "jax.sharding.Mesh":

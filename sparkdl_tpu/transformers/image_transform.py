@@ -76,7 +76,11 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
         mode = self.getOutputMode()
         src_hw = self.getOrDefault("deviceResizeFrom")
         if src_hw is not None:
-            wrapped = tfr_utils.deviceResizeModel(mf, src_hw)
+            # mesh-jitted programs need the XLA fallback: a Pallas call
+            # has no GSPMD partitioning rule (ops/infeed.py)
+            wrapped = tfr_utils.deviceResizeModel(
+                mf, src_hw,
+                use_pallas=False if self.getUseMesh() else None)
             if wrapped is mf:
                 src_hw = None  # (h, w) == model input: plain host path
             else:

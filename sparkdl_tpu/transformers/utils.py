@@ -124,7 +124,8 @@ def make_runner(model_fn, batch_size: int, use_mesh: bool = False,
     return BatchRunner(model_fn, batch_size, metrics=metrics)
 
 
-def deviceResizeModel(model_fn, src_hw: Tuple[int, int]):
+def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
+                      use_pallas=None):
     """Wrap a single-image-input ModelFunction so bilinear resize from
     ``src_hw`` to the model's native input size runs ON DEVICE, fused
     into the model's XLA program.
@@ -135,6 +136,11 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int]):
     Resize happens in float32, then rounds back to the model's declared
     input dtype so the downstream preprocess sees exactly what a host
     resize would have produced.
+
+    ``use_pallas``: forwarded to the fused op. Pass False when the
+    wrapped model will be jitted with mesh shardings — a Pallas call
+    has no GSPMD partitioning rule, while the XLA einsum fallback
+    shards cleanly over the data axis.
     """
     import jax.numpy as jnp
 
@@ -150,7 +156,7 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int]):
         # Pallas kernel on real TPU, identical XLA einsum chain
         # elsewhere (ops/infeed.py; parity with jax.image.resize is
         # kernel-tested)
-        y = fused_resize_normalize(x, (h, w))
+        y = fused_resize_normalize(x, (h, w), use_pallas=use_pallas)
         if np.dtype(in_dtype) == np.uint8:
             y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
         else:

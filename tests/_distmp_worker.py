@@ -47,6 +47,43 @@ def main() -> None:
     mine = dist.host_shard_dataframe(df)
     xs = sorted(r["x"] for r in mine.collect_rows())
 
+    # one full DP train step over the GLOBAL mesh: per-process local
+    # batch shards assemble into one global batch; the gradient
+    # all-reduce crosses processes (both must see the same loss)
+    import optax
+
+    from sparkdl_tpu.models.testnet import TestNet
+    from sparkdl_tpu.models.zoo import getKerasApplicationModel
+    from sparkdl_tpu.parallel.train import (
+        create_train_state,
+        make_train_step,
+        shard_train_step,
+    )
+
+    spec = getKerasApplicationModel("TestNet")
+    module = TestNet()
+    x0 = spec.preprocess(jnp.zeros((1, 32, 32, 3), jnp.uint8))
+    variables = module.init(jax.random.PRNGKey(0), x0)
+    state = create_train_state(module, variables, optax.sgd(1e-2, 0.9))
+    train_step = make_train_step(module, spec.preprocess,
+                                 num_classes=spec.num_classes)
+    jitted, state = shard_train_step(train_step, mesh, state)
+
+    per_proc = 2 * info.local_device_count
+    brng = np.random.default_rng(pid)
+    imgs = brng.integers(0, 255, (per_proc, 32, 32, 3), np.uint8)
+    labels = ((np.arange(per_proc) + pid)
+              % spec.num_classes).astype(np.int32)
+    gb = 2 * info.global_device_count
+    batch = {
+        "image": jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(DATA_AXIS)), imgs, (gb, 32, 32, 3)),
+        "label": jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(DATA_AXIS)), labels, (gb,)),
+    }
+    state, metrics = jitted(state, batch)
+    train_loss = float(metrics["loss"])
+
     print("RESULT " + json.dumps({
         "pid": pid,
         "process_count": info.process_count,
@@ -55,6 +92,7 @@ def main() -> None:
         "shard_indices": dist.host_shard_indices(num_partitions),
         "psum_total": float(total),
         "rows": xs,
+        "train_loss": train_loss,
     }), flush=True)
 
 

@@ -14,6 +14,7 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -82,6 +83,14 @@ def test_host_shard_indices_disjoint_covering(worker_results):
     a, b = (set(r["shard_indices"]) for r in worker_results)
     assert a.isdisjoint(b)
     assert a | b == set(range(NUM_PARTITIONS))
+
+
+def test_global_mesh_train_step(worker_results):
+    """One DP train step over the pod-wide mesh: the gradient all-reduce
+    crossed processes, so both report the identical finite loss."""
+    a, b = (r["train_loss"] for r in worker_results)
+    assert np.isfinite(a)
+    assert a == pytest.approx(b, rel=1e-6)
 
 
 def test_host_shard_dataframe_partitions_rows(worker_results):

@@ -13,6 +13,10 @@ from sparkdl_tpu.transformers.utils import packImageBatch
 
 @pytest.fixture(scope="module")
 def built():
+    import os
+    if os.environ.get("SPARKDL_TPU_NO_NATIVE"):
+        pytest.skip("native shim explicitly disabled via "
+                    "SPARKDL_TPU_NO_NATIVE (fallback-path suite run)")
     ok = native.available()
     assert ok, "native shim failed to build (g++ is expected in this env)"
     return ok
