@@ -1,0 +1,79 @@
+"""Export a model as StableHLO and serve it from a fresh process.
+
+The reference broadcast frozen GraphDef bytes inside Spark task closures
+to every executor (SURVEY §2.5); the TPU-era deploy form is serialized
+StableHLO from ``jax.export``: params baked in, computation portable,
+loadable without the model's Python code. This example exports on the
+"driver", then loads and serves in a NEW python process that never
+imports the zoo — exactly what a worker that only has the bytes does.
+
+Run on CPU:
+  JAX_PLATFORMS=cpu python examples/export_deploy.py
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sparkdl_tpu.graph.function import ModelFunction
+
+blob = open(sys.argv[1], "rb").read()
+mf = ModelFunction.deserialize(blob, name="deployed")
+x = np.load(sys.argv[2])
+out = mf({mf.input_names[0]: x})
+np.save(sys.argv[3], np.asarray(out[mf.output_names[0]]))
+print("served", x.shape, "->", np.asarray(out[mf.output_names[0]]).shape)
+"""
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from sparkdl_tpu.models.zoo import getModelFunction
+
+    # "driver": build + freeze (params baked into the program)
+    mf = getModelFunction("TestNet", featurize=True)
+    batch = 4
+    blob = mf.export(batch_size=batch)
+    print(f"exported {mf.name}: {len(blob) // 1024} KiB StableHLO")
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, (batch, 32, 32, 3), dtype=np.uint8)
+    expected = np.asarray(mf({mf.input_names[0]: x})[mf.output_names[0]])
+
+    # "worker": a fresh process with only the bytes
+    with tempfile.TemporaryDirectory(prefix="sparkdl_tpu_deploy_") as d:
+        blob_p = os.path.join(d, "model.stablehlo")
+        x_p = os.path.join(d, "x.npy")
+        out_p = os.path.join(d, "out.npy")
+        open(blob_p, "wb").write(blob)
+        np.save(x_p, x)
+
+        from sparkdl_tpu.utils.hostenv import sanitized_cpu_env
+        proc = subprocess.run(
+            [sys.executable, "-c", WORKER, blob_p, x_p, out_p],
+            env=sanitized_cpu_env(pythonpath=REPO_ROOT),
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(f"worker failed:\n{proc.stderr[-2000:]}")
+        print(proc.stdout.strip())
+        got = np.load(out_p)
+
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+    print(f"worker output matches driver oracle "
+          f"(max abs diff {np.abs(got - expected).max():.2e})")
+
+
+if __name__ == "__main__":
+    main()

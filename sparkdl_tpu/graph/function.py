@@ -69,6 +69,11 @@ class ModelFunction:
         # the host object it was built from so reassigning .params
         # invalidates it
         self._params_cache: Dict[Any, Tuple[Any, Any]] = {}
+        # known output signature (set by deserialize, which reads it
+        # from the exported avals); when present, output_signature()
+        # returns it instead of eval_shape-probing — a fixed-batch
+        # exported program rejects any other batch size
+        self._output_signature: Optional[Signature] = None
 
     # -- construction -------------------------------------------------------
 
@@ -151,7 +156,10 @@ class ModelFunction:
 
     def output_signature(self, batch_size: int = 1) -> Signature:
         """Infer named output shapes via ``jax.eval_shape`` (per-row
-        shapes, batch stripped)."""
+        shapes, batch stripped); deserialized models return the
+        signature recorded in the export instead of probing."""
+        if self._output_signature is not None:
+            return dict(self._output_signature)
         if self.backend != "jax":
             raise ValueError("output_signature requires a jax backend")
         inputs = {
@@ -313,7 +321,24 @@ class ModelFunction:
         def apply_fn(params_, inputs):
             return exported.call(inputs)
 
-        return ModelFunction(apply_fn, None, sig, None, name=name)
+        # Output names AND signature come from the exported avals
+        # directly — the lazy eval_shape probe would call the program
+        # with batch 1, which a fixed-batch export rejects.
+        out_avals = exported.out_avals
+        out_tree_names = jax.tree.unflatten(
+            exported.out_tree, list(range(len(out_avals))))
+        output_names = None
+        out_sig = None
+        if isinstance(out_tree_names, dict):
+            output_names = list(out_tree_names)
+            out_sig = {
+                key: (tuple(int(d) for d in out_avals[idx].shape[1:]),
+                      out_avals[idx].dtype)
+                for key, idx in out_tree_names.items()}
+
+        mf = ModelFunction(apply_fn, None, sig, output_names, name=name)
+        mf._output_signature = out_sig
+        return mf
 
     def __repr__(self) -> str:
         outs = self._output_names or "?"

@@ -12,6 +12,7 @@ import pytest
     "examples/keras_udf.py",
     "examples/multi_chip.py",
     "examples/fast_infeed.py",
+    "examples/export_deploy.py",
 ])
 def test_example_runs(script, capsys):
     runpy.run_path(script, run_name="__main__")

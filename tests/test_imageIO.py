@@ -54,6 +54,48 @@ class TestCodecs:
             imageIO.imageStructToArray(s)
 
 
+class TestExoticModes:
+    """Non-RGB source files must decode to the struct schema's channel
+    model (the reference leaned on PIL the same way: everything not
+    L/RGB/RGBA converts to RGB)."""
+
+    def _bytes(self, img, fmt):
+        import io
+        buf = io.BytesIO()
+        img.save(buf, fmt)
+        return buf.getvalue()
+
+    def test_cmyk_jpeg_and_palette_png(self):
+        import numpy as np
+        from PIL import Image
+        rng = np.random.default_rng(0)
+
+        cmyk = self._bytes(Image.fromarray(
+            rng.integers(0, 255, (20, 30, 4), dtype=np.uint8), "CMYK"),
+            "JPEG")
+        pal = self._bytes(Image.fromarray(
+            rng.integers(0, 255, (16, 16), dtype=np.uint8), "L")
+            .convert("P"), "PNG")
+        i16 = self._bytes(Image.fromarray(
+            rng.integers(0, 60000, (12, 14), dtype=np.uint16), "I;16"),
+            "PNG")
+
+        structs = imageIO._decodeBatch(
+            ["cmyk", "pal", "i16"], [cmyk, pal, i16])
+        assert all(s is not None for s in structs)
+        assert (structs[0]["height"], structs[0]["width"],
+                structs[0]["nChannels"]) == (20, 30, 3)
+        assert structs[1]["nChannels"] == 3   # palette expands to RGB
+        assert structs[2]["nChannels"] == 3   # 16-bit converts to RGB
+
+        # the batch (native-eligible) path and the pure-PIL path must
+        # produce identical pixels for the CMYK JPEG
+        pil = imageIO._decodeImage(cmyk, "cmyk")
+        np.testing.assert_array_equal(
+            np.frombuffer(structs[0]["data"], np.uint8),
+            np.frombuffer(pil["data"], np.uint8))
+
+
 class TestResize:
     def test_resize_matches_pil_oracle(self, rng):
         arr = rng.integers(0, 255, size=(30, 40, 3), dtype=np.uint8)

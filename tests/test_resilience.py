@@ -213,6 +213,22 @@ class TestGraphUtils:
         np.testing.assert_allclose(
             np.asarray(back({"inp": x})["out"]), x * 2.0)
 
+    def test_fixed_batch_freeze_roundtrip(self):
+        """A fixed-batch export must deserialize with working output
+        names (regression: the lazy name probe ran the program with
+        batch 1, which a fixed-batch export rejects)."""
+        mf = self._mf()
+        back = tfx.load_frozen(mf.export(batch_size=3))
+        assert back.output_names == ["out"]
+        # output_signature must come from the exported avals, not an
+        # eval_shape probe (which would call the program with batch 1)
+        shape, dtype = back.output_signature()["out"]
+        assert shape == (3,) and np.dtype(dtype) == np.float32
+        assert tfx.get_output_shape(back, "out") == (3,)
+        x = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_allclose(
+            np.asarray(back({"inp": x})["out"]), x * 2.0)
+
     def test_select_outputs_prunes(self):
         def two_headed(x):
             return {"a": x + 1.0, "b": x * 3.0}
