@@ -351,7 +351,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         targets = self._prepare_targets(y, est.getKerasLoss(), n_out)
 
         step = _make_step(model, loss_fn, tx)
-        jitted, batch_size = est._compile_step(step, batch_size)
+        jitted, batch_size, _ = est._compile_step(step, batch_size)
 
         n = len(X)
         if n == 0:
@@ -430,7 +430,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     def _compile_step(self, step, batch_size: int):
         """jit the train step — against the mesh (batch split over the
         ``data`` axis, state replicated; XLA psums grads over ICI) when
-        ``useMesh`` and >1 device, else single-device."""
+        ``useMesh`` and >1 device, else single-device.
+
+        Returns ``(jitted, batch_size, mesh)`` — mesh is None on the
+        single-device path; callers that place arrays themselves
+        (multi-host streaming) derive their shardings from THIS mesh so
+        the jit's in_shardings and the placed arrays can never diverge.
+        """
         import jax
 
         if self.getOrDefault("useMesh") and len(jax.devices()) > 1:
@@ -443,8 +449,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             jitted = jax.jit(step,
                              in_shardings=(rep, rep, rep, dat, dat),
                              out_shardings=(rep, rep, rep, rep))
-            return jitted, batch_size
-        return jax.jit(step), batch_size
+            return jitted, batch_size, mesh
+        return jax.jit(step), batch_size, None
 
     @staticmethod
     def _prepare_targets(y: np.ndarray, loss, n_out: int) -> np.ndarray:
@@ -510,7 +516,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return h.hexdigest()[:16]
 
     def _epoch_stream(self, loaded, label_col, batch_size,
-                      n_out, loss, epoch_seed, shuffle):
+                      n_out, loss, epoch_seed, shuffle,
+                      num_steps: Optional[int] = None):
         """Yield uniform (xb, yb) training batches from the loaded
         frame's partition stream, one epoch's worth.
 
@@ -520,6 +527,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         partial final batch is filled cyclically from the epoch's first
         rows, matching the in-memory trainer's np.resize(order) wrap so
         every step sees a full static-shape batch.
+
+        ``num_steps``: yield EXACTLY this many batches (multi-host mode:
+        every host must take the same number of steps or the collective
+        deadlocks) — the stream restarts over the frame if this host's
+        shard runs dry before the quota, and stops early once met.
+        ``None`` (single-host) derives the step count from the data.
         """
         import collections
 
@@ -536,6 +549,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # copies exactly batch_size rows — never the whole remainder
         parts: collections.deque = collections.deque()
         buffered = 0
+        emitted = 0
         head_x = head_y = None  # first batch, kept for the cyclic tail
 
         def targets(y):
@@ -558,29 +572,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             buffered -= n_rows
             return np.concatenate(xs_out), np.concatenate(ys_out)
 
-        for batch in frame.stream():
-            idx = column_index(batch, _LOADED_COL)
-            xs = np.asarray(arrow_to_tensor(batch.column(idx),
-                                            batch.schema.field(idx)),
-                            dtype=np.float32)
-            ys = np.asarray(
-                batch.column(column_index(batch, label_col)).to_pylist())
-            if shuffle and len(xs) > 1:
-                perm = rng.permutation(len(xs))
-                xs, ys = xs[perm], ys[perm]
-            if len(xs):
-                parts.append((xs, ys, 0))
-                buffered += len(xs)
-            while buffered >= batch_size:
-                xb, yb = emit(batch_size)
-                if head_x is None:
-                    head_x, head_y = xb, yb
-                yield xb, targets(yb)
-
-        if buffered:
+        def tail_batch():
+            """Assemble the final partial batch, wrapped cyclically."""
             X, y = emit(buffered)
             if head_x is None:
-                # whole epoch smaller than one batch: tile it (the
+                # whole pass smaller than one batch: tile it (the
                 # in-memory trainer's np.resize does the same)
                 reps = -(-batch_size // len(X))
                 X = np.concatenate([X] * reps)[:batch_size]
@@ -589,7 +585,54 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 pad = batch_size - len(X)
                 X = np.concatenate([X, head_x[:pad]])
                 y = np.concatenate([y, head_y[:pad]])
-            yield X, targets(y)
+            return X, y
+
+        while True:
+            saw_rows = False
+            for batch in frame.stream():
+                idx = column_index(batch, _LOADED_COL)
+                xs = np.asarray(arrow_to_tensor(batch.column(idx),
+                                                batch.schema.field(idx)),
+                                dtype=np.float32)
+                ys = np.asarray(
+                    batch.column(column_index(batch, label_col))
+                    .to_pylist())
+                if shuffle and len(xs) > 1:
+                    perm = rng.permutation(len(xs))
+                    xs, ys = xs[perm], ys[perm]
+                if len(xs):
+                    saw_rows = True
+                    parts.append((xs, ys, 0))
+                    buffered += len(xs)
+                while buffered >= batch_size and (
+                        num_steps is None or emitted < num_steps):
+                    xb, yb = emit(batch_size)
+                    if head_x is None:
+                        head_x, head_y = xb, yb
+                    emitted += 1
+                    yield xb, targets(yb)
+                if num_steps is not None and emitted >= num_steps:
+                    return
+            # one full pass over the frame is done
+            if num_steps is None:
+                if buffered:
+                    X, y = tail_batch()
+                    yield X, targets(y)
+                return
+            if emitted >= num_steps:
+                return
+            if not saw_rows and not buffered and head_x is None:
+                raise ValueError(
+                    "this host's data shard is empty; repartition the "
+                    "dataset with at least one partition per host "
+                    "(numPartitions >= process_count)")
+            if buffered:
+                X, y = tail_batch()
+                emitted += 1
+                yield X, targets(y)
+                if emitted >= num_steps:
+                    return
+            # shard dry, quota unmet: stream it again (re-decode)
 
     def _trainStreaming(self, dataset, paramMap: dict,
                         checkpoint_tag: str = "fit") -> KerasImageFileModel:
@@ -598,7 +641,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         image tensor (the reference's hard boundary, SURVEY §3.4: the
         dataset had to fit in driver memory AND was broadcast whole).
         Epochs re-decode; engine host threads pipeline decode ahead of
-        the device step."""
+        the device step.
+
+        Multi-host (``jax.process_count() > 1`` after
+        ``parallel.initialize``): each host streams only ITS round-robin
+        partition shard, local sub-batches assemble into one global
+        array over the pod-wide mesh, and XLA's gradient all-reduce
+        crosses hosts — every host takes the same (globally derived)
+        number of steps per epoch, so collectives stay aligned.
+        """
         import jax
 
         est = self.copy(paramMap) if paramMap else self
@@ -609,11 +660,38 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
 
+        from sparkdl_tpu.parallel import distributed as dist
+        info = dist.host_info()
+        multihost = info.process_count > 1
+        if multihost:
+            if est.isDefined("checkpointDir"):
+                raise ValueError(
+                    "checkpointDir with multi-host streaming is not "
+                    "supported: per-epoch saves would need coordinated "
+                    "multi-host checkpointing; run with a single "
+                    "process or drop checkpointDir")
+            if not est.getOrDefault("useMesh"):
+                raise ValueError(
+                    "multi-host streaming requires useMesh=True (the "
+                    "global batch is laid out over the pod-wide mesh)")
+            if dataset.num_partitions < info.process_count:
+                # fail on EVERY host before any device step — a
+                # mid-epoch failure on one host would leave the others
+                # blocked in their first cross-host collective
+                raise ValueError(
+                    f"dataset has {dataset.num_partitions} partitions "
+                    f"for {info.process_count} hosts; repartition with "
+                    "numPartitions >= process_count so every host owns "
+                    "data")
+
         in_col, label_col = est.getInputCol(), est.getLabelCol()
         base = dataset.select(in_col, label_col)
         loaded = est.loadImagesInternal(base, in_col, _LOADED_COL)
+        loaded_local = (dist.host_shard_dataframe(loaded) if multihost
+                        else loaded)
 
-        # cheap manifest (strings + labels), for sizing + fingerprint
+        # cheap manifest (strings + labels): sizing + fingerprint —
+        # identical on every host, so step counts agree everywhere
         meta = base.collect()
         uris = meta.column(0).to_pylist()
         labels_all = meta.column(1).to_pylist()
@@ -625,7 +703,34 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             est._setup_trial()
         n_out = int(model.outputs[0].shape[-1])
         step = _make_step(model, loss_fn, tx)
-        jitted, batch_size = est._compile_step(step, batch_size)
+        jitted, batch_size, mesh = est._compile_step(step, batch_size)
+
+        if multihost:
+            from sparkdl_tpu.parallel.mesh import data_sharding, replicated
+            # the exact mesh _compile_step jitted against — placed
+            # arrays and the jit's in_shardings cannot diverge
+            rep, dat = replicated(mesh), data_sharding(mesh)
+            # every host holds identical initial values; place them as
+            # replicated global arrays so the jitted shardings match
+            trainable, non_trainable, opt_state = jax.device_put(
+                (trainable, non_trainable, opt_state), rep)
+            rows_per_step = (batch_size * info.local_device_count
+                             // info.global_device_count)
+            steps_per_epoch = max(1, -(-n // batch_size))
+
+            def place(xb, yb):
+                gx = jax.make_array_from_process_local_data(
+                    dat, xb, (batch_size,) + xb.shape[1:])
+                gy = jax.make_array_from_process_local_data(
+                    dat, yb, (batch_size,) + yb.shape[1:])
+                return gx, gy
+        else:
+            import jax.numpy as jnp
+            rows_per_step = batch_size
+            steps_per_epoch = None  # derived from the stream
+
+            def place(xb, yb):
+                return jnp.asarray(xb), jnp.asarray(yb)
 
         rng = np.random.default_rng(seed)
         history: List[float] = []
@@ -658,15 +763,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         epoch_seeds = [int(s) for s in
                        rng.integers(0, 2**63 - 1, size=epochs)]
 
-        import jax.numpy as jnp
         for epoch in range(start_epoch, epochs):
             losses = []
             for xb, yb in self._epoch_stream(
-                    loaded, label_col, batch_size, n_out,
-                    est.getKerasLoss(), epoch_seeds[epoch], shuffle):
+                    loaded_local, label_col, rows_per_step, n_out,
+                    est.getKerasLoss(), epoch_seeds[epoch], shuffle,
+                    num_steps=steps_per_epoch):
+                gx, gy = place(xb, yb)
                 trainable, non_trainable, opt_state, loss = jitted(
-                    trainable, non_trainable, opt_state,
-                    jnp.asarray(xb), jnp.asarray(yb))
+                    trainable, non_trainable, opt_state, gx, gy)
                 losses.append(loss)
             history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
@@ -723,6 +828,20 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         shared = (None if streaming
                   else self._getNumpyFeaturesAndLabels(dataset))
         parallelism = max(1, self.getOrDefault("parallelism"))
+        if streaming:
+            import jax
+            if jax.process_count() > 1 and parallelism > 1:
+                # multi-controller JAX requires every process to launch
+                # global computations in the SAME order — racing trial
+                # threads would interleave differently per host and
+                # deadlock the cross-host collectives
+                import logging
+                logging.getLogger(__name__).warning(
+                    "multi-host streaming fitMultiple: running trials "
+                    "serially (parallelism=%d ignored) to keep global "
+                    "computation launch order identical on every host",
+                    parallelism)
+                parallelism = 1
 
         def trial(i, pm):
             if streaming:

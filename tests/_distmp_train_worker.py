@@ -1,0 +1,64 @@
+"""Worker for test_distributed_multiproc's multi-host STREAMING
+estimator fit: one process of a 2-process CPU cluster training one
+Keras model data-parallel over the pod-wide mesh, each host streaming
+only its own partition shard."""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    images_dir = sys.argv[3]
+    model_file = sys.argv[4]
+
+    import numpy as np
+
+    from sparkdl_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=2, process_id=pid)
+
+    import glob
+    import os
+
+    from sparkdl_tpu.data import DataFrame
+    from sparkdl_tpu.estimators import KerasImageFileEstimator
+
+    rows = []
+    for p in sorted(glob.glob(os.path.join(images_dir, "*.png"))):
+        label = int(os.path.basename(p).split("_")[1].split(".")[0]) % 2
+        rows.append({"uri": p, "label": label})
+    df = DataFrame.from_pylist(rows, num_partitions=4)
+
+    def loader(uri):
+        from PIL import Image
+        return np.asarray(Image.open(uri).convert("RGB"),
+                          dtype=np.float32) / 255.0
+
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="pred", labelCol="label",
+        imageLoader=loader, modelFile=model_file,
+        kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 2, "batch_size": 8,
+                        "learning_rate": 0.05, "seed": 3},
+        streaming=True, useMesh=True)
+    model = est.fit(df)
+
+    # weight digest proves every host converged to identical params
+    leaves = [np.asarray(v) for v in
+              model.modelFunction.params["trainable"]]
+    digest = float(sum(np.abs(a).sum() for a in leaves))
+
+    mine = dist.host_shard_dataframe(df)
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "history": model.history,
+        "weight_digest": digest,
+        "local_partitions": mine.num_partitions,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
