@@ -111,6 +111,46 @@ class TestEngine:
         df.collect()
         assert len(seen) >= 2
 
+    def test_concurrent_frames_share_engine_safely(self):
+        """Two frames materializing concurrently on ONE engine (the
+        default-engine reality: every transformer shares it) must each
+        stream their own partitions in order with no cross-talk, and
+        device stages must stay globally serialized across frames."""
+        active = [0]
+        max_active = [0]
+        lock = threading.Lock()
+
+        def dev_stage(b):
+            with lock:
+                active[0] += 1
+                max_active[0] = max(max_active[0], active[0])
+            import time
+            time.sleep(0.002)
+            with lock:
+                active[0] -= 1
+            return b
+
+        engine = LocalEngine(num_workers=4)
+        a = DataFrame.from_table(
+            pa.table({"x": np.arange(40.0)}), 8, engine) \
+            .map_batches(dev_stage, kind="device")
+        b = DataFrame.from_table(
+            pa.table({"x": np.arange(100.0, 140.0)}), 8, engine) \
+            .map_batches(dev_stage, kind="device")
+
+        results = {}
+
+        def run(name, df):
+            results[name] = [r["x"] for r in df.collect_rows()]
+
+        ta = threading.Thread(target=run, args=("a", a))
+        tb = threading.Thread(target=run, args=("b", b))
+        ta.start(); tb.start(); ta.join(); tb.join()
+
+        assert results["a"] == list(np.arange(40.0))
+        assert results["b"] == list(np.arange(100.0, 140.0))
+        assert max_active[0] == 1  # device serialization held across frames
+
     def test_device_stage_serialized(self):
         """Device stages never overlap."""
         active = [0]

@@ -54,6 +54,46 @@ class TestCodecs:
             imageIO.imageStructToArray(s)
 
 
+class TestSlicedColumnViews:
+    def test_zero_copy_views_respect_arrow_offsets(self):
+        """A sliced struct column (non-zero Arrow offset — what
+        ``batch.slice``/``limit`` produce) must view the right rows'
+        dims and pixels, both per-row and on the same-size fast path."""
+        import numpy as np
+        import pyarrow as pa
+
+        from sparkdl_tpu.transformers.utils import packImageBatch
+
+        rng = np.random.default_rng(0)
+        arrays = [rng.integers(0, 255, (4 + i, 5, 3), dtype=np.uint8)
+                  for i in range(6)]
+        col = pa.array([imageIO.imageArrayToStruct(a) for a in arrays],
+                       type=imageIO.imageType)
+        sl = col.slice(2, 3)
+
+        h, w, c, off, vals = imageIO.imageColumnViews(sl)
+        assert list(h) == [6, 7, 8]
+        for i in range(3):
+            np.testing.assert_array_equal(
+                vals[off[i]:off[i + 1]].reshape(h[i], w[i], c[i]),
+                arrays[2 + i])
+
+        # same-size fast path on a sliced uniform column
+        uni = pa.array([imageIO.imageArrayToStruct(a)
+                        for a in arrays[:1] * 5], type=imageIO.imageType)
+        batch = imageIO.imageColumnToNHWC(uni.slice(1, 3), 4, 5, 3)
+        assert batch.shape == (3, 4, 5, 3)
+        np.testing.assert_array_equal(batch[0], arrays[0])
+
+        # and the resize pack path — pixel content must match packing
+        # the full column and slicing the result (catches row pointers
+        # computed from the unsliced buffer start)
+        packed = packImageBatch(sl, 5, 5, 3)
+        assert packed.shape == (3, 5, 5, 3)
+        np.testing.assert_array_equal(packed,
+                                      packImageBatch(col, 5, 5, 3)[2:5])
+
+
 class TestExoticModes:
     """Non-RGB source files must decode to the struct schema's channel
     model (the reference leaned on PIL the same way: everything not
