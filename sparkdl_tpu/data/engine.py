@@ -82,6 +82,11 @@ class LocalEngine:
         return batch
 
     def _run_partition(self, source, plan, index) -> pa.RecordBatch:
+        # with_index stages see the partition's logical identity, not
+        # its position in a reordered/subset frame (frame.Source)
+        logical = getattr(source, "logical_index", None)
+        if logical is not None:
+            index = logical
         attempts = 1 + max(0, self.max_retries)
         for attempt in range(attempts):
             try:

@@ -47,9 +47,15 @@ class Stage:
 @dataclasses.dataclass(frozen=True)
 class Source:
     """One partition source. ``load`` materializes the partition's batch;
-    ``num_rows`` is a hint for count() fast-path (None = unknown)."""
+    ``num_rows`` is a hint for count() fast-path (None = unknown).
+    ``logical_index``, when set, is the partition's identity for
+    ``with_index`` stages — so reordering/subsetting partitions
+    (``with_partition_order``, host sharding, per-epoch shuffles) never
+    changes what a deterministic stage like ``sample`` draws for a
+    given partition. None = use the positional index."""
     load: Callable[[], pa.RecordBatch]
     num_rows: Optional[int] = None
+    logical_index: Optional[int] = None
 
 
 class DataFrame:
@@ -202,7 +208,10 @@ class DataFrame:
 
                 rows = (min(take, s.num_rows)
                         if s.num_rows is not None else None)
-                out_sources.append(Source(_load, rows))
+                # keep the partition's logical identity for with_index
+                # stages (the un-limited frame's draws must be a prefix)
+                out_sources.append(dataclasses.replace(
+                    s, load=_load, num_rows=rows))
                 remaining = 0
         if not out_sources:  # keep the schema even with zero rows
             return DataFrame.from_table(
@@ -220,7 +229,14 @@ class DataFrame:
         if bad:
             raise IndexError(
                 f"partition index {bad[0]} out of range [0, {n})")
-        return DataFrame([self._sources[i] for i in indices],
+
+        def keep_identity(i: int) -> Source:
+            src = self._sources[i]
+            if src.logical_index is not None:
+                return src  # already pinned by an earlier reorder
+            return dataclasses.replace(src, logical_index=i)
+
+        return DataFrame([keep_identity(int(i)) for i in indices],
                          self._plan, self._engine)
 
     def union(self, other: "DataFrame") -> "DataFrame":
@@ -331,9 +347,11 @@ class DataFrame:
         if self._schema is None:
             if not self._sources:
                 return pa.schema([])
-            proto = self._sources[0].load().slice(0, 0)
+            src = self._sources[0]
+            idx = src.logical_index if src.logical_index is not None else 0
+            proto = src.load().slice(0, 0)
             for stage in self._plan:
-                proto = (stage.fn(proto, 0) if stage.with_index
+                proto = (stage.fn(proto, idx) if stage.with_index
                          else stage.fn(proto))
             self._schema = proto.schema
         return self._schema

@@ -91,23 +91,32 @@ class SparkEngine:
 
     def execute(self, sources: Sequence, plan: Sequence
                 ) -> Iterator[pa.RecordBatch]:
-        apply_plan = plan_to_map_in_arrow(plan)
-        sc = self.spark.sparkContext
-        # Ship the load callables in the task closure — Spark serializes
-        # tasks with cloudpickle, which handles the local closures every
-        # Source in this codebase uses (stdlib pickle does not).
-        loads = [s.load for s in sources]
+        stages = list(plan)
+        # Ship (load, logical_index) in the task closure — Spark
+        # serializes tasks with cloudpickle, which handles the local
+        # closures every Source in this codebase uses (stdlib pickle
+        # does not). Baking the index in keeps with_index stages on the
+        # partition's LOGICAL identity (same contract LocalEngine
+        # honors), not the Spark task's positional id.
+        loads = [(s.load,
+                  s.logical_index if getattr(s, "logical_index", None)
+                  is not None else i)
+                 for i, s in enumerate(sources)]
 
-        def run_partition(load) -> bytes:
-            out = list(apply_plan(iter([load()])))
+        def run_partition(task) -> bytes:
+            load, index = task
+            batch = load()
+            for stage in stages:
+                batch = (stage.fn(batch, index)
+                         if getattr(stage, "with_index", False)
+                         else stage.fn(batch))
             sink = pa.BufferOutputStream()
-            with pa.ipc.new_stream(sink, out[0].schema) as w:
-                for b in out:
-                    w.write_batch(b)
+            with pa.ipc.new_stream(sink, batch.schema) as w:
+                w.write_batch(batch)
             return sink.getvalue().to_pybytes()
 
-        results = sc.parallelize(loads, len(loads)) \
-            .map(run_partition).collect()
+        results = self.spark.sparkContext.parallelize(
+            loads, len(loads)).map(run_partition).collect()
         for raw in results:
             with pa.ipc.open_stream(pa.BufferReader(raw)) as r:
                 yield from r

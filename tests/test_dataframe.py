@@ -111,6 +111,37 @@ class TestEngine:
         df.collect()
         assert len(seen) >= 2
 
+    def test_with_index_stages_survive_partition_reorder(self):
+        """with_index stages (sample's per-partition determinism) must
+        see each partition's LOGICAL index, so reordering partitions —
+        per-epoch shuffles, host sharding — keeps the same rows
+        (regression: the engine passed the positional index)."""
+        df = DataFrame.from_table(pa.table({"x": np.arange(400.0)}), 8)
+        sampled = df.sample(0.3, seed=5)
+        baseline = sorted(r["x"] for r in sampled.collect_rows())
+
+        reordered = sampled.with_partition_order([5, 2, 7, 0, 1, 6, 3, 4])
+        got = sorted(r["x"] for r in reordered.collect_rows())
+        assert got == baseline
+
+        subset = sampled.with_partition_order([3, 1])
+        sub_rows = set(r["x"] for r in subset.collect_rows())
+        assert sub_rows <= set(baseline)
+        # nested reorder keeps the original identity pinned
+        nested = sampled.with_partition_order([3, 1]) \
+            .with_partition_order([1, 0])
+        assert set(r["x"] for r in nested.collect_rows()) == sub_rows
+
+        # limit's partially-taken source keeps the pinned identity too:
+        # the limited rows must be a prefix of the reordered frame's
+        n_lim = 7
+        tag = df.with_partition_order([5, 2, 7, 0, 1, 6, 3, 4]) \
+            .map_batches(lambda b, i: b.append_column(
+                "pid", pa.array([i] * b.num_rows)), with_index=True)
+        full_rows = tag.collect_rows()
+        lim_rows = tag.limit(n_lim).collect_rows()
+        assert lim_rows == full_rows[:n_lim]
+
     def test_concurrent_frames_share_engine_safely(self):
         """Two frames materializing concurrently on ONE engine (the
         default-engine reality: every transformer shares it) must each

@@ -278,6 +278,35 @@ class TestKerasImageFileEstimator:
         out = cv_model.transform(uri_label_df)
         assert out.tensor("prediction").shape == (20, 2)
 
+    def test_crossvalidator_with_streaming(self, keras_cls_file,
+                                           uri_label_df):
+        """CV folds compose with streaming training: each trial streams
+        its fold's partitions, nothing is localized."""
+        est = make_estimator(
+            keras_cls_file, streaming=True, parallelism=1,
+            kerasFitParams={"epochs": 2, "batch_size": 8,
+                            "learning_rate": 0.05, "seed": 1})
+        # streaming shuffles partition-then-rows (coarser than the
+        # in-memory global permutation), so tiny folds need a few more
+        # epochs for the strong config to separate cleanly
+        grid = (ParamGridBuilder()
+                .addGrid(est.getParam("kerasFitParams"),
+                         [{"epochs": 1, "batch_size": 8,
+                           "learning_rate": 1e-4, "seed": 1},
+                          {"epochs": 6, "batch_size": 8,
+                           "learning_rate": 0.05, "seed": 1}])
+                .build())
+        cv = CrossValidator(
+            estimator=est, estimatorParamMaps=grid,
+            evaluator=ClassificationEvaluator(predictionCol="prediction",
+                                              labelCol="label"),
+            numFolds=2, seed=0)
+        cv_model = cv.fit(uri_label_df)
+        assert len(cv_model.avgMetrics) == 2
+        # the higher-lr/6-epoch config must win on separable data
+        assert int(np.argmax(cv_model.avgMetrics)) == 1
+        assert len(cv_model.bestModel.history) == 6
+
 
 class TestEvaluators:
     def _df(self):
