@@ -113,6 +113,14 @@ def main() -> None:
         print("accelerator backend unavailable; benching on CPU",
               file=sys.stderr)
     import jax
+    try:
+        # persistent XLA cache: repeat bench runs skip the multi-minute
+        # InceptionV3 compile (single-core CPU fallback especially)
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/sparkdl_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
 
     from sparkdl_tpu.models.zoo import getModelFunction
     from sparkdl_tpu.runtime.runner import BatchRunner

@@ -12,7 +12,7 @@ cast into the model's first conv.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 
