@@ -66,6 +66,15 @@ class TestShardedInference:
         with pytest.raises(ValueError, match="jax backend"):
             ShardedBatchRunner(mf)
 
+    def test_strategy_validated_like_batch_runner(self):
+        """The sharded runner shares BatchRunner's strategy contract:
+        typos raise, and the choice is introspectable."""
+        mf = getModelFunction("TestNet", featurize=True)
+        with pytest.raises(ValueError, match="immediate"):
+            ShardedBatchRunner(mf, strategy="immedaite")
+        r = ShardedBatchRunner(mf, strategy="immediate")
+        assert r.strategy == "immediate" and r.max_inflight == 0
+
 
 class TestDPTraining:
 
