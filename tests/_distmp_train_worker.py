@@ -12,6 +12,7 @@ def main() -> None:
     port = sys.argv[2]
     images_dir = sys.argv[3]
     model_file = sys.argv[4]
+    num_partitions = int(sys.argv[5]) if len(sys.argv) > 5 else 4
 
     import numpy as np
 
@@ -30,7 +31,7 @@ def main() -> None:
     for p in sorted(glob.glob(os.path.join(images_dir, "*.png"))):
         label = int(os.path.basename(p).split("_")[1].split(".")[0]) % 2
         rows.append({"uri": p, "label": label})
-    df = DataFrame.from_pylist(rows, num_partitions=4)
+    df = DataFrame.from_pylist(rows, num_partitions=num_partitions)
 
     def loader(uri):
         from PIL import Image

@@ -85,14 +85,19 @@ def test_host_shard_indices_disjoint_covering(worker_results):
     assert a | b == set(range(NUM_PARTITIONS))
 
 
-@pytest.fixture(scope="module")
-def streaming_fit_results(tmp_path_factory):
+@pytest.fixture(scope="module", params=[4, 3],
+                ids=["even-shards", "uneven-shards"])
+def streaming_fit_results(request, tmp_path_factory):
     """2-process multi-host STREAMING estimator fit over shared images:
-    each host decodes only its shard; gradient sync crosses hosts."""
+    each host decodes only its shard; gradient sync crosses hosts.
+    With 3 partitions over 2 hosts the shards are UNEVEN, so the
+    smaller host must cycle its shard to meet the global step quota —
+    the collective-alignment path."""
     import keras
     import numpy as np
     from PIL import Image
 
+    num_partitions = request.param
     d = tmp_path_factory.mktemp("mhimgs")
     rng = np.random.default_rng(9)
     for i in range(16):
@@ -114,7 +119,8 @@ def streaming_fit_results(tmp_path_factory):
     port = _free_port()
     env = _clean_env()
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), str(port), str(d), model_file],
+        [sys.executable, worker, str(i), str(port), str(d), model_file,
+         str(num_partitions)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, env=env, cwd=REPO_ROOT) for i in range(2)]
     results = []
@@ -129,13 +135,14 @@ def streaming_fit_results(tmp_path_factory):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    return sorted(results, key=lambda r: r["pid"])
+    return num_partitions, sorted(results, key=lambda r: r["pid"])
 
 
 def test_multihost_streaming_fit_identical_models(streaming_fit_results):
-    a, b = streaming_fit_results
-    # each host streamed only its half of the partitions
-    assert a["local_partitions"] == 2 and b["local_partitions"] == 2
+    num_partitions, (a, b) = streaming_fit_results
+    # round-robin shard sizes (uneven when partitions don't divide)
+    assert a["local_partitions"] == (num_partitions + 1) // 2
+    assert b["local_partitions"] == num_partitions // 2
     # replicated state stayed in lockstep: same loss history, same
     # final weights on both hosts
     assert len(a["history"]) == 2
