@@ -102,11 +102,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def disabled_by_env() -> bool:
+    """Whether SPARKDL_TPU_NO_NATIVE disables the shim. "0"/"false"/""
+    mean NOT disabled — a truthy-string check would silently disable
+    for SPARKDL_TPU_NO_NATIVE=0. (Shared with the test skip-gate so the
+    accepted spellings can't drift.)"""
+    return os.environ.get("SPARKDL_TPU_NO_NATIVE", "").lower() \
+        not in ("", "0", "false")
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, building it on first call; None when
     disabled or unavailable."""
     global _lib, _tried
-    if os.environ.get("SPARKDL_TPU_NO_NATIVE"):
+    if disabled_by_env():
         return None
     with _lock:
         if _tried:

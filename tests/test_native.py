@@ -13,8 +13,7 @@ from sparkdl_tpu.transformers.utils import packImageBatch
 
 @pytest.fixture(scope="module")
 def built():
-    import os
-    if os.environ.get("SPARKDL_TPU_NO_NATIVE"):
+    if native.disabled_by_env():
         pytest.skip("native shim explicitly disabled via "
                     "SPARKDL_TPU_NO_NATIVE (fallback-path suite run)")
     ok = native.available()
