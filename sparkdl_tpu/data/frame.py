@@ -131,6 +131,13 @@ class DataFrame:
         array or a numpy array (auto-converted to a tensor column)."""
         from sparkdl_tpu.data.tensors import append_tensor_column
 
+        if not callable(fn):
+            raise TypeError(
+                f"with_column({name!r}) needs a per-batch function "
+                f"(batch -> column), got {type(fn).__name__}; a literal "
+                "column can't be appended lazily — partitions stream, "
+                "so compute it from each batch (e.g. from a key column)")
+
         def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
             col = fn(batch)
             if isinstance(col, np.ndarray):
@@ -225,6 +232,7 @@ class DataFrame:
         shuffles (streaming training) and host sharding (each index
         selects one existing partition; repeats allowed)."""
         n = len(self._sources)
+        indices = [int(i) for i in indices]  # one-shot iterables: read once
         bad = [i for i in indices if not (0 <= i < n)]
         if bad:
             raise IndexError(
@@ -236,7 +244,7 @@ class DataFrame:
                 return src  # already pinned by an earlier reorder
             return dataclasses.replace(src, logical_index=i)
 
-        return DataFrame([keep_identity(int(i)) for i in indices],
+        return DataFrame([keep_identity(i) for i in indices],
                          self._plan, self._engine)
 
     def union(self, other: "DataFrame") -> "DataFrame":

@@ -19,9 +19,23 @@ dropped in where available"). The binding has two halves:
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Sequence
+from typing import Callable, Iterator, Optional, Sequence
 
 import pyarrow as pa
+
+
+def apply_plan(stages: Sequence, batch: pa.RecordBatch,
+               index: int) -> pa.RecordBatch:
+    """Apply a stage plan to one batch — the stage contract
+    (``with_index`` stages receive the partition's logical index),
+    shared by both binding halves. (``LocalEngine._run_stage`` applies
+    the same contract per stage, separately, because it interleaves the
+    device lock and per-stage timing.)"""
+    for stage in stages:
+        batch = (stage.fn(batch, index)
+                 if getattr(stage, "with_index", False)
+                 else stage.fn(batch))
+    return batch
 
 
 def _require_pyspark():
@@ -35,7 +49,8 @@ def _require_pyspark():
             "pipeline runs identically on it.") from e
 
 
-def plan_to_map_in_arrow(plan: Sequence) -> Callable[
+def plan_to_map_in_arrow(plan: Sequence, index: Optional[int] = None
+                         ) -> Callable[
         [Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
     """Compile a stage plan into a ``mapInArrow`` function.
 
@@ -44,6 +59,10 @@ def plan_to_map_in_arrow(plan: Sequence) -> Callable[
         fn = plan_to_map_in_arrow(df_tpu._plan)
         out = spark_df.mapInArrow(fn, schema=arrow_schema_ddl)
 
+    ``index`` bakes in a fixed partition index for ``with_index``
+    stages; when None it is taken from the Spark ``TaskContext``
+    (falling back to 0 outside Spark).
+
     All stages run inline on the Spark task's Python worker. Executors
     that own an exclusive accelerator (TPU) must run ONE task at a time
     (``spark.task.cpus`` = executor cores, the standard accelerator
@@ -51,23 +70,22 @@ def plan_to_map_in_arrow(plan: Sequence) -> Callable[
     the same device.
     """
     stages = list(plan)
+    baked = index
 
     def apply_batches(batches: Iterator[pa.RecordBatch]
                       ) -> Iterator[pa.RecordBatch]:
-        index = 0
-        try:  # Spark partition id for with_index stages, when available
-            from pyspark import TaskContext
-            ctx = TaskContext.get()
-            if ctx is not None:
-                index = ctx.partitionId()
-        except ImportError:
-            pass
+        index = baked
+        if index is None:
+            index = 0
+            try:  # Spark partition id for with_index stages
+                from pyspark import TaskContext
+                ctx = TaskContext.get()
+                if ctx is not None:
+                    index = ctx.partitionId()
+            except ImportError:
+                pass
         for batch in batches:
-            for stage in stages:
-                batch = (stage.fn(batch, index)
-                         if getattr(stage, "with_index", False)
-                         else stage.fn(batch))
-            yield batch
+            yield apply_plan(stages, batch, index)
 
     return apply_batches
 
@@ -105,11 +123,7 @@ class SparkEngine:
 
         def run_partition(task) -> bytes:
             load, index = task
-            batch = load()
-            for stage in stages:
-                batch = (stage.fn(batch, index)
-                         if getattr(stage, "with_index", False)
-                         else stage.fn(batch))
+            batch = apply_plan(stages, load(), index)
             sink = pa.BufferOutputStream()
             with pa.ipc.new_stream(sink, batch.schema) as w:
                 w.write_batch(batch)

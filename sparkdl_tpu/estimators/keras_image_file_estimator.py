@@ -716,6 +716,17 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 (trainable, non_trainable, opt_state), rep)
             rows_per_step = (batch_size * info.local_device_count
                              // info.global_device_count)
+            if rows_per_step * info.process_count != batch_size:
+                # _compile_step rounds batch_size to the data-axis size,
+                # which makes this exact for every standard mesh; a
+                # layout where it isn't must fail loudly on EVERY host
+                # before the first collective, not deep inside sharding
+                raise ValueError(
+                    f"global batch {batch_size} does not split evenly "
+                    f"across {info.process_count} hosts x "
+                    f"{info.local_device_count} local devices "
+                    f"({info.global_device_count} global); choose a "
+                    "batch_size divisible by the global device count")
             steps_per_epoch = max(1, -(-n // batch_size))
 
             def place(xb, yb):

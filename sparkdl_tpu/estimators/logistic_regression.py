@@ -148,7 +148,20 @@ class LogisticRegression(Estimator, HasLabelCol):
             .to_pylist())
         if len(X) == 0:
             raise ValueError("cannot fit on an empty dataset")
-        if y.ndim != 1 or not np.issubdtype(y.dtype, np.integer):
+        if y.ndim != 1:
+            raise ValueError(
+                f"labelCol must hold scalar class ids, got shape "
+                f"{y.shape}")
+        if np.issubdtype(y.dtype, np.floating):
+            # Spark ML labels are doubles holding integral class ids
+            # (0.0, 1.0, ...) — accept those; reject true fractions
+            if len(y) and not (y == np.round(y)).all():
+                i = int(np.flatnonzero(y != np.round(y))[0])
+                raise ValueError(
+                    f"labelCol must hold integral class ids; row {i} "
+                    f"is {y[i]!r}")
+            y = y.astype(np.int64)
+        elif not np.issubdtype(y.dtype, np.integer):
             raise ValueError(
                 f"labelCol must hold integer class ids, got dtype "
                 f"{y.dtype} shape {y.shape}")

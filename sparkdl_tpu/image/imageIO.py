@@ -209,7 +209,15 @@ def imageColumnToNHWC(column, height: int, width: int,
     row is the target size the batch is literally a reshaped view of the
     column's data buffer — no per-row Python, no memcpy. The returned
     array may be read-only (it aliases the Arrow buffer)."""
-    heights, widths, channels, offsets, values = imageColumnViews(column)
+    return viewsToNHWC(imageColumnViews(column), height, width, nChannels)
+
+
+def viewsToNHWC(views, height: int, width: int,
+                nChannels: int = 3) -> np.ndarray:
+    """The :func:`imageColumnToNHWC` core over already-computed
+    :func:`imageColumnViews` output, so hot paths that hold the views
+    (``packImageBatch``) don't re-derive them from the column."""
+    heights, widths, channels, offsets, values = views
     n = len(heights)
     bad = np.flatnonzero((heights != height) | (widths != width)
                          | (channels != nChannels))

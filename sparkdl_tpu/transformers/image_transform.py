@@ -76,11 +76,11 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
         mode = self.getOutputMode()
         src_hw = self.getOrDefault("deviceResizeFrom")
         if src_hw is not None:
-            # mesh-jitted programs need the XLA fallback: a Pallas call
-            # has no GSPMD partitioning rule (ops/infeed.py)
+            # XLA resize path always: it's the measured default AND the
+            # only one with a GSPMD partitioning rule for useMesh
+            # (ops/infeed.py)
             wrapped = tfr_utils.deviceResizeModel(
-                mf, src_hw,
-                use_pallas=False if self.getUseMesh() else None)
+                mf, src_hw, use_pallas=False)
             if wrapped is mf:
                 src_hw = None  # (h, w) == model input: plain host path
             else:

@@ -33,13 +33,13 @@ def packImageBatch(column, height: int, width: int, nChannels: int = 3,
     (bilinear vs PIL's triangle filter), as the reference's JVM and PIL
     paths did.
     """
-    heights, widths, channels, offsets, values = \
-        imageIO.imageColumnViews(column)
+    views = imageIO.imageColumnViews(column)
+    heights, widths, channels, offsets, values = views
     n = len(heights)
     same = ((heights == height) & (widths == width)
             & (channels == nChannels))
     if same.all():
-        return imageIO.imageColumnToNHWC(column, height, width, nChannels)
+        return imageIO.viewsToNHWC(views, height, width, nChannels)
     if not resize:
         i = int(np.flatnonzero(~same)[0])
         raise ValueError(
@@ -153,8 +153,9 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
     def resize(inputs):
         from sparkdl_tpu.ops import fused_resize_normalize
         x = inputs[in_name]
-        # Pallas kernel on real TPU, identical XLA einsum chain
-        # elsewhere (ops/infeed.py; parity with jax.image.resize is
+        # XLA einsum chain by default (measured faster than the Pallas
+        # kernel on v5e AND fusable into the model program —
+        # ops/infeed.py docstring; parity with jax.image.resize is
         # kernel-tested)
         y = fused_resize_normalize(x, (h, w), use_pallas=use_pallas)
         if np.dtype(in_dtype) == np.uint8:

@@ -132,6 +132,14 @@ class TestEngine:
             .with_partition_order([1, 0])
         assert set(r["x"] for r in nested.collect_rows()) == sub_rows
 
+        # a one-shot iterable must be read once, not consumed by the
+        # bounds check and then silently produce a 0-partition frame
+        gen = (i for i in [5, 2, 7, 0, 1, 6, 3, 4])
+        from_gen = sorted(
+            r["x"] for r in sampled.with_partition_order(gen)
+            .collect_rows())
+        assert from_gen == baseline
+
         # limit's partially-taken source keeps the pinned identity too:
         # the limited rows must be a prefix of the reordered frame's
         n_lim = 7

@@ -130,6 +130,24 @@ class TestLogisticRegression:
         assert (np.linalg.norm(reg.coefficients)
                 < np.linalg.norm(free.coefficients))
 
+    def test_double_labels_spark_convention(self):
+        """Spark ML label columns are float64 holding integral class ids
+        (0.0, 1.0) — accept those identically to ints; reject true
+        fractions loudly. In particular a LogisticRegressionModel's own
+        predictionCol (float64 class label) must be usable as a label."""
+        import pyarrow as pa
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 2, 60)
+        X = rng.normal(0, 1, (60, 4)).astype(np.float32) + 3.0 * y[:, None]
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": float(v)} for v in y])
+        batch = append_tensor_column(batch, "features", X)
+        df = DataFrame.from_batches([batch])
+        model = LogisticRegression(maxIter=100, learningRate=0.2).fit(df)
+        assert model.numClasses == 2
+        # (fractional labels stay rejected: test_bad_labels_rejected)
+
     def test_negative_labels_rejected(self):
         """{-1, 1} labels must error, not silently wrap through np.eye
         fancy-indexing (regression)."""
@@ -166,7 +184,7 @@ class TestLogisticRegression:
         batch = append_tensor_column(
             batch, "features", np.zeros((2, 3), np.float32))
         df = DataFrame.from_batches([batch])
-        with pytest.raises(ValueError, match="integer class ids"):
+        with pytest.raises(ValueError, match="integral class ids"):
             LogisticRegression().fit(df)
 
     def test_empty_dataset_rejected(self):
