@@ -12,9 +12,15 @@ dropped in where available"). The binding has two halves:
   process per executor). This half is pure and testable without Spark.
 * :class:`SparkEngine` — an engine implementing the local
   ``execute(sources, plan)`` contract by parallelizing partition loads
-  as a Spark job. Requires pyspark (not installed in this environment,
-  so construction raises with instructions — the seam is the deliverable
-  here and the local engine is the default everywhere).
+  as a Spark job. Constructing one without pyspark raises with
+  instructions; passing an explicit session duck-types (execute() only
+  needs ``sparkContext.parallelize(seq, n).map(fn).collect()``), which
+  is how the contract test drives the full path — including cloudpickle
+  round-trips of the task closures, the way Spark ships them.
+  Shippability is designed, not assumed: RunnerMetrics recreates its
+  lock on arrival, ModelFunction drops process-local jit/device caches
+  on the wire, and host-backend (TF) functions refuse to serialize with
+  a re-ingest instruction.
 """
 
 from __future__ import annotations
@@ -101,10 +107,13 @@ class SparkEngine:
     """
 
     def __init__(self, spark=None):
-        _require_pyspark()
         if spark is None:
+            _require_pyspark()
             from pyspark.sql import SparkSession
             spark = SparkSession.builder.getOrCreate()
+        # An explicit session is duck-typed: execute() only needs
+        # sparkContext.parallelize(seq, n).map(fn).collect(), which also
+        # makes the engine contract-testable without pyspark.
         self.spark = spark
 
     def execute(self, sources: Sequence, plan: Sequence

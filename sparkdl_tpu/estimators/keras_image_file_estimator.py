@@ -649,6 +649,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         array over the pod-wide mesh, and XLA's gradient all-reduce
         crosses hosts — every host takes the same (globally derived)
         number of steps per epoch, so collectives stay aligned.
+        ``checkpointDir`` works multi-host too: it must name a path all
+        hosts can reach (GCS/NFS — the standard pod setup); orbax saves
+        per epoch with every host participating, and a resumed run
+        first AGREES on the restore step across hosts over DCN.
         """
         import jax
 
@@ -664,12 +668,6 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         info = dist.host_info()
         multihost = info.process_count > 1
         if multihost:
-            if est.isDefined("checkpointDir"):
-                raise ValueError(
-                    "checkpointDir with multi-host streaming is not "
-                    "supported: per-epoch saves would need coordinated "
-                    "multi-host checkpointing; run with a single "
-                    "process or drop checkpointDir")
             if not est.getOrDefault("useMesh"):
                 raise ValueError(
                     "multi-host streaming requires useMesh=True (the "
@@ -710,8 +708,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             # the exact mesh _compile_step jitted against — placed
             # arrays and the jit's in_shardings cannot diverge
             rep, dat = replicated(mesh), data_sharding(mesh)
-            # every host holds identical initial values; place them as
-            # replicated global arrays so the jitted shardings match
+            # every host holds identical (fresh or restored) values;
+            # place them as replicated global arrays so the jitted
+            # shardings match
             trainable, non_trainable, opt_state = jax.device_put(
                 (trainable, non_trainable, opt_state), rep)
             rows_per_step = (batch_size * info.local_device_count
@@ -743,6 +742,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             def place(xb, yb):
                 return jnp.asarray(xb), jnp.asarray(yb)
 
+        # Checkpointing runs AFTER placement so the restore template in
+        # a multi-host run holds the globally-replicated arrays — orbax
+        # then follows its own multiprocess protocol: every host calls
+        # save/restore on the SAME directory (checkpointDir must be a
+        # path all hosts see — GCS/NFS in production; a per-host local
+        # path deadlocks orbax's cross-host barriers, verified), the
+        # primary writes, everyone restores into the global sharding.
         rng = np.random.default_rng(seed)
         history: List[float] = []
         checkpointer = None
@@ -757,8 +763,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 f"{self._streaming_fingerprint(est, uris, labels_all)}")
             checkpointer = PytreeCheckpointer(trial_dir)
             usable = [s for s in checkpointer.all_steps() if s <= epochs]
-            if usable:
-                start_epoch = max(usable)
+            local_best = max(usable) if usable else 0
+            # hosts must restore the SAME step: filesystem listing
+            # races would otherwise fork the replicated state and
+            # deadlock the first collective
+            start_epoch = (dist.agree_resume_step(local_best, usable)
+                           if multihost else local_best)
+            if start_epoch:
                 template = {"trainable": trainable,
                             "non_trainable": non_trainable,
                             "opt_state": opt_state,
@@ -786,11 +797,17 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 losses.append(loss)
             history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
+                # live arrays, not device_get copies: jax arrays are
+                # immutable and the step doesn't donate, so the async
+                # save reads them safely — and multi-host orbax needs
+                # the global arrays to run its every-host-participates
+                # write protocol (a host-local numpy copy would not
+                # carry the global sharding)
                 checkpointer.save(
                     len(history),
-                    {"trainable": jax.device_get(trainable),
-                     "non_trainable": jax.device_get(non_trainable),
-                     "opt_state": jax.device_get(opt_state),
+                    {"trainable": trainable,
+                     "non_trainable": non_trainable,
+                     "opt_state": opt_state,
                      "history": np.asarray(history, np.float64)})
         if checkpointer is not None:
             checkpointer.close()

@@ -340,6 +340,28 @@ class ModelFunction:
         mf._output_signature = out_sig
         return mf
 
+    # -- shipping -----------------------------------------------------------
+
+    def __getstate__(self):
+        """Stage closures holding a ModelFunction ship to Spark
+        executors (spark_binding; cloudpickle handles apply_fn and the
+        host params pytree). Compiled programs and device-resident
+        params are process-local — drop them on the wire; the executor
+        re-jits and re-places lazily, exactly like a fresh process.
+        Host-backend functions (ingested TF graphs) hold live TF objects
+        and cannot ship — re-ingest from the artifact on the executor."""
+        if self.backend == "host":
+            raise TypeError(
+                f"host-backend ModelFunction {self.name!r} cannot be "
+                "serialized for shipping (it wraps live TF runtime "
+                "state); re-create it on the worker from its source "
+                "artifact (SavedModel/checkpoint path), or export a "
+                "jax-backend model to StableHLO instead")
+        state = self.__dict__.copy()
+        state["_jit_cache"] = {}
+        state["_params_cache"] = {}
+        return state
+
     def __repr__(self) -> str:
         outs = self._output_names or "?"
         return (f"ModelFunction({self.name}, backend={self.backend}, "

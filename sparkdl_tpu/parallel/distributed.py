@@ -133,6 +133,49 @@ def host_shard_dataframe(df: DataFrame,
     return df.with_partition_order(idxs)
 
 
+def agree_min(value: int) -> int:
+    """The minimum of ``value`` across all processes (identity when
+    single-process). Every process must call this at the same point —
+    it launches a tiny global computation over DCN."""
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    vals = multihost_utils.process_allgather(np.int64(value))
+    return int(np.min(vals))
+
+
+def agree_resume_step(local_best: int,
+                      available: Sequence[int],
+                      _agree=None) -> int:
+    """Globally agree which checkpoint step to resume from, given this
+    host's newest usable step and its full usable list. Hosts write
+    checkpoints in lockstep but views can diverge (a crash mid-save, a
+    replaced machine): resume from the newest step EVERY host still
+    holds, or from scratch when no common step exists — one host
+    restoring a different epoch than the others would silently fork the
+    replicated state and deadlock the first collective.
+
+    The descent agrees round by round: each host proposes its best step
+    ``<= candidate`` and the global min becomes the next candidate, so
+    the loop converges on ``max(intersection)`` (not merely testing one
+    candidate, which would drop to 0 when the min-of-bests is missing
+    somewhere despite a lower common step). The candidate is a
+    globally-agreed value, so every host runs the SAME number of
+    collectives. ``_agree`` is injectable for single-process tests."""
+    agree = _agree or agree_min
+    avail = sorted(set(int(s) for s in available))
+    candidate = agree(int(local_best))
+    while candidate > 0:
+        below = [s for s in avail if s <= candidate]
+        mine = below[-1] if below else 0
+        agreed = agree(mine)
+        if agreed == candidate:
+            return candidate
+        candidate = agreed
+    return 0
+
+
 def global_mesh(spec=None) -> "jax.sharding.Mesh":
     """The ("data", "model") mesh over ALL processes' devices —
     ``jax.devices()`` is global after :func:`initialize`, so the same

@@ -160,6 +160,19 @@ class RunnerMetrics:
             self.batches += batches
             self.seconds += seconds
 
+    # Locks don't pickle; stage closures holding a metrics object must
+    # ship to Spark executors (spark_binding), so the lock is dropped on
+    # the wire and recreated on arrival (counters travel as values —
+    # each task counts its own work, as Spark metrics do).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     @property
     def rows_per_second(self) -> float:
         return self.rows / self.seconds if self.seconds else 0.0

@@ -13,6 +13,10 @@ def main() -> None:
     images_dir = sys.argv[3]
     model_file = sys.argv[4]
     num_partitions = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    # optional: a checkpoint dir triggers the interrupted-run scenario
+    # (fit 1 epoch with checkpoints, then extend to 2 — must resume and
+    # land exactly where the uninterrupted 2-epoch fit lands)
+    ckpt_dir = sys.argv[6] if len(sys.argv) > 6 else None
 
     import numpy as np
 
@@ -20,6 +24,15 @@ def main() -> None:
 
     dist.initialize(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=2, process_id=pid)
+
+    import jax
+
+    # persistent compile cache: the checkpoint scenario runs THREE fits
+    # of the same program shapes — compile once (concurrent-safe:
+    # atomic renames)
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/sparkdl_tpu_jax_cache_mp")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
     import glob
     import os
@@ -38,27 +51,45 @@ def main() -> None:
         return np.asarray(Image.open(uri).convert("RGB"),
                           dtype=np.float32) / 255.0
 
-    est = KerasImageFileEstimator(
-        inputCol="uri", outputCol="pred", labelCol="label",
-        imageLoader=loader, modelFile=model_file,
-        kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
-        kerasFitParams={"epochs": 2, "batch_size": 8,
-                        "learning_rate": 0.05, "seed": 3},
-        streaming=True, useMesh=True)
-    model = est.fit(df)
+    def make_est(epochs, checkpointDir=None):
+        kw = dict(
+            inputCol="uri", outputCol="pred", labelCol="label",
+            imageLoader=loader, modelFile=model_file,
+            kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+            kerasFitParams={"epochs": epochs, "batch_size": 8,
+                            "learning_rate": 0.05, "seed": 3},
+            streaming=True, useMesh=True)
+        if checkpointDir:
+            kw["checkpointDir"] = checkpointDir
+        return KerasImageFileEstimator(**kw)
 
-    # weight digest proves every host converged to identical params
-    leaves = [np.asarray(v) for v in
-              model.modelFunction.params["trainable"]]
-    digest = float(sum(np.abs(a).sum() for a in leaves))
+    def digest_of(model):
+        # weight digest proves every host holds identical params
+        leaves = [np.asarray(v) for v in
+                  model.modelFunction.params["trainable"]]
+        return float(sum(np.abs(a).sum() for a in leaves))
 
-    mine = dist.host_shard_dataframe(df)
-    print("RESULT " + json.dumps({
+    model = make_est(epochs=2).fit(df)
+
+    result = {
         "pid": pid,
         "history": model.history,
-        "weight_digest": digest,
-        "local_partitions": mine.num_partitions,
-    }), flush=True)
+        "weight_digest": digest_of(model),
+        "local_partitions": dist.host_shard_dataframe(df).num_partitions,
+    }
+
+    if ckpt_dir:
+        # interrupted: 1 epoch saved, then the same config extended to
+        # 2 epochs resumes from the per-host checkpoint (every host
+        # agrees on the resume step over DCN) and must match the
+        # uninterrupted run above bit-for-bit in history and weights
+        short = make_est(epochs=1, checkpointDir=ckpt_dir).fit(df)
+        resumed = make_est(epochs=2, checkpointDir=ckpt_dir).fit(df)
+        result["short_history"] = short.history
+        result["resumed_history"] = resumed.history
+        result["resumed_digest"] = digest_of(resumed)
+
+    print("RESULT " + json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -85,8 +85,8 @@ def test_host_shard_indices_disjoint_covering(worker_results):
     assert a | b == set(range(NUM_PARTITIONS))
 
 
-@pytest.fixture(scope="module", params=[4, 3],
-                ids=["even-shards", "uneven-shards"])
+@pytest.fixture(scope="module", params=[4, 3, "resume"],
+                ids=["even-shards", "uneven-shards", "ckpt-resume"])
 def streaming_fit_results(request, tmp_path_factory):
     """2-process multi-host STREAMING estimator fit over shared images:
     each host decodes only its shard; gradient sync crosses hosts.
@@ -97,7 +97,8 @@ def streaming_fit_results(request, tmp_path_factory):
     import numpy as np
     from PIL import Image
 
-    num_partitions = request.param
+    resume = request.param == "resume"
+    num_partitions = 4 if resume else request.param
     d = tmp_path_factory.mktemp("mhimgs")
     rng = np.random.default_rng(9)
     for i in range(16):
@@ -118,15 +119,17 @@ def streaming_fit_results(request, tmp_path_factory):
                           "_distmp_train_worker.py")
     port = _free_port()
     env = _clean_env()
+    argv = [str(port), str(d), model_file, str(num_partitions)]
+    if resume:
+        argv.append(str(tmp_path_factory.mktemp("mhckpt")))
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), str(port), str(d), model_file,
-         str(num_partitions)],
+        [sys.executable, worker, str(i)] + argv,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, env=env, cwd=REPO_ROOT) for i in range(2)]
     results = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=600)
             assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
             line = [l for l in out.splitlines()
                     if l.startswith("RESULT ")][0]
@@ -150,6 +153,30 @@ def test_multihost_streaming_fit_identical_models(streaming_fit_results):
     assert np.isfinite(a["weight_digest"])
     assert a["weight_digest"] == pytest.approx(b["weight_digest"],
                                                rel=1e-6)
+
+
+def test_multihost_checkpoint_resume(streaming_fit_results):
+    """Interrupted multi-host streaming training (1 epoch saved, budget
+    extended to 2) must resume from the per-host checkpoints — resume
+    step agreed over DCN — and reproduce the uninterrupted 2-epoch run
+    exactly, with identical state on every host."""
+    _, results = streaming_fit_results
+    a, b = results
+    if "resumed_history" not in a:
+        pytest.skip("checkpoint scenario runs in the ckpt-resume param")
+    for r in results:
+        assert len(r["short_history"]) == 1
+        assert len(r["resumed_history"]) == 2
+        # epoch 0 was NOT retrained: its loss is the restored history
+        assert r["resumed_history"][0] == pytest.approx(
+            r["short_history"][0], rel=1e-6)
+        # the resumed run ends exactly where the uninterrupted run does
+        assert r["resumed_history"] == pytest.approx(r["history"],
+                                                     rel=1e-6)
+        assert r["resumed_digest"] == pytest.approx(r["weight_digest"],
+                                                    rel=1e-6)
+    assert a["resumed_digest"] == pytest.approx(b["resumed_digest"],
+                                                rel=1e-6)
 
 
 def test_global_mesh_train_step(worker_results):

@@ -194,6 +194,17 @@ class TestIngestionMatrix:
         out = mf({"x": x_batch})
         np.testing.assert_allclose(out["y"], expected, atol=1e-5)
 
+    def test_host_backend_refuses_to_ship(self, mlp_weights, tmp_path):
+        """Host-backend ModelFunctions wrap live TF state; pickling one
+        for a Spark task must fail with the re-ingest instruction, not
+        ship something that can't run on the executor."""
+        import pickle
+
+        d = self._saved_model(mlp_weights, tmp_path)
+        mf = ModelIngest.fromSavedModel(d)
+        with pytest.raises(TypeError, match="re-create it on the worker"):
+            pickle.dumps(mf)
+
     def _frozen_graph_def(self, mlp_weights):
         """The TF1-era artifact: a frozen (constants-only) GraphDef with
         named feed/fetch tensors, as serialized bytes."""

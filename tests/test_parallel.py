@@ -184,3 +184,76 @@ class TestCheckpoint:
         for a, b in zip(jax.tree.leaves(state.params),
                         jax.tree.leaves(restored.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+class TestAgreeResumeStep:
+    """Single-process simulation of the multi-host resume-step descent:
+    a scripted agree function plays the global-min rounds of a 2-host
+    cluster, asserting each host proposes the right values and both
+    converge on max(intersection) with the same collective count."""
+
+    @staticmethod
+    def _simulate(hosts):
+        """hosts: list of (local_best, available). Runs every host's
+        agree_resume_step in lockstep with a real cross-host min."""
+        from sparkdl_tpu.parallel.distributed import agree_resume_step
+
+        proposals = [[] for _ in hosts]
+        results = [None] * len(hosts)
+
+        # threads: each host runs the real function; a barrier computes
+        # the min per round
+        import threading
+        n = len(hosts)
+        lock = threading.Condition()
+        round_vals: dict = {}
+
+        def agree_factory(i):
+            my_round = [0]
+
+            def agree(value):
+                r = my_round[0]
+                my_round[0] += 1
+                with lock:
+                    round_vals.setdefault(r, {})[i] = int(value)
+                    lock.notify_all()
+                    while len(round_vals[r]) < n:
+                        lock.wait(timeout=10)
+                    proposals[i].append(int(value))
+                    return min(round_vals[r].values())
+            return agree
+
+        threads = []
+        for i, (best, avail) in enumerate(hosts):
+            def run(i=i, best=best, avail=avail):
+                results[i] = agree_resume_step(best, avail,
+                                               _agree=agree_factory(i))
+            t = threading.Thread(target=run)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "agreement deadlocked"
+        return results, proposals
+
+    def test_diverged_views_find_common_step(self):
+        # host A holds {1,3} (step-2 save failed), host B holds {1,2}
+        # (crashed mid-save of 3): the newest COMMON step is 1
+        results, proposals = self._simulate([(3, [1, 3]), (2, [1, 2])])
+        assert results == [1, 1]
+        # rounds: bests (3,2)->2; best<=2: (1,2)->1; best<=1: (1,1)->1
+        assert proposals[0] == [3, 1, 1]
+        assert proposals[1] == [2, 2, 1]
+
+    def test_identical_views_resume_newest(self):
+        results, _ = self._simulate([(4, [2, 3, 4]), (4, [2, 3, 4])])
+        assert results == [4, 4]
+
+    def test_one_host_empty_starts_fresh(self):
+        results, _ = self._simulate([(3, [1, 2, 3]), (0, [])])
+        assert results == [0, 0]
+
+    def test_single_process_identity(self):
+        from sparkdl_tpu.parallel.distributed import agree_resume_step
+        assert agree_resume_step(5, [3, 5]) == 5
+        assert agree_resume_step(0, []) == 0
