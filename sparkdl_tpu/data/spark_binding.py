@@ -20,7 +20,10 @@ dropped in where available"). The binding has two halves:
   Shippability is designed, not assumed: RunnerMetrics recreates its
   lock on arrival, ModelFunction drops process-local jit/device caches
   on the wire, and host-backend (TF) functions refuse to serialize with
-  a re-ingest instruction.
+  a re-ingest instruction. Driver-side ``RunnerMetrics``/``StageMetrics``
+  counters do NOT aggregate across Spark tasks (each task counts into
+  its own copy and discards it) — on a cluster, use Spark's task
+  metrics/UI; driver-side metrics are a LocalEngine feature.
 """
 
 from __future__ import annotations

@@ -150,7 +150,8 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
 
     def __init__(self, model_fn: ModelFunction, *, inputCol, outputCol,
                  imageLoader, outputMode="vector", batchSize=64,
-                 useMesh=False, history: Optional[List[float]] = None):
+                 useMesh=False, history: Optional[List[float]] = None,
+                 resumedFrom: int = 0):
         super().__init__()
         self._setDefault(outputMode="vector", batchSize=64, useMesh=False)
         self._set(inputCol=inputCol, outputCol=outputCol,
@@ -158,6 +159,9 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
                   batchSize=batchSize, useMesh=useMesh)
         self.modelFunction = model_fn
         self.history = history or []  # per-epoch mean training loss
+        # which epoch this fit restored from (0 = trained from scratch)
+        # — observable proof a checkpointDir resume actually happened
+        self.resumedFrom = int(resumedFrom)
         self.metrics = RunnerMetrics()
 
     def _transform(self, dataset):
@@ -425,7 +429,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             mf, inputCol=est.getInputCol(), outputCol=est.getOutputCol(),
             imageLoader=est.getImageLoader(), outputMode=est.getOutputMode(),
             batchSize=est.getBatchSize(),
-            useMesh=est.getOrDefault("useMesh"), history=history)
+            useMesh=est.getOrDefault("useMesh"), history=history,
+            resumedFrom=start_epoch)
 
     def _compile_step(self, step, batch_size: int):
         """jit the train step — against the mesh (batch split over the
@@ -821,7 +826,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             mf, inputCol=est.getInputCol(), outputCol=est.getOutputCol(),
             imageLoader=est.getImageLoader(), outputMode=est.getOutputMode(),
             batchSize=est.getBatchSize(),
-            useMesh=est.getOrDefault("useMesh"), history=history)
+            useMesh=est.getOrDefault("useMesh"), history=history,
+            resumedFrom=start_epoch)
 
     # -- Estimator interface -------------------------------------------------
 

@@ -77,12 +77,26 @@ class ShardedBatchRunner:
         fn = self.model_fn.sharded_jitted(self.mesh)
         params = self.model_fn.replicated_params(self.mesh)
 
+        # Single-process jit accepts numpy args and shards them itself;
+        # a multi-process runtime refuses numpy for non-trivially
+        # sharded args even on an all-local mesh — place each chunk
+        # explicitly there (all this mesh's devices are addressable, so
+        # the device_put is purely local).
+        place = None
+        if jax.process_count() > 1:
+            from sparkdl_tpu.parallel.mesh import data_sharding
+            dat = data_sharding(self.mesh)
+            place = lambda c: {k: jax.device_put(v, dat)  # noqa: E731
+                               for k, v in c.items()}
+
         t0 = time.perf_counter()
         gb = self._global_batch
         pending: collections.deque = collections.deque()
         outs: Dict[str, List[np.ndarray]] = {}
         batches = 0
         for valid, chunk in iter_padded_chunks(inputs, n, gb):
+            if place is not None:
+                chunk = place(chunk)
             pending.append((valid, fn(params, chunk)))
             batches += 1
             drain_bounded(pending, outs, self.max_inflight)

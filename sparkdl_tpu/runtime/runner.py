@@ -162,8 +162,12 @@ class RunnerMetrics:
 
     # Locks don't pickle; stage closures holding a metrics object must
     # ship to Spark executors (spark_binding), so the lock is dropped on
-    # the wire and recreated on arrival (counters travel as values —
-    # each task counts its own work, as Spark metrics do).
+    # the wire and recreated on arrival. NOTE the boundary this implies:
+    # each task increments its own deserialized copy and discards it —
+    # the driver-side object stays at zero on SparkEngine runs. That is
+    # deliberate (aggregating counters back through the Arrow stream is
+    # not the engine contract); on a cluster, read Spark's own task
+    # metrics/UI. Driver-side metrics are a LocalEngine feature.
     def __getstate__(self):
         state = self.__dict__.copy()
         del state["_lock"]

@@ -88,6 +88,11 @@ def main() -> None:
         result["short_history"] = short.history
         result["resumed_history"] = resumed.history
         result["resumed_digest"] = digest_of(resumed)
+        # observable resume proof: a silent from-scratch retrain would
+        # reproduce identical history/weights (deterministic seeds), so
+        # assert the restore actually happened via resumedFrom
+        result["short_resumed_from"] = short.resumedFrom
+        result["resumed_from"] = resumed.resumedFrom
 
     print("RESULT " + json.dumps(result), flush=True)
 

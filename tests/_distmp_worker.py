@@ -11,6 +11,43 @@ import json
 import sys
 
 
+def build_image_frame(num_rows: int, num_partitions: int):
+    """A deterministic image frame every process (and the test's
+    reference run) can rebuild identically: row ``i`` carries a seeded
+    32x32 uint8 image and key column ``x = i``."""
+    import numpy as np
+    import pyarrow as pa
+
+    from sparkdl_tpu.data.frame import DataFrame
+    from sparkdl_tpu.image import imageIO
+
+    structs = []
+    for i in range(num_rows):
+        arr = np.random.default_rng(1000 + i).integers(
+            0, 255, (32, 32, 3), dtype=np.uint8)
+        structs.append(imageIO.imageArrayToStruct(arr, origin=str(i)))
+    batch = imageIO.structsToBatch(
+        structs, extra_columns={"x": pa.array(list(range(num_rows)))})
+    return DataFrame.from_table(
+        pa.Table.from_batches([batch]), num_partitions)
+
+
+def featurize_rows(df):
+    """(x, sum(features)) per row through DeepImageFeaturizer(TestNet)
+    on the local-device mesh — multi-host DP inference is exactly
+    'every host runs its shard on its own chips', no collectives."""
+    import numpy as np
+
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    out = DeepImageFeaturizer(modelName="TestNet", inputCol="image",
+                              outputCol="f", useMesh=True).transform(df)
+    table = out.collect()
+    xs = table.column("x").to_pylist()
+    sums = [float(np.sum(v)) for v in table.column("f").to_pylist()]
+    return sorted(zip(xs, sums))
+
+
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
@@ -84,6 +121,11 @@ def main() -> None:
     state, metrics = jitted(state, batch)
     train_loss = float(metrics["loss"])
 
+    # multi-host DP inference: featurize ONLY this host's shard of a
+    # shared logical frame on this host's local mesh
+    img_df = build_image_frame(4 * num_partitions - 1, num_partitions)
+    feats = featurize_rows(dist.host_shard_dataframe(img_df))
+
     print("RESULT " + json.dumps({
         "pid": pid,
         "process_count": info.process_count,
@@ -93,6 +135,7 @@ def main() -> None:
         "psum_total": float(total),
         "rows": xs,
         "train_loss": train_loss,
+        "features": feats,
     }), flush=True)
 
 

@@ -148,6 +148,9 @@ class TestKerasImageFileEstimator:
         resumed = make_estimator(keras_cls_file, kerasFitParams=fit_params,
                                  streaming=True, checkpointDir=ckpt) \
             .fit(uri_label_df)
+        # the restore actually happened (a deterministic retrain would
+        # produce identical weights, so equality alone can't prove it)
+        assert resumed.resumedFrom == 2
         np.testing.assert_allclose(np.asarray(resumed.history),
                                    np.asarray(full.history),
                                    rtol=1e-5, atol=1e-6)
@@ -209,6 +212,7 @@ class TestKerasImageFileEstimator:
         # extension train from scratch in a fresh dir)
         import os
         assert len(os.listdir(ckpt)) == 1
+        assert resumed.resumedFrom == 2
         assert resumed.history == pytest.approx(full.history, rel=1e-5)
         import jax
         for a, b in zip(jax.tree.leaves(resumed.modelFunction.params),
