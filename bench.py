@@ -127,7 +127,10 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    batch_size = 256 if on_tpu else 8
+    # 128: best measured device-resident batch (sweep 2026-07-30 @16
+    # batches: 128→6425, 256→6103, 512→6187 img/s); e2e is link-bound
+    # at any batch size
+    batch_size = 128 if on_tpu else 8
     n_rows = batch_size * (4 if on_tpu else 2)
 
     mf = getModelFunction("InceptionV3", featurize=True)

@@ -8,6 +8,7 @@ import pytest
 from sparkdl_tpu.data import DataFrame
 from sparkdl_tpu.params import (
     CrossValidator,
+    TrainValidationSplit,
     Estimator,
     Evaluator,
     HasInputCol,
@@ -183,3 +184,32 @@ class TestCrossValidator:
         assert isinstance(cvm.bestModel, MeanModel)
         # best model trained with shift=0
         assert abs(cvm.bestModel.mean - np.arange(30).mean()) < 1e-9
+
+
+class TestTrainValidationSplit:
+    def test_selects_best_and_refits_on_full_data(self):
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        grid = [{e.shift: 0.0}, {e.shift: 100.0}]
+        tvs = TrainValidationSplit(estimator=e, estimatorParamMaps=grid,
+                                   evaluator=MAE(), trainRatio=0.7,
+                                   seed=1)
+        m = tvs.fit(_df(40))
+        assert len(m.validationMetrics) == 2
+        assert m.validationMetrics[0] < m.validationMetrics[1]
+        # best model is REFIT on the full dataset with the winning map
+        assert abs(m.bestModel.mean - np.arange(40).mean()) < 1e-9
+        # the fitted wrapper transforms through the best model
+        tab = m.transform(_df(5)).collect()
+        np.testing.assert_allclose(tab.column("m").to_numpy(),
+                                   np.arange(40).mean())
+
+    def test_split_is_seeded_and_ratio_respected(self):
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        grid = [{e.shift: 0.0}]
+        a = TrainValidationSplit(estimator=e, estimatorParamMaps=grid,
+                                 evaluator=MAE(), trainRatio=0.75,
+                                 seed=7).fit(_df(200))
+        b = TrainValidationSplit(estimator=e, estimatorParamMaps=grid,
+                                 evaluator=MAE(), trainRatio=0.75,
+                                 seed=7).fit(_df(200))
+        assert a.validationMetrics == b.validationMetrics  # same split
