@@ -179,8 +179,10 @@ def load_variables(name: str, fetcher: Optional[ModelFetcher] = None,
 def getModelFunction(name: str, featurize: bool = True,
                      fetcher: Optional[ModelFetcher] = None
                      ) -> ModelFunction:
-    """Named model → ModelFunction: uint8 NHWC [N,H,W,3] → features (or
-    logits). Preprocess + model is ONE jittable program."""
+    """Named model → ModelFunction: uint8 NHWC [N,H,W,3] → ``features``
+    (penultimate layer) or, with ``featurize=False``, ``predictions`` —
+    softmax PROBABILITIES, matching keras classifier heads. Preprocess +
+    model is ONE jittable program."""
     spec = getKerasApplicationModel(name)
     module = spec.module_fn()
     variables = load_variables(name, fetcher)
@@ -189,14 +191,20 @@ def getModelFunction(name: str, featurize: bool = True,
         x = spec.preprocess(inputs["image"])
         out = module.apply(vars_, x, train=False,
                            features_only=featurize)
-        key = "features" if featurize else "logits"
-        return {key: out}
+        if featurize:
+            return {"features": out}
+        # keras.applications classifier heads end in softmax
+        # (classifier_activation default), so the reference's
+        # DeepImagePredictor decoded PROBABILITIES — match that (the
+        # conversion oracles in tests/test_import_keras.py compare
+        # against keras outputs the same way)
+        return {"predictions": jax.nn.softmax(out, axis=-1)}
 
     return ModelFunction(
         apply_fn, variables,
         input_signature={"image": ((spec.height, spec.width, 3),
                                    np.uint8)},
-        output_names=["features" if featurize else "logits"],
+        output_names=["features" if featurize else "predictions"],
         name=f"{name}:{'featurize' if featurize else 'predict'}")
 
 

@@ -82,12 +82,13 @@ class TestZooIntegration:
                                   fetcher=fetcher)
         rng = np.random.default_rng(2)
         x = rng.integers(0, 255, (1, 224, 224, 3), dtype=np.uint8)
-        got = np.asarray(mf({"image": x})["logits"])
+        # predict path emits PROBABILITIES (keras classifier heads end
+        # in softmax; decode_predictions scores match reference scale)
+        ours = np.asarray(mf({"image": x})["predictions"])
         # oracle: keras on the same caffe-preprocessed input
         pre = x.astype(np.float32)[..., ::-1] - np.array(
             [103.939, 116.779, 123.68], np.float32)
         expected = np.asarray(kmodel(pre))
-        ours = np.asarray(jax.nn.softmax(got, axis=-1))
         np.testing.assert_allclose(ours, expected, atol=1e-3)
 
     def test_count_mismatch_fails_loudly(self):

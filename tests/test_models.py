@@ -100,9 +100,14 @@ class TestForward:
 
     def test_predict_mode(self):
         mf = zoo.getModelFunction("TestNet", featurize=False)
+        assert mf.output_names == ["predictions"]
         x = np.zeros((2, 32, 32, 3), np.uint8)
         out = np.asarray(mf(x))
         assert out.shape == (2, 10)
+        # probabilities, not raw logits (keras classifier heads end in
+        # softmax — decode_predictions scores must match that scale)
+        assert (out >= 0).all() and (out <= 1).all()
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
 
 
 class TestFetcher:

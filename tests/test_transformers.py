@@ -165,6 +165,8 @@ class TestNamedImage:
             assert len(r["preds"]) == 3
             scores = [e["score"] for e in r["preds"]]
             assert scores == sorted(scores, reverse=True)
+            # probabilities (keras decode_predictions score scale)
+            assert all(0.0 <= s <= 1.0 for s in scores)
             assert all(isinstance(e["description"], str)
                        for e in r["preds"])
 
