@@ -14,7 +14,7 @@ Reports, in ONE JSON line (driver contract):
   link imposes on ANY host-fed pipeline (bandwidth ÷ bytes/image).
 
 Separating these is the point (round-1 lesson): on a tunneled TPU the
-link moves ~10-25 MB/s, capping end-to-end at ~40-90 img/s regardless
+link moves ~10-35 MB/s, capping end-to-end at ~40-134 img/s regardless
 of the device program, while the device program itself runs thousands
 of img/s. ``vs_baseline`` stays honest (end-to-end vs the 1,250
 img/s/chip target = 10k/s ÷ 8 chips, BASELINE.md) and the extra keys

@@ -9,6 +9,9 @@ Run:  KERAS_BACKEND=jax python examples/hyperparameter_search.py
 """
 
 import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")  # must precede keras import
+
 import tempfile
 
 import numpy as np

@@ -7,6 +7,9 @@ Run:  KERAS_BACKEND=jax python examples/keras_udf.py
 """
 
 import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")  # must precede keras import
+
 import tempfile
 
 import numpy as np
