@@ -36,54 +36,6 @@ PER_CHIP_TARGET = 1250.0  # 10k img/s ÷ 8 chips (BASELINE.md)
 INCEPTION_GFLOPS = 11.5   # fwd FLOPs per 299x299 image (SURVEY §6)
 
 
-def _sync(x) -> float:
-    """Force completion of everything ``x`` depends on via a 1-element
-    dependent readback (reliable where block_until_ready is not)."""
-    import jax.numpy as jnp
-    return float(jnp.reshape(x, (-1,))[0].astype(jnp.float32))
-
-
-def measure_link(n_mb: int) -> dict:
-    import jax
-
-    x = np.random.default_rng(0).integers(
-        0, 255, size=(n_mb * 1024 * 1024,), dtype=np.uint8)
-    _sync(jax.device_put(x[:1024]).sum())  # warm the path
-    t0 = time.perf_counter()
-    d = jax.device_put(x)
-    _sync(d.sum())  # the sum can't run before the transfer lands
-    up = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    h = jax.device_get(d)
-    down = time.perf_counter() - t0
-    assert h[0] == x[0]
-    return {"h2d_MBps": round(n_mb / up, 1),
-            "d2h_MBps": round(n_mb / down, 1)}
-
-
-def measure_device_resident(mf, batch_size: int, n_batches: int) -> dict:
-    """Compute-side img/s with input already in HBM: no host transfer
-    inside the timed region."""
-    import jax
-
-    fn = mf.jitted()
-    params = mf.device_params()
-    x = np.random.default_rng(1).integers(
-        0, 255, size=(batch_size, 299, 299, 3), dtype=np.uint8)
-    dx = {"image": jax.device_put(x)}
-    _sync(fn(params, dx)["features"])  # compile + warm
-
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n_batches):
-        out = fn(params, dx)
-    _sync(out["features"])
-    dt = time.perf_counter() - t0
-    ips = batch_size * n_batches / dt
-    return {"ips": round(ips, 1),
-            "tflops": round(ips * INCEPTION_GFLOPS / 1000.0, 2)}
-
-
 def _probe_accelerator(timeout_s: int = 180) -> bool:
     """Whether the ambient accelerator backend initializes, checked in a
     throwaway subprocess with a hard timeout — the tunneled TPU can HANG
@@ -124,6 +76,10 @@ def main() -> None:
 
     from sparkdl_tpu.models.zoo import getModelFunction
     from sparkdl_tpu.runtime.runner import BatchRunner
+    from sparkdl_tpu.utils.measure import (
+        measure_device_resident,
+        measure_link,
+    )
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -169,7 +125,8 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(e2e_ips / PER_CHIP_TARGET, 3),
         "device_resident_ips": device["ips"],
-        "device_tflops": device["tflops"],
+        "device_tflops": round(
+            device["ips"] * INCEPTION_GFLOPS / 1000.0, 2),
         "vs_baseline_device_resident": round(
             device["ips"] / PER_CHIP_TARGET, 3),
         "link_h2d_MBps": link["h2d_MBps"],

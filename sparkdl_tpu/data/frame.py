@@ -204,7 +204,17 @@ class DataFrame:
         for s in self._sources:
             if remaining <= 0:
                 break
-            if s.num_rows is not None and s.num_rows <= remaining:
+            if s.num_rows is None:
+                # Unknown partition size (union's deferred sides): a
+                # lazy prefix cannot know whether this source satisfies
+                # the cutoff — slicing it and stopping here silently
+                # under-returns when it holds fewer than ``remaining``
+                # rows. Materialize just enough instead.
+                rows = self.take(n)
+                return DataFrame.from_table(
+                    pa.Table.from_pylist(rows, schema=self.schema), 1,
+                    self._engine)
+            if s.num_rows <= remaining:
                 out_sources.append(s)
                 remaining -= s.num_rows
             else:
@@ -213,12 +223,10 @@ class DataFrame:
                 def _load(s=s, take=take) -> pa.RecordBatch:
                     return s.load().slice(0, take)
 
-                rows = (min(take, s.num_rows)
-                        if s.num_rows is not None else None)
                 # keep the partition's logical identity for with_index
                 # stages (the un-limited frame's draws must be a prefix)
                 out_sources.append(dataclasses.replace(
-                    s, load=_load, num_rows=rows))
+                    s, load=_load, num_rows=take))
                 remaining = 0
         if not out_sources:  # keep the schema even with zero rows
             return DataFrame.from_table(

@@ -86,6 +86,10 @@ class LossEvaluator(Evaluator):
         preds, labels = _collect_pred_and_labels(
             dataset, self.getOrDefault("predictionCol"),
             self.getOrDefault("labelCol"))
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            # squeeze BEFORE the class-label guard, or an (N,1) tensor
+            # column of integer labels would bypass it
+            preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
         if (preds.ndim == 1 and len(preds)
                 and np.all(preds == np.round(preds))):
             if preds.max(initial=0.0) > 1.0:
@@ -110,8 +114,6 @@ class LossEvaluator(Evaluator):
                 "point predictionCol at the probability column",
                 self.getOrDefault("predictionCol"))
         preds = np.clip(preds, 1e-7, 1.0 - 1e-7)
-        if preds.ndim > 1 and preds.shape[-1] == 1:
-            preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
         if preds.ndim == 1:  # binary cross-entropy on a scalar probability
             y = (labels.argmax(-1) if labels.ndim > 1
                  else labels).astype(np.float64)

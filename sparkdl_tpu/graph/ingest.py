@@ -152,7 +152,9 @@ class ModelIngest:
 
         ``feed_names``/``fetch_names`` are tensor names (``"x:0"``; a
         bare op name means its output 0). Input/output keys on the
-        resulting ModelFunction are the clean op names — use
+        resulting ModelFunction are the clean op names; when several
+        tensors come off the SAME op (``"split:0"``, ``"split:1"``)
+        their keys keep the output index so none collide — use
         ``rename_io`` to remap.
         """
         tf = _tf()
@@ -174,8 +176,23 @@ class ModelIngest:
                    for n in fetch_names]
         pruned = wrapped.prune(feeds=feeds, fetches=fetches)
 
-        in_keys = [_tensor_name(n).split(":")[0] for n in feed_names]
-        out_keys = [_tensor_name(n).split(":")[0] for n in fetch_names]
+        def _keys(names: Sequence[str], role: str) -> List[str]:
+            """Dict keys for tensors: the bare op name, EXCEPT when
+            several requested tensors share an op — then every such key
+            keeps its output index (``op_1``), because colliding keys
+            would silently drop all but the last tensor."""
+            full = [_tensor_name(n) for n in names]
+            if len(set(full)) != len(full):
+                dup = next(t for t in full if full.count(t) > 1)
+                raise ValueError(
+                    f"duplicate {role} tensor {dup!r}")
+            ops = [t.split(":")[0] for t in full]
+            return [op if ops.count(op) == 1
+                    else f"{op}_{t.split(':')[1]}"
+                    for op, t in zip(ops, full)]
+
+        in_keys = _keys(feed_names, "feed")
+        out_keys = _keys(fetch_names, "fetch")
         input_signature: Signature = {}
         for key, t in zip(in_keys, feeds):
             shape = tuple(int(d) if d is not None else None

@@ -131,17 +131,28 @@ ARTIFACTS_DIR = os.path.join(os.path.dirname(__file__), "artifacts")
 _warned_random: set = set()
 
 
+def _resolve_weights(name: str, fetcher: Optional[ModelFetcher]):
+    """THE provenance cascade, in priority order — single source of
+    truth for both :func:`weights_provenance` (reporting) and
+    :func:`load_variables` (loading), so the report can never drift
+    from what actually loads. Returns ``(source, loader)`` where
+    ``loader(init)`` produces the variables."""
+    fetcher = fetcher or ModelFetcher()
+    fileName = f"{name}.msgpack"
+    if fetcher.has(fileName):
+        return "cache", lambda init: fetcher.get(fileName, init)
+    if os.path.exists(os.path.join(ARTIFACTS_DIR, fileName)):
+        return "committed", lambda init: ModelFetcher(
+            cache_dir=ARTIFACTS_DIR).get(fileName, init)
+    return "random", lambda init: init
+
+
 def weights_provenance(name: str,
                        fetcher: Optional[ModelFetcher] = None) -> str:
     """Where :func:`load_variables` will get this model's weights:
     ``"cache"`` (user-seeded fetcher cache), ``"committed"`` (trained
     artifact shipped in-repo), or ``"random"`` (seeded init)."""
-    fetcher = fetcher or ModelFetcher()
-    if fetcher.has(f"{name}.msgpack"):
-        return "cache"
-    if os.path.exists(os.path.join(ARTIFACTS_DIR, f"{name}.msgpack")):
-        return "committed"
-    return "random"
+    return _resolve_weights(name, fetcher)[0]
 
 
 def load_variables(name: str, fetcher: Optional[ModelFetcher] = None,
@@ -151,15 +162,8 @@ def load_variables(name: str, fetcher: Optional[ModelFetcher] = None,
     deterministic seeded init — with a LOUD warning, because a random
     featurizer emits structured noise and a random predictor's labels
     are meaningless (VERDICT r1 weak #4: never serve noise silently)."""
-    fetcher = fetcher or ModelFetcher()
-    fileName = f"{name}.msgpack"
-    init = _init_variables(name, seed)
-    if fetcher.has(fileName):
-        return fetcher.get(fileName, init)
-    committed = os.path.join(ARTIFACTS_DIR, fileName)
-    if os.path.exists(committed):
-        return ModelFetcher(cache_dir=ARTIFACTS_DIR).get(fileName, init)
-    if name not in _warned_random:
+    source, loader = _resolve_weights(name, fetcher)
+    if source == "random" and name not in _warned_random:
         _warned_random.add(name)
         import logging
         logging.getLogger(__name__).warning(
@@ -168,8 +172,8 @@ def load_variables(name: str, fetcher: Optional[ModelFetcher] = None,
             "Real weights cannot be downloaded in a zero-egress "
             "environment — convert them with models.import_keras or "
             "pre-seed the cache via ModelFetcher.put(%r, params).",
-            name, fileName)
-    return init
+            name, f"{name}.msgpack")
+    return loader(_init_variables(name, seed))
 
 
 # ---------------------------------------------------------------------------

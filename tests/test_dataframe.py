@@ -263,6 +263,22 @@ class TestFrameUsability:
         assert u.count() == 5
         assert [r["x"] for r in u.collect_rows()] == [0, 1, 2, 0, 1]
 
+    def test_limit_over_unknown_count_partitions(self):
+        """limit(n) must return exactly n rows even when partition row
+        counts are unknown — union of different-plan frames produces
+        deferred sources with num_rows=None, and a lazy prefix that
+        stops at the first unknown source silently under-returns
+        (regression: limit(5) over 6+6 rows returned 3)."""
+        a = self._df(6, 2).filter_rows(np.ones(6, bool))  # non-preserving
+        b = self._df(6, 2)
+        u = a.union(b)
+        assert u.count() == 12
+        got = [r["x"] for r in u.limit(5).collect_rows()]
+        assert got == [0, 1, 2, 3, 4]
+        assert u.limit(0).count() == 0
+        assert u.limit(12).count() == 12
+        assert u.limit(50).count() == 12
+
     def test_sample(self):
         df = self._df(200, 4)
         kept = df.sample(0.3, seed=7).count()

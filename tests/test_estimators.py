@@ -373,6 +373,23 @@ class TestEvaluators:
         with pytest.raises(ValueError, match="class labels"):
             ev.evaluate(df)
 
+    def test_loss_evaluator_rejects_n1_label_tensor_column(self):
+        """The same mistake stored as an (N,1) tensor column must hit
+        the guard too (regression: the squeeze ran after it)."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.frame import DataFrame
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": 2}, {"label": 0}, {"label": 2}])
+        batch = append_tensor_column(
+            batch, "prediction",
+            np.array([[2.0], [0.0], [1.0]], np.float32))
+        df = DataFrame.from_batches([batch])
+        ev = LossEvaluator(predictionCol="prediction", labelCol="label")
+        with pytest.raises(ValueError, match="class labels"):
+            ev.evaluate(df)
+
 
 class TestTargetPrep:
     def test_int_labels_one_hot(self):

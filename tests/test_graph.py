@@ -222,6 +222,30 @@ class TestIngestionMatrix:
         g = tf.compat.v1.wrap_function(_import, []).graph
         return g.as_graph_def().SerializeToString()
 
+    def test_from_graphdef_multi_output_op(self, x_batch):
+        """Two fetches off the SAME op (split:0, split:1) must keep
+        distinct keys — stripping the output index collided them and
+        silently dropped all but the last fetch (regression)."""
+        import tensorflow as tf
+
+        def _import():
+            x = tf.compat.v1.placeholder(tf.float32, [None, IN_DIM],
+                                         name="x")
+            tf.split(x, 2, axis=1, name="split")
+
+        blob = tf.compat.v1.wrap_function(_import, []) \
+            .graph.as_graph_def().SerializeToString()
+        mf = ModelIngest.fromGraphDef(blob, ["x:0"],
+                                      ["split:0", "split:1"])
+        assert mf.output_names == ["split_0", "split_1"]
+        out = mf({"x": x_batch})
+        half = IN_DIM // 2
+        np.testing.assert_allclose(out["split_0"], x_batch[:, :half])
+        np.testing.assert_allclose(out["split_1"], x_batch[:, half:])
+        with pytest.raises(ValueError, match="duplicate fetch"):
+            ModelIngest.fromGraphDef(blob, ["x:0"], ["split:0",
+                                                     "split:0"])
+
     def test_from_graphdef_bytes(self, mlp_weights, x_batch, expected):
         blob = self._frozen_graph_def(mlp_weights)
         mf = ModelIngest.fromGraphDef(blob, ["x:0"], ["y:0"])

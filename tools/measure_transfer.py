@@ -31,59 +31,24 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def _sync(x):
-    """Force completion of everything x depends on via a tiny readback."""
-    import jax.numpy as jnp
-    return float(jnp.reshape(x, (-1,))[0].astype(jnp.float32))
-
-
-def measure_link(n_mb: int = 32) -> dict:
-    import jax
-    import jax.numpy as jnp
-
-    x = np.random.default_rng(0).integers(
-        0, 255, size=(n_mb * 1024 * 1024,), dtype=np.uint8)
-    # warm the path
-    _sync(jnp.asarray(jax.device_put(x[: 1024])).sum())
-    t0 = time.perf_counter()
-    d = jax.device_put(x)
-    _sync(d.sum())  # the sum can't run before the transfer lands
-    up = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    h = jax.device_get(d)
-    down = time.perf_counter() - t0
-    assert h[0] == x[0]
-    return {"h2d_MBps": round(n_mb / up, 2),
-            "d2h_MBps": round(n_mb / down, 2)}
+# the forced-sync methodology lives in ONE place, shared with bench.py
+from sparkdl_tpu.utils.measure import (  # noqa: E402
+    measure_device_resident,
+    measure_link,
+    sync_readback as _sync,
+)
 
 
-def measure_compute(batch_size: int, n_batches: int = 4) -> dict:
+def measure_compute(batch_size: int, n_batches: int = 16) -> dict:
     """Device-resident InceptionV3 featurize: img/s and TFLOP/s with no
     host transfer in the timed region."""
-    import jax
-
     from sparkdl_tpu.models.zoo import getModelFunction
 
     mf = getModelFunction("InceptionV3", featurize=True)
-    fn = mf.jitted()
-    params = mf.device_params()
-    x = np.random.default_rng(1).integers(
-        0, 255, size=(batch_size, 299, 299, 3), dtype=np.uint8)
-    dx = {"image": jax.device_put(x)}
-    _sync(fn(params, dx)["features"])  # compile + warm
-
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n_batches):
-        out = fn(params, dx)
-    _sync(out["features"])
-    dt = time.perf_counter() - t0
-    ips = batch_size * n_batches / dt
-    return {"device_ips": round(ips, 1),
-            "device_tflops": round(ips * 11.5e9 / 1e12, 2),
-            "batch_ms": round(dt / n_batches * 1000, 2)}
+    out = measure_device_resident(mf, batch_size, n_batches)
+    return {"device_ips": out["ips"],
+            "device_tflops": round(out["ips"] * 11.5e9 / 1e12, 2),
+            "batch_ms": out["batch_ms"]}
 
 
 def _strategies(batch_size: int, n_rows: int) -> dict:
