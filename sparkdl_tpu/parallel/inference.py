@@ -23,11 +23,13 @@ from jax.sharding import Mesh
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from sparkdl_tpu.runtime.runner import (
+    MAX_INFLIGHT_BATCHES,
     RunnerMetrics,
     check_row_counts,
     drain_bounded,
     empty_jax_outputs,
     iter_padded_chunks,
+    start_host_copies,
 )
 
 
@@ -57,8 +59,8 @@ class ShardedBatchRunner:
         self.batch_size = batch_size
         self.metrics = metrics or RunnerMetrics()
         # same measured strategy selection + validation as BatchRunner
-        # (runner.py module docstring): immediate drain on tunneled
-        # devices, bounded async dispatch on direct-attached ones
+        # (runner.py module docstring): host_async on tunneled devices,
+        # bounded async dispatch on direct-attached ones
         from sparkdl_tpu.runtime.runner import resolve_strategy
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
@@ -91,15 +93,22 @@ class ShardedBatchRunner:
 
         t0 = time.perf_counter()
         gb = self._global_batch
+        host_async = self.strategy == "host_async"
+        limit = self.max_inflight
         pending: collections.deque = collections.deque()
         outs: Dict[str, List[np.ndarray]] = {}
         batches = 0
         for valid, chunk in iter_padded_chunks(inputs, n, gb):
             if place is not None:
                 chunk = place(chunk)
-            pending.append((valid, fn(params, chunk)))
+            res = fn(params, chunk)
+            if host_async and not start_host_copies(res):
+                # missing API: shallow queue, like BatchRunner
+                host_async = False
+                limit = min(limit, MAX_INFLIGHT_BATCHES)
+            pending.append((valid, res))
             batches += 1
-            drain_bounded(pending, outs, self.max_inflight)
+            drain_bounded(pending, outs, limit)
         drain_bounded(pending, outs, 0)
         out = {k: np.concatenate(v) for k, v in outs.items()}
         self.metrics.add(n, batches, time.perf_counter() - t0)

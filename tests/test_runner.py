@@ -65,6 +65,10 @@ class TestBatchRunner:
 
         assert resolve_strategy("immediate", None) == ("immediate", 0)
         assert resolve_strategy("deferred", 5) == ("deferred", 5)
+        from sparkdl_tpu.runtime.runner import MAX_INFLIGHT_HOST_ASYNC
+        assert resolve_strategy("host_async", None) == \
+            ("host_async", MAX_INFLIGHT_HOST_ASYNC)
+        assert resolve_strategy("host_async", 3) == ("host_async", 3)
         # an explicit queue depth means the caller wants a queue — it
         # must select deferred, not be silently dropped by the
         # tunnel-env auto-default
@@ -78,6 +82,36 @@ class TestBatchRunner:
             resolve_strategy("immedaite", None)
         r = BatchRunner(_double_fn(), strategy="immediate")
         assert r.strategy == "immediate" and r.max_inflight == 0
+
+    def test_start_host_copies_reports_missing_api(self):
+        """A backend without copy_to_host_async must report False so
+        runners fall back to the SHALLOW deferred queue — a deep queue
+        of never-copied buffers is the round-1 stale-buffer collapse."""
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.runtime.runner import start_host_copies
+
+        class _NoAPI:
+            pass
+
+        assert start_host_copies({"y": _NoAPI()}) is False
+        assert start_host_copies({"y": jnp.zeros(3)}) is True
+
+    def test_all_strategies_produce_identical_outputs(self):
+        """immediate / deferred / host_async are pure dispatch policies
+        — same results, same order, including the padded tail."""
+        x = np.arange(22 * 3, dtype=np.float32).reshape(22, 3)
+        expected = None
+        for strategy in ("immediate", "deferred", "host_async"):
+            r = BatchRunner(_double_fn(), batch_size=4,
+                            strategy=strategy)
+            out = r.run({"input": x})["output"]
+            assert out.shape == (22, 3)
+            if expected is None:
+                expected = out
+            else:
+                np.testing.assert_array_equal(out, expected)
+        np.testing.assert_allclose(expected, x * 2.0)
 
     def test_host_backend(self):
         def host_apply(params, inputs):
