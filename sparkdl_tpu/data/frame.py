@@ -187,6 +187,26 @@ class DataFrame:
         return DataFrame.from_table(self.collect(), num_partitions,
                                     self._engine)
 
+    def _materialize_prefix(self, n: int) -> "DataFrame":
+        """First ``n`` FINAL rows as a 1-partition frame, streaming
+        partitions only until the cutoff is met and slicing whole Arrow
+        batches (no per-row Python — image/tensor columns stay
+        columnar)."""
+        batches: List[pa.RecordBatch] = []
+        remaining = n
+        if remaining > 0:
+            for batch in self.stream():
+                if batch.num_rows > remaining:
+                    batch = batch.slice(0, remaining)
+                batches.append(batch)
+                remaining -= batch.num_rows
+                if remaining <= 0:
+                    break
+        table = (pa.Table.from_batches(batches, schema=self.schema)
+                 if batches else
+                 pa.Table.from_pylist([], schema=self.schema))
+        return DataFrame.from_table(table, 1, self._engine)
+
     def limit(self, n: int) -> "DataFrame":
         """First ``n`` rows (across partitions, in order), lazily:
         partitions past the cutoff are never loaded."""
@@ -195,10 +215,7 @@ class DataFrame:
         if any(not st.row_preserving for st in self._plan):
             # a filter in the plan changes row counts — the cutoff must
             # apply to FINAL rows, so materialize just enough
-            rows = self.take(n)
-            return DataFrame.from_table(
-                pa.Table.from_pylist(rows, schema=self.schema), 1,
-                self._engine)
+            return self._materialize_prefix(n)
         out_sources: List[Source] = []
         remaining = n
         for s in self._sources:
@@ -210,10 +227,7 @@ class DataFrame:
                 # the cutoff — slicing it and stopping here silently
                 # under-returns when it holds fewer than ``remaining``
                 # rows. Materialize just enough instead.
-                rows = self.take(n)
-                return DataFrame.from_table(
-                    pa.Table.from_pylist(rows, schema=self.schema), 1,
-                    self._engine)
+                return self._materialize_prefix(n)
             if s.num_rows <= remaining:
                 out_sources.append(s)
                 remaining -= s.num_rows

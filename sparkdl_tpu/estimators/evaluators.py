@@ -65,7 +65,15 @@ class ClassificationEvaluator(Evaluator):
 
 class LossEvaluator(Evaluator):
     """Mean categorical cross-entropy of a probability-vector prediction
-    column vs integer labels. Smaller is better."""
+    column vs integer labels. Smaller is better.
+
+    Default ``predictionCol`` is ``"probability"`` — the column
+    LogisticRegressionModel writes its softmax vector to. Its
+    ``predictionCol`` ("prediction") holds the float64 CLASS LABEL
+    (Spark convention): cross-entropy on labels is meaningless, and for
+    a binary model it is undetectable from values alone (all 0.0/1.0
+    looks like a saturated sigmoid), so the default must point at
+    probabilities."""
 
     predictionCol = Param("LossEvaluator", "predictionCol",
                           "probability vector column",
@@ -74,9 +82,9 @@ class LossEvaluator(Evaluator):
                      TypeConverters.toString)
 
     @keyword_only
-    def __init__(self, *, predictionCol="prediction", labelCol="label"):
+    def __init__(self, *, predictionCol="probability", labelCol="label"):
         super().__init__()
-        self._setDefault(predictionCol="prediction", labelCol="label")
+        self._setDefault(predictionCol="probability", labelCol="label")
         self._set(predictionCol=predictionCol, labelCol=labelCol)
 
     def isLargerBetter(self) -> bool:

@@ -694,13 +694,32 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                         else loaded)
 
         # cheap manifest (strings + labels): sizing + fingerprint —
-        # identical on every host, so step counts agree everywhere
-        meta = base.collect()
+        # identical on every host, so step counts agree everywhere.
+        # Collected per partition so shard EMPTINESS is checkable:
+        # partition COUNT >= host count does not guarantee every host
+        # owns rows (empty partitions, filters), and a host whose shard
+        # is empty would raise alone mid-epoch, hanging the others in
+        # the first cross-host collective.
+        import pyarrow as pa
+        part_batches = list(base.stream())
+        meta = pa.Table.from_batches(part_batches, schema=base.schema)
         uris = meta.column(0).to_pylist()
         labels_all = meta.column(1).to_pylist()
         n = len(uris)
         if n == 0:
             raise ValueError("cannot fit on an empty dataset")
+        if multihost:
+            counts = [b.num_rows for b in part_batches]
+            for host in range(info.process_count):
+                owned = dist.host_shard_indices(
+                    len(counts), host, info.process_count)
+                if sum(counts[i] for i in owned) == 0:
+                    # same computation on every host → every host
+                    # raises here, before any device step
+                    raise ValueError(
+                        f"host {host}'s partition shard holds 0 rows "
+                        f"(partition sizes {counts}); repartition so "
+                        "every host owns data")
 
         model, loss_fn, tx, trainable, non_trainable, opt_state = \
             est._setup_trial()

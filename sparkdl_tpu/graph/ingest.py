@@ -180,16 +180,20 @@ class ModelIngest:
             """Dict keys for tensors: the bare op name, EXCEPT when
             several requested tensors share an op — then every such key
             keeps its output index (``op_1``), because colliding keys
-            would silently drop all but the last tensor."""
+            would silently drop all but the last tensor. If even those
+            collide with another requested op's literal name (an op
+            actually named ``split_0`` next to ``split:0``), fall back
+            to the full unique tensor names for everything."""
             full = [_tensor_name(n) for n in names]
             if len(set(full)) != len(full):
                 dup = next(t for t in full if full.count(t) > 1)
                 raise ValueError(
                     f"duplicate {role} tensor {dup!r}")
             ops = [t.split(":")[0] for t in full]
-            return [op if ops.count(op) == 1
+            keys = [op if ops.count(op) == 1
                     else f"{op}_{t.split(':')[1]}"
                     for op, t in zip(ops, full)]
+            return keys if len(set(keys)) == len(keys) else full
 
         in_keys = _keys(feed_names, "feed")
         out_keys = _keys(fetch_names, "fetch")

@@ -202,14 +202,18 @@ def imageColumnViews(column):
 
 
 def imageColumnToNHWC(column, height: int, width: int,
-                      nChannels: int = 3) -> np.ndarray:
+                      nChannels: int = 3,
+                      writable: bool = False) -> np.ndarray:
     """Image struct column (all rows already h×w×c) → [N,H,W,C] uint8.
 
     Zero-copy: Arrow binary rows are stored back-to-back, so when every
     row is the target size the batch is literally a reshaped view of the
     column's data buffer — no per-row Python, no memcpy. The returned
-    array may be read-only (it aliases the Arrow buffer)."""
-    return viewsToNHWC(imageColumnViews(column), height, width, nChannels)
+    array aliases the Arrow buffer and may be READ-ONLY (IPC/mmap
+    buffers are immutable); pass ``writable=True`` to always get a
+    mutable non-aliasing copy (one memcpy) for in-place augmentation."""
+    out = viewsToNHWC(imageColumnViews(column), height, width, nChannels)
+    return out.copy() if writable else out
 
 
 def viewsToNHWC(views, height: int, width: int,

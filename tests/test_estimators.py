@@ -357,6 +357,16 @@ class TestEvaluators:
         assert ev.evaluate(self._df()) == pytest.approx(expected, rel=1e-5)
         assert not ev.isLargerBetter()
 
+    def test_loss_evaluator_defaults_to_probability_column(self):
+        """The default predictionCol must be 'probability' — with
+        LogisticRegressionModel, 'prediction' holds the float64 CLASS
+        LABEL, and for a binary model cross-entropy on labels is
+        undetectable from values alone (all 0.0/1.0 looks like a
+        saturated sigmoid). Wiring LossEvaluator() to an LR pipeline
+        must score the model's probabilities by default."""
+        assert LossEvaluator().getOrDefault("predictionCol") \
+            == "probability"
+
     def test_loss_evaluator_rejects_class_label_column(self):
         """Pointing LossEvaluator at a class-label column (e.g.
         LogisticRegressionModel's predictionCol) must error, not return

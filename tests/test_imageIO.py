@@ -196,6 +196,15 @@ class TestReadImages:
         batch = imageIO.structsToBatch(structs)
         nhwc = imageIO.imageColumnToNHWC(batch.column(0), 6, 7, 3)
         np.testing.assert_array_equal(nhwc, np.stack(arrs))
+        # default is a zero-copy view (writability follows the Arrow
+        # buffer's provenance — IPC/mmap buffers are read-only);
+        # writable=True GUARANTEES a mutable copy that never aliases
+        assert not nhwc.flags.owndata  # aliases the Arrow buffer
+        w = imageIO.imageColumnToNHWC(batch.column(0), 6, 7, 3,
+                                      writable=True)
+        assert w.flags.writeable
+        w[0, 0, 0, 0] += 1  # must not raise nor write through
+        np.testing.assert_array_equal(nhwc, np.stack(arrs))
 
     def test_nhwc_size_mismatch_raises(self, rng):
         structs = [imageIO.imageArrayToStruct(
