@@ -206,6 +206,15 @@ class TestReadImages:
         w[0, 0, 0, 0] += 1  # must not raise nor write through
         np.testing.assert_array_equal(nhwc, np.stack(arrs))
 
+    def test_struct_to_pil_roundtrip(self, rng):
+        for c, mode in ((1, "L"), (3, "RGB"), (4, "RGBA")):
+            arr = rng.integers(0, 255, (5, 4, c), dtype=np.uint8)
+            pil = imageIO.imageStructToPIL(imageIO.imageArrayToStruct(arr))
+            assert pil.mode == mode
+            back = np.asarray(pil)
+            np.testing.assert_array_equal(
+                back if c > 1 else back[:, :, None], arr)
+
     def test_nhwc_size_mismatch_raises(self, rng):
         structs = [imageIO.imageArrayToStruct(
             rng.integers(0, 255, (6, 7, 3), dtype=np.uint8))]

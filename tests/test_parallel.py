@@ -254,6 +254,10 @@ class TestAgreeResumeStep:
         assert results == [0, 0]
 
     def test_single_process_identity(self):
-        from sparkdl_tpu.parallel.distributed import agree_resume_step
+        from sparkdl_tpu.parallel.distributed import (
+            agree_min,
+            agree_resume_step,
+        )
+        assert agree_min(7) == 7  # process_count == 1 → identity
         assert agree_resume_step(5, [3, 5]) == 5
         assert agree_resume_step(0, []) == 0
