@@ -11,6 +11,8 @@ serialized by the engine so the chip sees an orderly batch stream.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import threading
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -18,6 +20,65 @@ import pyarrow as pa
 
 
 Row = dict  # a collected row is a plain dict, keyed by column name
+
+
+class _DeferredSide:
+    """One side of a different-plan :meth:`DataFrame.union`, materialized
+    lazily exactly once per process.
+
+    Materialization runs on a PRIVATE small thread pool: running on the
+    engine's own pool from a pool worker deadlocks once outer partitions
+    saturate it (``max_inflight >= num_workers``), while fully-inline
+    materialization serializes an N-partition decode. Each partition
+    runs through the engine's retrying ``_run_partition`` when it has
+    one (LocalEngine: device stages still serialize on its device
+    lock); duck-typed engines without it (SparkEngine) get the plain
+    stage contract (``apply_plan``).
+
+    Pickle-safe for Spark task shipping: the lock, the cached batches,
+    and the engine are process-local and dropped on the wire — a remote
+    task rematerializes the side itself via ``apply_plan`` (repeated
+    work per task, but correct; Spark's own different-plan unions
+    likewise recompute or shuffle)."""
+
+    def __init__(self, engine, plan, sources):
+        self._engine = engine
+        self._plan = list(plan)
+        self._sources = list(sources)
+        self._lock = threading.Lock()
+        self._batches: Optional[List[pa.RecordBatch]] = None
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        state["_batches"] = None
+        state["_engine"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def _run_partition(self, s: "Source", j: int) -> pa.RecordBatch:
+        runner = getattr(self._engine, "_run_partition", None)
+        if runner is not None:
+            return runner(s, self._plan, j)
+        from sparkdl_tpu.data.spark_binding import apply_plan
+        idx = s.logical_index if s.logical_index is not None else j
+        return apply_plan(self._plan, s.load(), idx)
+
+    def get(self, i: int) -> pa.RecordBatch:
+        with self._lock:
+            if self._batches is None:
+                from concurrent.futures import ThreadPoolExecutor
+                n_workers = min(4, max(1, len(self._sources)))
+                with ThreadPoolExecutor(
+                        max_workers=n_workers,
+                        thread_name_prefix="sparkdl-union") as pool:
+                    self._batches = list(pool.map(
+                        self._run_partition, self._sources,
+                        range(len(self._sources))))
+            return self._batches[i]
 
 
 def column_index(data, name: str) -> int:
@@ -283,29 +344,9 @@ class DataFrame:
                              self._engine)
 
         def deferred(df: "DataFrame") -> List[Source]:
-            import threading
-            cache: dict = {}
-            lock = threading.Lock()
-
-            def load_part(i):
-                def _load() -> pa.RecordBatch:
-                    with lock:
-                        if "batches" not in cache:
-                            # Run this side's plan inline in the calling
-                            # thread (engine _run_once: device stages
-                            # still serialize on the engine's lock).
-                            # df.stream() here would re-enter the SAME
-                            # thread pool from a pool worker and
-                            # deadlock once outer partitions saturate it
-                            # (max_inflight >= num_workers always).
-                            cache["batches"] = [
-                                df._engine._run_partition(s, df._plan, j)
-                                for j, s in enumerate(df._sources)]
-                    return cache["batches"][i]
-                return _load
-
+            side = _DeferredSide(df._engine, df._plan, df._sources)
             preserving = all(st.row_preserving for st in df._plan)
-            return [Source(load_part(i),
+            return [Source(functools.partial(side.get, i),
                            s.num_rows if preserving else None)
                     for i, s in enumerate(df._sources)]
 

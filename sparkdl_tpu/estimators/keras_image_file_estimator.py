@@ -460,14 +460,21 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     @staticmethod
     def _prepare_targets(y: np.ndarray, loss, n_out: int) -> np.ndarray:
         """Integer class labels one-hot to the model's output width for
-        categorical losses; everything else passes through as float32,
-        with 1-D targets lifted to [N, 1] so elementwise losses align
-        with a 2-D model output — without the reshape, [N,1] preds
-        against [N] targets broadcast to [N,N] and BCE silently
-        minimizes a wrong objective."""
-        if (loss == "categorical_crossentropy"
-                and y.ndim == 1 and np.issubdtype(y.dtype, np.integer)):
-            return np.eye(n_out, dtype=np.float32)[y]
+        categorical losses — including float64 columns holding INTEGRAL
+        class ids, the Spark ML label convention this library accepts
+        everywhere else (LogisticRegression, its predictionCol output);
+        everything else passes through as float32, with 1-D targets
+        lifted to [N, 1] so elementwise losses align with a 2-D model
+        output — without the reshape, [N,1] preds against [N] targets
+        broadcast to [N,N] and BCE silently minimizes a wrong
+        objective."""
+        if loss == "categorical_crossentropy" and y.ndim == 1:
+            if np.issubdtype(y.dtype, np.integer):
+                return np.eye(n_out, dtype=np.float32)[y]
+            if (np.issubdtype(y.dtype, np.floating) and len(y)
+                    and (y == np.round(y)).all()):
+                return np.eye(n_out, dtype=np.float32)[
+                    y.astype(np.int64)]
         y = np.asarray(y, dtype=np.float32)
         if y.ndim == 1:
             y = y.reshape(len(y), 1)
@@ -708,12 +715,14 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         n = len(uris)
         if n == 0:
             raise ValueError("cannot fit on an empty dataset")
+        shard_rows: List[int] = []
         if multihost:
             counts = [b.num_rows for b in part_batches]
             for host in range(info.process_count):
                 owned = dist.host_shard_indices(
                     len(counts), host, info.process_count)
-                if sum(counts[i] for i in owned) == 0:
+                shard_rows.append(sum(counts[i] for i in owned))
+                if shard_rows[-1] == 0:
                     # same computation on every host → every host
                     # raises here, before any device step
                     raise ValueError(
@@ -750,7 +759,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     f"{info.local_device_count} local devices "
                     f"({info.global_device_count} global); choose a "
                     "batch_size divisible by the global device count")
-            steps_per_epoch = max(1, -(-n // batch_size))
+            # per-epoch quota sized by the LARGEST shard, not the global
+            # mean: with uneven shards, ceil(n / batch) would let the
+            # bigger host stop before its tail every epoch — with
+            # shuffle=False the same rows would NEVER train. Sizing by
+            # max(shard_rows) covers every host's full shard each epoch
+            # (smaller hosts cycle, as they already do); identical on
+            # every host, so collectives stay aligned.
+            steps_per_epoch = max(
+                1, -(-max(shard_rows) // rows_per_step))
 
             def place(xb, yb):
                 gx = jax.make_array_from_process_local_data(

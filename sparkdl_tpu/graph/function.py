@@ -74,6 +74,10 @@ class ModelFunction:
         # returns it instead of eval_shape-probing — a fixed-batch
         # exported program rejects any other batch size
         self._output_signature: Optional[Signature] = None
+        # the ONLY batch size a fixed-batch exported program accepts
+        # (set by deserialize; propagated by wrappers). eval_shape
+        # probes must use it — batch-1 probes crash such programs.
+        self._fixed_batch: Optional[int] = None
 
     # -- construction -------------------------------------------------------
 
@@ -157,11 +161,15 @@ class ModelFunction:
     def output_signature(self, batch_size: int = 1) -> Signature:
         """Infer named output shapes via ``jax.eval_shape`` (per-row
         shapes, batch stripped); deserialized models return the
-        signature recorded in the export instead of probing."""
+        signature recorded in the export instead of probing, and
+        wrappers around a fixed-batch deserialized program probe with
+        ITS batch size (any other size is rejected by the export)."""
         if self._output_signature is not None:
             return dict(self._output_signature)
         if self.backend != "jax":
             raise ValueError("output_signature requires a jax backend")
+        if self._fixed_batch is not None:
+            batch_size = self._fixed_batch
         inputs = {
             n: jax.ShapeDtypeStruct((batch_size,) + tuple(shape), dtype)
             for n, (shape, dtype) in self.input_signature.items()
@@ -189,9 +197,15 @@ class ModelFunction:
                for n, v in self.input_signature.items()}
         out_names = ([output_map.get(n, n) for n in self._output_names]
                      if self._output_names else None)
-        return ModelFunction(apply_fn, self.params, sig, out_names,
-                             backend=self.backend,
-                             name=f"{self.name}.renamed")
+        out = ModelFunction(apply_fn, self.params, sig, out_names,
+                            backend=self.backend,
+                            name=f"{self.name}.renamed")
+        out._fixed_batch = self._fixed_batch
+        if self._output_signature is not None:
+            out._output_signature = {
+                output_map.get(n, n): v
+                for n, v in self._output_signature.items()}
+        return out
 
     # -- execution ----------------------------------------------------------
 
@@ -338,6 +352,12 @@ class ModelFunction:
 
         mf = ModelFunction(apply_fn, None, sig, output_names, name=name)
         mf._output_signature = out_sig
+        try:
+            mf._fixed_batch = int(avals[0].shape[0])
+        except Exception:
+            # symbolic batch dims (jax shape-poly raises its own
+            # InconclusiveDimensionOperation on int()) → no constraint
+            mf._fixed_batch = None
         return mf
 
     # -- shipping -----------------------------------------------------------

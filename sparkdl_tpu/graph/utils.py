@@ -17,6 +17,13 @@ from typing import List, Optional, Tuple
 from sparkdl_tpu.graph.function import ModelFunction
 
 
+def _propagate_fixed_batch(base: ModelFunction, wrapped: ModelFunction):
+    """Wrappers around a fixed-batch deserialized program must keep its
+    batch constraint, or their eval_shape probes crash with batch-1
+    inputs the export rejects."""
+    wrapped._fixed_batch = base._fixed_batch
+
+
 def validated_model(mf) -> ModelFunction:
     """Assert the object is a usable ModelFunction (reference
     ``validated_graph``)."""
@@ -89,10 +96,15 @@ def select_outputs(mf: ModelFunction, names: List[str],
         out = mf.apply_fn(params_, inputs)
         return {k: out[k] for k in names}
 
-    return ModelFunction(
+    out = ModelFunction(
         apply_fn, params=mf.params, input_signature=mf.input_signature,
         output_names=list(names), backend=mf.backend,
         name=name or f"{mf.name}[{','.join(names)}]")
+    _propagate_fixed_batch(mf, out)
+    if mf._output_signature is not None:
+        out._output_signature = {
+            k: v for k, v in mf._output_signature.items() if k in names}
+    return out
 
 
 def with_preprocessor(mf: ModelFunction, fn, input_signature=None,
@@ -107,11 +119,15 @@ def with_preprocessor(mf: ModelFunction, fn, input_signature=None,
     def apply_fn(params_, inputs):
         return mf.apply_fn(params_, fn(inputs))
 
-    return ModelFunction(
+    out = ModelFunction(
         apply_fn, params=mf.params,
         input_signature=input_signature or mf.input_signature,
         output_names=mf.output_names, backend=mf.backend,
         name=name or f"pre+{mf.name}")
+    _propagate_fixed_batch(mf, out)
+    if mf._output_signature is not None:
+        out._output_signature = dict(mf._output_signature)
+    return out
 
 
 def with_postprocessor(mf: ModelFunction, fn,
@@ -137,18 +153,22 @@ def with_postprocessor(mf: ModelFunction, fn,
                 "output_names_out explicitly (name inference would "
                 "execute the model at wrap time)")
         import jax
+        # fixed-batch deserialized programs reject any other batch size
+        nb = mf._fixed_batch or 1
         probe = {
-            k: jax.ShapeDtypeStruct((1,) + tuple(
+            k: jax.ShapeDtypeStruct((nb,) + tuple(
                 d if d is not None else 1 for d in shape), dtype)
             for k, (shape, dtype) in mf.input_signature.items()}
         out = jax.eval_shape(lambda p, x: apply_fn(p, x),
                              mf.params, probe)
         out_names = list(out)
 
-    return ModelFunction(
+    out = ModelFunction(
         apply_fn, params=mf.params, input_signature=mf.input_signature,
         output_names=out_names, backend=mf.backend,
         name=name or f"{mf.name}+post")
+    _propagate_fixed_batch(mf, out)
+    return out
 
 
 def strip_and_freeze(mf: ModelFunction,

@@ -414,3 +414,18 @@ class TestTargetPrep:
         out = KerasImageFileEstimator._prepare_targets(y, "mse", 2)
         assert out.dtype == np.float32
         np.testing.assert_array_equal(out, y)
+
+    def test_double_class_labels_one_hot(self):
+        """Spark-convention float64 integral class ids must one-hot for
+        categorical losses exactly like ints (regression: they fell
+        into the 1-D lift and raised for any multi-wide head)."""
+        y = np.array([0.0, 1.0, 1.0, 0.0])
+        out = KerasImageFileEstimator._prepare_targets(
+            y, "categorical_crossentropy", 2)
+        np.testing.assert_array_equal(
+            out, np.eye(2, dtype=np.float32)[[0, 1, 1, 0]])
+        # fractional labels stay out of the one-hot path: they lift and
+        # raise against a multi-wide head rather than round silently
+        with pytest.raises(ValueError, match="1-D targets"):
+            KerasImageFileEstimator._prepare_targets(
+                np.array([0.5, 1.0]), "categorical_crossentropy", 2)

@@ -138,6 +138,31 @@ class TestIngestionMatrix:
         mf2 = ModelIngest.fromExport(mf.export(batch_size=6))
         _assert_matches(mf2, x_batch, expected)
 
+    def test_fixed_batch_survives_wrappers(self, mlp_weights, x_batch,
+                                           expected):
+        """Graph-surgery wrappers over a FIXED-batch deserialized
+        program must keep its batch constraint: their eval_shape probes
+        previously used batch 1, which such exports reject
+        (regression)."""
+        from sparkdl_tpu.graph import utils as tfx
+
+        mf = ModelIngest.fromFunction(mlp_apply, mlp_weights,
+                                      input_shape=(IN_DIM,))
+        frozen = ModelIngest.fromExport(mf.export(batch_size=6))
+
+        post = tfx.with_postprocessor(frozen,
+                                      lambda o: {"y2": o["output"] * 2})
+        assert post.output_names == ["y2"]  # probe at batch 6, not 1
+        np.testing.assert_allclose(
+            np.asarray(post({"input": x_batch})["y2"]), expected * 2,
+            rtol=1e-5)
+
+        sel = tfx.select_outputs(frozen, ["output"])
+        assert sel.output_signature()["output"][0] == (OUT_DIM,)
+
+        renamed = frozen.rename_io(output_map={"output": "z"})
+        assert renamed.output_signature()["z"][0] == (OUT_DIM,)
+
     def _keras_model(self, mlp_weights):
         import keras
         m = keras.Sequential([

@@ -145,6 +145,23 @@ def test_spark_engine_execute_contract(featurized):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
 
 
+def test_spark_engine_union_of_different_plans():
+    """A different-plan union's deferred sides must survive the Spark
+    task boundary (regression: the deferred loader reached into
+    LocalEngine privates and captured an unpicklable lock)."""
+    a = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 2) \
+        .filter_rows(np.arange(6.0) >= 1)  # non-preserving plan
+    b = DataFrame.from_table(pa.table({"x": np.arange(6.0, 10.0)}), 2)
+    u = a.union(b)
+    expected = [r["x"] for r in u.collect_rows()]
+    assert expected == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+
+    engine = SparkEngine(spark=_FakeSparkSession())
+    got = pa.Table.from_batches(
+        list(engine.execute(u._sources, u._plan)))
+    assert got.column("x").to_pylist() == expected
+
+
 def test_spark_engine_with_index_uses_logical_identity():
     """A reordered frame's with_index stages must see each partition's
     pinned LOGICAL index on the Spark engine too, not the task position
