@@ -28,7 +28,7 @@ dropped in where available"). The binding has two halves:
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Optional, Sequence
+from typing import Callable, Iterator, Sequence
 
 import pyarrow as pa
 
@@ -58,8 +58,7 @@ def _require_pyspark():
             "pipeline runs identically on it.") from e
 
 
-def plan_to_map_in_arrow(plan: Sequence, index: Optional[int] = None
-                         ) -> Callable[
+def plan_to_map_in_arrow(plan: Sequence) -> Callable[
         [Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
     """Compile a stage plan into a ``mapInArrow`` function.
 
@@ -68,9 +67,10 @@ def plan_to_map_in_arrow(plan: Sequence, index: Optional[int] = None
         fn = plan_to_map_in_arrow(df_tpu._plan)
         out = spark_df.mapInArrow(fn, schema=arrow_schema_ddl)
 
-    ``index`` bakes in a fixed partition index for ``with_index``
-    stages; when None it is taken from the Spark ``TaskContext``
-    (falling back to 0 outside Spark).
+    ``with_index`` stages receive the Spark partition id from the
+    ``TaskContext`` (0 outside Spark). :class:`SparkEngine` instead
+    bakes each source's LOGICAL index into its task tuples and applies
+    the plan via :func:`apply_plan` directly.
 
     All stages run inline on the Spark task's Python worker. Executors
     that own an exclusive accelerator (TPU) must run ONE task at a time
@@ -79,20 +79,17 @@ def plan_to_map_in_arrow(plan: Sequence, index: Optional[int] = None
     the same device.
     """
     stages = list(plan)
-    baked = index
 
     def apply_batches(batches: Iterator[pa.RecordBatch]
                       ) -> Iterator[pa.RecordBatch]:
-        index = baked
-        if index is None:
-            index = 0
-            try:  # Spark partition id for with_index stages
-                from pyspark import TaskContext
-                ctx = TaskContext.get()
-                if ctx is not None:
-                    index = ctx.partitionId()
-            except ImportError:
-                pass
+        index = 0
+        try:  # Spark partition id for with_index stages
+            from pyspark import TaskContext
+            ctx = TaskContext.get()
+            if ctx is not None:
+                index = ctx.partitionId()
+        except ImportError:
+            pass
         for batch in batches:
             yield apply_plan(stages, batch, index)
 

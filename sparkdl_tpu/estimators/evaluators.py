@@ -98,6 +98,16 @@ class LossEvaluator(Evaluator):
             # squeeze BEFORE the class-label guard, or an (N,1) tensor
             # column of integer labels would bypass it
             preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
+        if preds.ndim == 1 and len(preds) \
+                and preds.min(initial=1.0) < 0.0:
+            # negative values are as definitively not-probabilities as
+            # values above 1 (e.g. a {-1, 1} label convention column):
+            # clipping them to 1e-7 would return a near-perfect loss
+            raise ValueError(
+                f"column {self.getOrDefault('predictionCol')!r} "
+                "holds negative values, not probabilities; point "
+                "LossEvaluator(predictionCol=...) at the probability "
+                "vector column (e.g. 'probability')")
         if (preds.ndim == 1 and len(preds)
                 and np.all(preds == np.round(preds))):
             if preds.max(initial=0.0) > 1.0:

@@ -95,10 +95,15 @@ def _config_fingerprint_bytes(est) -> bytes:
     resume the same checkpoints, not start a fresh directory."""
     fit_params = {k: v for k, v in est.getKerasFitParams().items()
                   if k != "epochs"}
-    return (repr(sorted(fit_params.items()))
-            + repr(est.getKerasLoss())
-            + repr(est.getOrDefault("kerasOptimizer"))
-            + est.getModelFile()).encode()
+    # field SEPARATORS matter: delimiter-free concatenation lets
+    # distinct configs collide byte-for-byte and silently share a
+    # checkpoint directory
+    return "\x1f".join([
+        repr(sorted(fit_params.items())),
+        repr(est.getKerasLoss()),
+        repr(est.getOrDefault("kerasOptimizer")),
+        est.getModelFile(),
+    ]).encode()
 
 
 def _make_step(model, loss_fn, tx):
@@ -469,12 +474,22 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         broadcast to [N,N] and BCE silently minimizes a wrong
         objective."""
         if loss == "categorical_crossentropy" and y.ndim == 1:
+            ids = None
             if np.issubdtype(y.dtype, np.integer):
-                return np.eye(n_out, dtype=np.float32)[y]
-            if (np.issubdtype(y.dtype, np.floating) and len(y)
+                ids = y.astype(np.int64)
+            elif (np.issubdtype(y.dtype, np.floating) and len(y)
                     and (y == np.round(y)).all()):
-                return np.eye(n_out, dtype=np.float32)[
-                    y.astype(np.int64)]
+                ids = y.astype(np.int64)
+            if ids is not None:
+                if len(ids) and (ids.min() < 0 or ids.max() >= n_out):
+                    # np.eye fancy-indexing would silently WRAP a -1
+                    # label to the last class (re-encode {-1,1} to
+                    # {0,1}, like LogisticRegression demands)
+                    raise ValueError(
+                        f"class ids must be in [0, {n_out}); got range "
+                        f"[{ids.min()}, {ids.max()}] (re-encode e.g. "
+                        "{-1,1} labels to {0,1})")
+                return np.eye(n_out, dtype=np.float32)[ids]
         y = np.asarray(y, dtype=np.float32)
         if y.ndim == 1:
             y = y.reshape(len(y), 1)
@@ -523,8 +538,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         h = hashlib.sha256()
         h.update(_config_fingerprint_bytes(est))
         for u, l in zip(uris, labels):
-            h.update(str(u).encode())
-            h.update(repr(l).encode())
+            # separators: 'img1',23 must not hash like 'img12',3
+            h.update(str(u).encode() + b"\x1f")
+            h.update(repr(l).encode() + b"\x1e")
         return h.hexdigest()[:16]
 
     def _epoch_stream(self, loaded, label_col, batch_size,

@@ -383,6 +383,21 @@ class TestEvaluators:
         with pytest.raises(ValueError, match="class labels"):
             ev.evaluate(df)
 
+    def test_loss_evaluator_rejects_negative_values(self):
+        """Negative values are as definitively not-probabilities as
+        values above 1 (e.g. a {-1,1} label column) — clipping them
+        returned a near-perfect garbage loss (regression)."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.frame import DataFrame
+        batch = pa.RecordBatch.from_pylist(
+            [{"prediction": -1.0, "label": 0},
+             {"prediction": 1.0, "label": 1}])
+        df = DataFrame.from_batches([batch])
+        ev = LossEvaluator(predictionCol="prediction", labelCol="label")
+        with pytest.raises(ValueError, match="negative"):
+            ev.evaluate(df)
+
     def test_loss_evaluator_rejects_n1_label_tensor_column(self):
         """The same mistake stored as an (N,1) tensor column must hit
         the guard too (regression: the squeeze ran after it)."""
@@ -429,3 +444,9 @@ class TestTargetPrep:
         with pytest.raises(ValueError, match="1-D targets"):
             KerasImageFileEstimator._prepare_targets(
                 np.array([0.5, 1.0]), "categorical_crossentropy", 2)
+        # out-of-range ids raise instead of np.eye silently WRAPPING
+        # -1 to the last class (regression)
+        for bad in ([-1.0, 1.0], [0, 3]):
+            with pytest.raises(ValueError, match="re-encode|class ids"):
+                KerasImageFileEstimator._prepare_targets(
+                    np.array(bad), "categorical_crossentropy", 2)

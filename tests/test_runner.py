@@ -60,9 +60,13 @@ class TestBatchRunner:
         with pytest.raises(ValueError):
             BatchRunner(_double_fn(), batch_size=0)
 
-    def test_strategy_resolution(self):
+    def test_strategy_resolution(self, monkeypatch):
         from sparkdl_tpu.runtime.runner import resolve_strategy
 
+        # isolate from the documented env override: a developer running
+        # the suite with SPARKDL_TPU_RUNNER_STRATEGY exported must not
+        # see spurious failures here
+        monkeypatch.delenv("SPARKDL_TPU_RUNNER_STRATEGY", raising=False)
         assert resolve_strategy("immediate", None) == ("immediate", 0)
         assert resolve_strategy("deferred", 5) == ("deferred", 5)
         from sparkdl_tpu.runtime.runner import MAX_INFLIGHT_HOST_ASYNC
@@ -72,8 +76,6 @@ class TestBatchRunner:
         # an explicit queue depth means the caller wants a queue — it
         # must select deferred, not be silently dropped by the
         # tunnel-env auto-default
-        import os
-        assert "SPARKDL_TPU_RUNNER_STRATEGY" not in os.environ
         assert resolve_strategy(None, 8) == ("deferred", 8)
         # contradictions and typos are loud
         with pytest.raises(ValueError, match="contradicts"):
