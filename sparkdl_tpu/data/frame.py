@@ -37,9 +37,11 @@ class _DeferredSide:
 
     Pickle-safe for Spark task shipping: the lock, the cached batches,
     and the engine are process-local and dropped on the wire — a remote
-    task rematerializes the side itself via ``apply_plan`` (repeated
-    work per task, but correct; Spark's own different-plan unions
-    likewise recompute or shuffle)."""
+    task computes ONLY the side partition it asks for via
+    ``apply_plan`` (per-task copies share nothing, so full
+    materialization there would cost O(P²) partition decodes
+    cluster-wide; Spark's own different-plan unions likewise recompute
+    or shuffle)."""
 
     def __init__(self, engine, plan, sources):
         self._engine = engine
@@ -68,6 +70,11 @@ class _DeferredSide:
         return apply_plan(self._plan, s.load(), idx)
 
     def get(self, i: int) -> pa.RecordBatch:
+        if self._engine is None:
+            # Post-pickle (remote task) path: there is no process-local
+            # cache another partition could reuse — compute just this
+            # partition instead of pool-mapping the whole side.
+            return self._run_partition(self._sources[i], i)
         with self._lock:
             if self._batches is None:
                 from concurrent.futures import ThreadPoolExecutor
@@ -391,6 +398,16 @@ class DataFrame:
             print("|" + "|".join(f" {r[c].ljust(widths[c])} "
                                  for c in cols) + "|")
         print(line)
+
+    def cache(self) -> "DataFrame":
+        """Materialize the plan ONCE and return a frame over the
+        in-memory result (Spark's ``df.cache()`` affordance, eager).
+        Repeated materializations of the returned frame — CV folds,
+        multi-trial fits, per-epoch passes — re-slice the table instead
+        of re-running a decode-bearing plan."""
+        return DataFrame.from_table(self.collect(),
+                                    max(1, len(self._sources)),
+                                    self._engine)
 
     def filter_rows(self, mask: np.ndarray) -> "DataFrame":
         """Keep rows where the GLOBAL boolean mask is true (mask indexed in

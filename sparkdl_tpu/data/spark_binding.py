@@ -14,7 +14,8 @@ dropped in where available"). The binding has two halves:
   ``execute(sources, plan)`` contract by parallelizing partition loads
   as a Spark job. Constructing one without pyspark raises with
   instructions; passing an explicit session duck-types (execute() only
-  needs ``sparkContext.parallelize(seq, n).map(fn).collect()``), which
+  needs ``sparkContext.parallelize(seq, n).map(fn)`` plus
+  ``toLocalIterator()`` — or ``collect()`` on minimal fakes), which
   is how the contract test drives the full path — including cloudpickle
   round-trips of the task closures, the way Spark ships them.
   Shippability is designed, not assumed: RunnerMetrics recreates its
@@ -102,19 +103,23 @@ class SparkEngine:
     Drop-in for :class:`~sparkdl_tpu.data.engine.LocalEngine` behind the
     same ``execute(sources, plan)`` contract: partition sources are
     parallelized one-per-task, each task loads its batch and applies the
-    compiled plan, and results stream back through ``collect`` in
-    partition order.
+    compiled plan, and results stream back lazily in partition order
+    (windowed ``runJob`` collections), keeping driver memory
+    O(``stream_chunk_size`` partitions) while the cluster still runs a
+    whole window's tasks in parallel.
     """
 
-    def __init__(self, spark=None):
+    def __init__(self, spark=None, stream_chunk_size: int = 64):
         if spark is None:
             _require_pyspark()
             from pyspark.sql import SparkSession
             spark = SparkSession.builder.getOrCreate()
         # An explicit session is duck-typed: execute() only needs
-        # sparkContext.parallelize(seq, n).map(fn).collect(), which also
-        # makes the engine contract-testable without pyspark.
+        # sparkContext.parallelize(seq, n).map(fn) plus one of
+        # runJob / toLocalIterator / collect, which also makes the
+        # engine contract-testable without pyspark.
         self.spark = spark
+        self.stream_chunk_size = max(1, int(stream_chunk_size))
 
     def execute(self, sources: Sequence, plan: Sequence
                 ) -> Iterator[pa.RecordBatch]:
@@ -138,8 +143,35 @@ class SparkEngine:
                 w.write_batch(batch)
             return sink.getvalue().to_pybytes()
 
-        results = self.spark.sparkContext.parallelize(
-            loads, len(loads)).map(run_partition).collect()
+        sc = self.spark.sparkContext
+        rdd = sc.parallelize(loads, len(loads)).map(run_partition)
+        # Stream results back in bounded windows. collect() would
+        # materialize EVERY partition's Arrow IPC bytes on the driver at
+        # once — at north-star scale (1M rows × 2048-d float32 ≈ 8 GB)
+        # that is a driver OOM by construction, where LocalEngine
+        # deliberately bounds inflight results. Plain toLocalIterator
+        # has the opposite failure: pyspark schedules ONE JOB PER
+        # PARTITION sequentially, so a wide cluster degrades from
+        # max(partition time) to sum(partition times). Windowed runJob
+        # keeps both properties: each window's tasks run in parallel
+        # across the cluster, driver memory stays
+        # O(stream_chunk_size) partitions.
+        run_job = getattr(sc, "runJob", None)
+        if callable(run_job):
+            for lo in range(0, len(loads), self.stream_chunk_size):
+                window = list(range(lo, min(lo + self.stream_chunk_size,
+                                            len(loads))))
+                for raw in run_job(rdd, lambda it: list(it), window):
+                    with pa.ipc.open_stream(pa.BufferReader(raw)) as r:
+                        yield from r
+            return
+        if hasattr(rdd, "toLocalIterator"):
+            results = rdd.toLocalIterator()
+        else:
+            # A duck-typed session may only offer collect(); accept it
+            # so minimal fakes still satisfy the contract, but memory is
+            # then O(dataset) — fine only for test-sized frames.
+            results = iter(rdd.collect())
         for raw in results:
             with pa.ipc.open_stream(pa.BufferReader(raw)) as r:
                 yield from r

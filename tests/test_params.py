@@ -186,6 +186,26 @@ class TestCrossValidator:
         assert abs(cvm.bestModel.mean - np.arange(30).mean()) < 1e-9
 
 
+    def test_cv_materializes_dataset_once(self):
+        """A decode-bearing plan must run ONCE per fit — the old fold
+        construction re-collected the frame on every filter_rows call,
+        fully re-decoding 2×numFolds times (VERDICT r2 weak #2)."""
+        calls = {"n": 0}
+
+        def counting(batch):
+            if batch.num_rows:  # ignore zero-row schema probes
+                calls["n"] += 1
+            return batch
+
+        df = _df(30).map_batches(counting, name="decode")
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        cv = CrossValidator(estimator=e,
+                            estimatorParamMaps=[{e.shift: 0.0}],
+                            evaluator=MAE(), numFolds=3)
+        cv.fit(df)
+        assert calls["n"] == df.num_partitions  # one pass, ever
+
+
 class TestTrainValidationSplit:
     def test_selects_best_and_refits_on_full_data(self):
         e = MeanEstimator(inputCol="x", outputCol="m")
