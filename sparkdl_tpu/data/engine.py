@@ -16,36 +16,93 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
-from typing import Iterator, List, Optional, Sequence
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Iterator, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
 logger = logging.getLogger(__name__)
 
 
+def default_retryable_exceptions() -> Tuple[type, ...]:
+    """Exception families a partition re-run can plausibly fix.
+
+    ``OSError`` covers disk and Arrow IO. The jax runtime-error family
+    covers transient device failures — a dropped PJRT tunnel connection
+    mid-partition (realistic in this very environment), a preempted
+    device — which re-run cleanly because sources re-load from disk and
+    stages are pure. jax errors carrying a DETERMINISTIC status code
+    (INVALID_ARGUMENT, a genuine RESOURCE_EXHAUSTED allocation failure,
+    ...) are filtered out by :func:`is_deterministic_jax_error` even
+    though the class is listed here. Python-level user errors (bad
+    column names, trace-time shape mismatches) are never retried.
+    """
+    excs = [OSError]
+    try:
+        from jax.errors import JaxRuntimeError
+        excs.append(JaxRuntimeError)
+    except ImportError:  # pragma: no cover - jax is a hard dep in env
+        pass
+    return tuple(excs)
+
+
+# Status codes that mean "this exact program will fail this exact way
+# again" — re-running the partition cannot help, so time-to-failure must
+# not triple and the retry warning must not suggest transience.
+# (RESOURCE_EXHAUSTED: a program whose allocations exceed HBM fails
+# deterministically; transient allocator races surface as INTERNAL or
+# UNAVAILABLE in PJRT.)
+_DETERMINISTIC_JAX_STATUSES = (
+    "INVALID_ARGUMENT", "NOT_FOUND", "ALREADY_EXISTS", "PERMISSION_DENIED",
+    "FAILED_PRECONDITION", "OUT_OF_RANGE", "UNIMPLEMENTED",
+    "RESOURCE_EXHAUSTED", "UNAUTHENTICATED",
+)
+
+
+def is_deterministic_jax_error(exc: BaseException) -> bool:
+    """True when a jax/PJRT runtime error carries a status code that a
+    re-run cannot fix. XlaRuntimeError IS JaxRuntimeError, and its
+    message leads with the absl status name ("INVALID_ARGUMENT: ...")."""
+    try:
+        from jax.errors import JaxRuntimeError
+    except ImportError:  # pragma: no cover
+        return False
+    if not isinstance(exc, JaxRuntimeError):
+        return False
+    msg = str(exc).lstrip()
+    return any(msg.startswith(s) for s in _DETERMINISTIC_JAX_STATUSES)
+
+
 class LocalEngine:
     """Thread-pool engine with ordered streaming and bounded in-flight
     partitions (backpressure keeps memory flat on large frames).
 
-    IO failures (``OSError`` family, which includes Arrow IO errors) are
-    retried ``max_retries`` times before propagating — the counterpart
-    of Spark's task retry, which gave the reference free retry of
-    inference partitions (SURVEY §5 "failure detection"): sources
-    re-load from disk, so a transient read failure re-runs cleanly.
-    Deterministic errors (bad column names, shape mismatches) propagate
-    immediately and unchanged.
+    Transient failures are retried ``max_retries`` times before
+    propagating — the counterpart of Spark's task retry, which gave the
+    reference free retry of inference partitions (SURVEY §5 "failure
+    detection"). The retryable set defaults to
+    :func:`default_retryable_exceptions` (IO + jax/PJRT transients) and
+    is configurable via ``retryable_exceptions``. Deterministic errors
+    (bad column names, shape mismatches) propagate immediately and
+    unchanged.
     """
 
     def __init__(self, num_workers: Optional[int] = None,
                  max_inflight: Optional[int] = None,
                  max_retries: int = 2,
-                 stage_metrics=None):
+                 stage_metrics=None,
+                 retryable_exceptions: Optional[Tuple[type, ...]] = None):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
         # Enough in-flight partitions to keep workers busy while the
         # consumer drains in order.
         self.max_inflight = max_inflight or self.num_workers * 2
         self.max_retries = max_retries
+        # normalize to tuple: `except` rejects lists/sets at failure
+        # time (masking the real error); an explicit () means "retry
+        # nothing" and must not fall back to the defaults
+        self.retryable_exceptions = (
+            tuple(retryable_exceptions) if retryable_exceptions is not None
+            else default_retryable_exceptions())
         # optional sparkdl_tpu.utils.StageMetrics for per-stage timing
         self.stage_metrics = stage_metrics
         self._pool = ThreadPoolExecutor(
@@ -91,7 +148,9 @@ class LocalEngine:
         for attempt in range(attempts):
             try:
                 return self._run_once(source, plan, index)
-            except OSError as e:
+            except self.retryable_exceptions as e:
+                if is_deterministic_jax_error(e):
+                    raise
                 if attempt + 1 >= attempts:
                     raise
                 logger.warning(

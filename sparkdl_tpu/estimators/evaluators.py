@@ -131,6 +131,17 @@ class LossEvaluator(Evaluator):
                 "saturated probabilities, this loss is meaningless; "
                 "point predictionCol at the probability column",
                 self.getOrDefault("predictionCol"))
+        if preds.ndim > 1 and preds.size \
+                and (preds.min() < 0.0 or preds.max() > 1.0):
+            # A probability-VECTOR column with values outside [0, 1] is
+            # raw logits mistakenly wired in; clipping would return a
+            # plausible-looking loss (the 1-D guards above catch the
+            # scalar case — this is its multi-dimensional twin).
+            raise ValueError(
+                f"column {self.getOrDefault('predictionCol')!r} holds "
+                "values outside [0, 1] (raw logits?), not "
+                "probabilities; point LossEvaluator(predictionCol=...) "
+                "at the probability vector column (e.g. 'probability')")
         preds = np.clip(preds, 1e-7, 1.0 - 1e-7)
         if preds.ndim == 1:  # binary cross-entropy on a scalar probability
             y = (labels.argmax(-1) if labels.ndim > 1

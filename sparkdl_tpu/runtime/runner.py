@@ -169,15 +169,23 @@ def start_host_copies(res: Dict[str, jax.Array]) -> bool:
     Real runtime errors propagate; only the missing-API case degrades."""
     global _warned_no_host_async
     for v in res.values():
-        try:
-            v.copy_to_host_async()
-        except (AttributeError, NotImplementedError):
-            if not _warned_no_host_async:
-                _warned_no_host_async = True
-                logging.getLogger(__name__).warning(
-                    "backend lacks copy_to_host_async; host_async "
-                    "degrades to a shallow deferred queue")
-            return False
+        # Probe for the API with getattr rather than catching
+        # AttributeError around the call — an AttributeError raised
+        # INSIDE a working implementation is a real bug and must
+        # propagate, not silently degrade the strategy.
+        copy = getattr(v, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+                continue
+            except NotImplementedError:
+                pass
+        if not _warned_no_host_async:
+            _warned_no_host_async = True
+            logging.getLogger(__name__).warning(
+                "backend lacks copy_to_host_async; host_async "
+                "degrades to a shallow deferred queue")
+        return False
     return True
 
 

@@ -398,6 +398,22 @@ class TestEvaluators:
         with pytest.raises(ValueError, match="negative"):
             ev.evaluate(df)
 
+    def test_loss_evaluator_rejects_logits_vector_column(self):
+        """A 2-D prediction column holding raw logits (negatives or
+        values above 1) must raise like the 1-D guards do, not be
+        silently clipped into a plausible loss (ADVICE r2 #3)."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.frame import DataFrame
+        from sparkdl_tpu.data.tensors import append_tensor_column
+
+        batch = pa.RecordBatch.from_pylist([{"label": 0}, {"label": 1}])
+        logits = np.array([[2.5, -1.3], [-0.2, 4.1]], dtype=np.float32)
+        batch = append_tensor_column(batch, "probability", logits)
+        ev = LossEvaluator(labelCol="label")
+        with pytest.raises(ValueError, match="outside"):
+            ev.evaluate(DataFrame.from_batches([batch]))
+
     def test_loss_evaluator_rejects_n1_label_tensor_column(self):
         """The same mistake stored as an (N,1) tensor column must hit
         the guard too (regression: the squeeze ran after it)."""

@@ -99,6 +99,26 @@ class TestBatchRunner:
         assert start_host_copies({"y": _NoAPI()}) is False
         assert start_host_copies({"y": jnp.zeros(3)}) is True
 
+    def test_start_host_copies_propagates_internal_bugs(self):
+        """An AttributeError raised INSIDE a working copy_to_host_async
+        is a genuine bug — it must propagate, not be misread as
+        'API missing' and silently degrade the strategy (ADVICE r2 #2).
+        NotImplementedError still means 'backend can't' → False."""
+        from sparkdl_tpu.runtime.runner import start_host_copies
+
+        class _Buggy:
+            def copy_to_host_async(self):
+                raise AttributeError("'NoneType' has no attribute 'buf'")
+
+        class _CannotDo:
+            def copy_to_host_async(self):
+                raise NotImplementedError
+
+        import pytest
+        with pytest.raises(AttributeError, match="buf"):
+            start_host_copies({"y": _Buggy()})
+        assert start_host_copies({"y": _CannotDo()}) is False
+
     def test_all_strategies_produce_identical_outputs(self):
         """immediate / deferred / host_async are pure dispatch policies
         — same results, same order, including the padded tail."""
