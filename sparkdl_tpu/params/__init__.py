@@ -35,6 +35,7 @@ from sparkdl_tpu.params.shared import (  # noqa: F401
     HasUseMesh,
     HasInputCol,
     HasInputMapping,
+    HasTFHParams,
     HasKerasLoss,
     HasKerasModel,
     HasKerasOptimizer,
@@ -72,6 +73,7 @@ __all__ = [
     "HasKerasOptimizer",
     "HasKerasLoss",
     "HasInputMapping",
+    "HasTFHParams",
     "HasOutputMapping",
     "HasModelFunction",
     "CanLoadImage",

@@ -181,6 +181,43 @@ class Params:
                 to._set(**{p.name: v})
         return to
 
+    # -- persistence (pyspark ML save/load semantics) -----------------------
+
+    def save(self, path: str) -> None:
+        """Persist this stage (params + fitted state + child stages) to
+        a directory; reload with :func:`sparkdl_tpu.load_model`."""
+        from sparkdl_tpu.params.persistence import save_stage
+        save_stage(self, path)
+
+    def _extra_state(self) -> Dict[str, Any]:
+        """Non-Param fitted state to persist (coefficients, histories,
+        model functions). Subclasses override; keys are restored through
+        ``_from_saved``'s ``extra``."""
+        return {}
+
+    def _child_stages(self) -> Dict[str, "Params"]:
+        """Nested stages persisted as subdirectories (PipelineModel
+        stages, CV bestModel). Keys are directory names; sorted order is
+        the reload order."""
+        return {}
+
+    def _unsaved_param_names(self) -> set:
+        """Params excluded from persistence (process-local handles)."""
+        return set()
+
+    @classmethod
+    def _from_saved(cls, params: Dict[str, Any], extra: Dict[str, Any],
+                    children: Dict[str, "Params"]) -> "Params":
+        """Rebuild from saved state. Default: explicit params go
+        straight back into the ``keyword_only`` constructor (pyspark's
+        DefaultParamsReader pattern). Stages with required non-Param
+        constructor args or children override this."""
+        if extra or children:
+            raise NotImplementedError(
+                f"{cls.__name__} saved extra state/children but does "
+                "not override _from_saved")
+        return cls(**params)
+
 
 class TypeConverters:
     """Typed converters for Param values.
@@ -261,6 +298,26 @@ class TypeConverters:
         for k, v in items:
             if not isinstance(k, str) or not isinstance(v, str):
                 raise TypeError("mapping keys and values must be str")
+            out[k] = v
+        return out
+
+    @staticmethod
+    def toHParams(value) -> dict:
+        """{str: number/array} hyperparameter dict (reference:
+        ``SparkDLTypeConverters.toTFHParams`` — a tf.contrib HParams
+        bag; here a plain dict of named constants)."""
+        import numpy as np
+        if not isinstance(value, dict):
+            raise TypeError(
+                f"expected hyperparams dict, got {type(value).__name__}")
+        out = {}
+        for k, v in value.items():
+            if not isinstance(k, str):
+                raise TypeError("hyperparam names must be str")
+            if not isinstance(v, (int, float, bool, np.ndarray, list, tuple)):
+                raise TypeError(
+                    f"hyperparam {k!r} must be numeric or array-like, "
+                    f"got {type(v).__name__}")
             out[k] = v
         return out
 

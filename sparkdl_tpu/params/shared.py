@@ -183,6 +183,29 @@ class HasInputMapping(Params):
         return self.getOrDefault("inputMapping")
 
 
+class HasTFHParams(Params):
+    """Named hyperparameter constants fed to matching model inputs
+    (reference ``TFTransformer.tfHParams``, a tf.contrib HParams bag
+    shipped into the graph; here each entry feeds the model input of
+    the same name as a row-broadcast constant)."""
+
+    tfHParams = Param("HasTFHParams", "tfHParams",
+                      "dict: model input name -> constant value",
+                      TypeConverters.toHParams)
+
+    def __init__(self):
+        super().__init__()
+        # the mixin owns its default (pyspark Has* convention) so any
+        # stage mixing it in gets a working getTFHParams for free
+        self._setDefault(tfHParams=None)
+
+    def setTFHParams(self, value):
+        return self._set(tfHParams=value)
+
+    def getTFHParams(self) -> dict:
+        return self.getOrDefault("tfHParams") or {}
+
+
 class HasOutputMapping(Params):
     """Model output name → DataFrame column (reference
     ``TFTransformer.outputMapping``)."""

@@ -19,6 +19,7 @@ from sparkdl_tpu.params import (
     HasInputMapping,
     HasModelFunction,
     HasOutputMapping,
+    HasTFHParams,
     HasUseMesh,
     Transformer,
     keyword_only,
@@ -27,27 +28,52 @@ from sparkdl_tpu.runtime.runner import RunnerMetrics
 
 
 class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
-                        HasOutputMapping, HasBatchSize, HasUseMesh):
+                        HasOutputMapping, HasBatchSize, HasUseMesh,
+                        HasTFHParams):
     @keyword_only
     def __init__(self, *, modelFunction=None, inputMapping=None,
-                 outputMapping=None, batchSize=64, useMesh=False):
+                 outputMapping=None, batchSize=64, useMesh=False,
+                 tfHParams=None):
         super().__init__()
         self._setDefault(batchSize=64, useMesh=False)
         self._set(modelFunction=modelFunction, inputMapping=inputMapping,
                   outputMapping=outputMapping, batchSize=batchSize,
-                  useMesh=useMesh)
+                  useMesh=useMesh, tfHParams=tfHParams)
         self.metrics = RunnerMetrics()
 
     def _validate(self):
         mf = self.getModelFunction()
         in_map = self.getInputMapping()     # col -> input name
         out_map = self.getOutputMapping()   # output name -> col
+        hparams = self.getTFHParams()       # input name -> constant
         missing = set(in_map.values()) - set(mf.input_names)
         if missing:
             raise ValueError(
                 f"inputMapping references unknown model inputs {missing}; "
                 f"model has {mf.input_names}")
-        unmapped = set(mf.input_names) - set(in_map.values())
+        unknown_hp = set(hparams) - set(mf.input_names)
+        if unknown_hp:
+            raise ValueError(
+                f"tfHParams references unknown model inputs {unknown_hp}; "
+                f"model has {mf.input_names}")
+        overlap = set(hparams) & set(in_map.values())
+        if overlap:
+            raise ValueError(
+                f"model inputs {overlap} supplied by BOTH inputMapping "
+                "and tfHParams")
+        for name, value in hparams.items():
+            shape, dtype = mf.input_signature[name]
+            if shape is None or any(d is None for d in shape):
+                continue  # dynamic per-row shape: nothing to check
+            got = np.asarray(value, dtype=dtype).shape
+            if got != tuple(shape):
+                # front-load the error with names; a mismatched
+                # broadcast otherwise dies mid-transform as an opaque
+                # XLA arity/shape error naming neither
+                raise ValueError(
+                    f"tfHParams[{name!r}] has shape {got}, model input "
+                    f"{name!r} expects per-row shape {tuple(shape)}")
+        unmapped = set(mf.input_names) - set(in_map.values()) - set(hparams)
         if unmapped:
             raise ValueError(f"model inputs {unmapped} not mapped")
         unknown_out = set(out_map) - set(mf.output_names)
@@ -55,10 +81,10 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
             raise ValueError(
                 f"outputMapping references unknown model outputs "
                 f"{unknown_out}; model has {mf.output_names}")
-        return mf, in_map, out_map
+        return mf, in_map, out_map, hparams
 
     def _transform(self, dataset):
-        mf, in_map, out_map = self._validate()
+        mf, in_map, out_map, hparams = self._validate()
         from sparkdl_tpu.transformers.utils import make_runner
         runner = make_runner(mf, self.getBatchSize(),
                              use_mesh=self.getUseMesh(),
@@ -77,6 +103,14 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
                 if static and arr.shape[1:] != tuple(shape):
                     arr = arr.reshape((arr.shape[0],) + tuple(shape))
                 inputs[input_name] = arr.astype(dtype, copy=False)
+            for input_name, value in hparams.items():
+                # a hyperparameter constant rides along as a
+                # row-broadcast input so the jitted program stays a
+                # single fixed-arity function
+                shape, dtype = sig[input_name]
+                const = np.asarray(value, dtype=dtype)
+                inputs[input_name] = np.broadcast_to(
+                    const, (batch.num_rows,) + const.shape)
             outputs = runner.run(inputs)
             for output_name, col in out_map.items():
                 out = np.asarray(outputs[output_name])

@@ -210,6 +210,65 @@ class TestTensorTransformer:
         with pytest.raises(ValueError, match="unknown model inputs"):
             t.transform(df)
 
+    def test_tfhparams_feeds_constant_input(self):
+        """tfHParams entries feed model inputs of the same name as
+        row-broadcast constants (reference TFTransformer.tfHParams,
+        SURVEY §2.1 tf_tensor.py)."""
+        df, x = self._df()
+
+        def apply_fn(p, inputs):
+            return {"scores": inputs["feats"] * inputs["scale"][:, None]}
+
+        mf = ModelFunction(apply_fn, None,
+                           {"feats": ((4,), np.float32),
+                            "scale": ((), np.float32)},
+                           output_names=["scores"])
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"x": "feats"},
+                              outputMapping={"scores": "y"},
+                              tfHParams={"scale": 2.5}, batchSize=4)
+        got = t.transform(df).tensor("y")
+        np.testing.assert_allclose(got, x * 2.5, rtol=1e-5, atol=1e-6)
+
+    def test_tfhparams_validation(self):
+        df, _ = self._df()
+        t = TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={"x": "feats"},
+                              outputMapping={"scores": "y"},
+                              tfHParams={"bogus": 1.0})
+        with pytest.raises(ValueError, match="tfHParams references"):
+            t.transform(df)
+        t2 = TensorTransformer(modelFunction=_mlp_model_fn(),
+                               inputMapping={"x": "feats"},
+                               outputMapping={"scores": "y"},
+                               tfHParams={"feats": 1.0})
+        with pytest.raises(ValueError, match="BOTH"):
+            t2.transform(df)
+        with pytest.raises(TypeError, match="numeric"):
+            TensorTransformer(modelFunction=_mlp_model_fn(),
+                              inputMapping={"x": "feats"},
+                              outputMapping={"scores": "y"},
+                              tfHParams={"scale": "not-a-number"})
+
+    def test_tfhparams_shape_mismatch_front_loaded(self):
+        """A wrong-shaped constant must fail at validation with the
+        param name, not mid-transform as an opaque XLA error."""
+        df, _ = self._df()
+
+        def apply_fn(p, inputs):
+            return {"scores": inputs["feats"] * inputs["scale"]}
+
+        mf = ModelFunction(apply_fn, None,
+                           {"feats": ((4,), np.float32),
+                            "scale": ((4,), np.float32)},
+                           output_names=["scores"])
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"x": "feats"},
+                              outputMapping={"scores": "y"},
+                              tfHParams={"scale": 2.0})  # scalar, not (4,)
+        with pytest.raises(ValueError, match=r"tfHParams\['scale'\]"):
+            t.transform(df)
+
     def test_unmapped_input(self):
         df, _ = self._df()
         t = TensorTransformer(modelFunction=_mlp_model_fn(),
