@@ -50,6 +50,8 @@ _EXPORTS = {
                                 "ClassificationEvaluator"),
     "LossEvaluator": ("sparkdl_tpu.estimators.evaluators",
                       "LossEvaluator"),
+    # fitted-stage persistence (pyspark ML save/load semantics)
+    "load_model": ("sparkdl_tpu.params.persistence", "load_stage"),
 }
 
 __all__ = list(_EXPORTS)

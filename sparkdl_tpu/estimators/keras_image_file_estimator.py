@@ -208,6 +208,25 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
         that.metrics = RunnerMetrics()
         return that
 
+    def _extra_state(self):
+        # the ModelFunction persists as StableHLO with the trained
+        # weights baked in (persistence.py's model_fn codec)
+        return {"modelFunction": self.modelFunction,
+                "history": [float(v) for v in self.history],
+                "resumedFrom": self.resumedFrom}
+
+    @classmethod
+    def _from_saved(cls, params, extra, children):
+        return cls(extra["modelFunction"],
+                   inputCol=params["inputCol"],
+                   outputCol=params["outputCol"],
+                   imageLoader=params.get("imageLoader"),
+                   outputMode=params.get("outputMode", "vector"),
+                   batchSize=params.get("batchSize", 64),
+                   useMesh=params.get("useMesh", False),
+                   history=extra.get("history"),
+                   resumedFrom=extra.get("resumedFrom", 0))
+
 
 # ---------------------------------------------------------------------------
 # the estimator

@@ -1,0 +1,185 @@
+"""Fitted-stage persistence: ``stage.save(dir)`` / ``load_model(dir)``.
+
+The reference era got ``Pipeline.save``/``load`` semantics from pyspark
+ML for Params-based stages (SURVEY §2.1 param-system row); this build
+reimplements Pipeline/CrossValidator natively, so persistence is native
+too. A saved stage is a directory:
+
+* ``metadata.json`` — ``{"format", "version", "class", "params",
+  "extra", "children"}`` where ``params`` holds the stage's explicitly
+  set Params and ``extra`` its non-Param fitted state (coefficients,
+  training history, ...), each as a typed descriptor;
+* sidecar files for values JSON can't carry: numpy arrays as ``.npy``,
+  jax-backend ModelFunctions as serialized StableHLO with weights baked
+  in (``ModelFunction.export`` — the same deploy form the engine
+  broadcasts), callables (``imageLoader``) via cloudpickle;
+* one subdirectory per child stage (PipelineModel stages,
+  CrossValidatorModel's bestModel), each a saved stage itself.
+
+``load_model`` resolves ``class`` by import path and rebuilds the stage
+through ``cls._from_saved(params, extra, children)`` — the default
+implementation passes explicit params straight back to the
+``keyword_only`` constructor, which is exactly how pyspark's
+DefaultParamsReader rebuilds a stage from its param map.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+FORMAT = "sparkdl_tpu.stage"
+VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# value codecs
+# ---------------------------------------------------------------------------
+
+def _is_plain_json(value) -> bool:
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return True
+    if isinstance(value, (list, tuple)):
+        return all(_is_plain_json(v) for v in value)
+    if isinstance(value, dict):
+        return all(isinstance(k, str) and _is_plain_json(v)
+                   for k, v in value.items())
+    return False
+
+
+def _encode_value(key: str, value, directory: str) -> dict:
+    """Value → JSON descriptor (+ sidecar file when needed)."""
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    if _is_plain_json(value):
+        return {"kind": "json", "value": value}
+    if isinstance(value, np.ndarray):
+        fname = f"{key}.npy"
+        np.save(os.path.join(directory, fname), value)
+        return {"kind": "ndarray", "file": fname}
+    if isinstance(value, ModelFunction):
+        if value.backend != "jax":
+            raise TypeError(
+                f"cannot save {key!r}: host-backend ModelFunction "
+                f"{value.name!r} wraps live TF runtime state — re-ingest "
+                "it from its source artifact after loading instead")
+        try:
+            # only the export itself may fall back; IO errors while
+            # writing the sidecar must propagate (a swallowed ENOSPC
+            # would leave a corrupt orphan and silently record pickle)
+            blob = value.export(batch_size=value._fixed_batch)
+        except Exception as e:
+            # Some programs can't export with a symbolic batch dim
+            # (shape-polymorphism limits); fall back to cloudpickle of
+            # the function object — same-environment portable, and
+            # ModelFunction.__getstate__ already drops process-local
+            # compiled/device state.
+            import logging
+
+            import cloudpickle
+            logging.getLogger(__name__).warning(
+                "StableHLO export of %s failed (%s: %s); persisting "
+                "%r via cloudpickle — the save is bound to this "
+                "environment, not portable", value.name,
+                type(e).__name__, e, key)
+            fname = f"{key}.mf.pkl"
+            with open(os.path.join(directory, fname), "wb") as f:
+                f.write(cloudpickle.dumps(value))
+            return {"kind": "pickle", "file": fname}
+        fname = f"{key}.stablehlo"
+        with open(os.path.join(directory, fname), "wb") as f:
+            f.write(blob)
+        # no batch metadata: deserialize re-derives _fixed_batch from
+        # the exported avals
+        return {"kind": "model_fn", "file": fname, "name": value.name}
+    import cloudpickle
+    fname = f"{key}.pkl"
+    with open(os.path.join(directory, fname), "wb") as f:
+        f.write(cloudpickle.dumps(value))
+    return {"kind": "pickle", "file": fname}
+
+
+def _decode_value(desc: dict, directory: str):
+    kind = desc["kind"]
+    if kind == "json":
+        return desc["value"]
+    if kind == "ndarray":
+        return np.load(os.path.join(directory, desc["file"]))
+    if kind == "model_fn":
+        from sparkdl_tpu.graph.function import ModelFunction
+        with open(os.path.join(directory, desc["file"]), "rb") as f:
+            return ModelFunction.deserialize(f.read(),
+                                             name=desc.get("name",
+                                                           "stablehlo"))
+    if kind == "pickle":
+        import cloudpickle
+        with open(os.path.join(directory, desc["file"]), "rb") as f:
+            return cloudpickle.loads(f.read())
+    raise ValueError(f"unknown descriptor kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+def save_stage(stage, path: str) -> None:
+    """Save a Transformer/Model/Estimator to ``path`` (created;
+    must be empty or absent — never silently overwrites a prior save)."""
+    os.makedirs(path, exist_ok=True)
+    if os.listdir(path):
+        # also catches a prior save that crashed before metadata.json:
+        # mixing fresh sidecars with orphans would poison the artifact
+        raise FileExistsError(
+            f"{path} is not empty; choose a fresh directory "
+            "(overwrite is never implicit)")
+    cls = type(stage)
+    params = {p.name: _encode_value(f"param_{p.name}", v, path)
+              for p, v in stage._paramMap.items()
+              if p.name not in stage._unsaved_param_names()}
+    extra = {k: _encode_value(f"extra_{k}", v, path)
+             for k, v in stage._extra_state().items()}
+    children = {}
+    for name, child in stage._child_stages().items():
+        child_dir = os.path.join(path, name)
+        save_stage(child, child_dir)
+        children[name] = True
+    meta = {
+        "format": FORMAT,
+        "version": VERSION,
+        "class": f"{cls.__module__}.{cls.__qualname__}",
+        "params": params,
+        "extra": extra,
+        "children": sorted(children),
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+
+def load_stage(path: str):
+    """Load a stage saved by :func:`save_stage` (also exported as
+    ``sparkdl_tpu.load_model``)."""
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"{path} is not a saved stage (no metadata.json)")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if meta.get("format") != FORMAT:
+        raise ValueError(
+            f"{path} was not written by sparkdl_tpu persistence "
+            f"(format={meta.get('format')!r})")
+    module, _, qualname = meta["class"].rpartition(".")
+    cls = importlib.import_module(module)
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    params = {name: _decode_value(d, path)
+              for name, d in meta["params"].items()}
+    extra = {name: _decode_value(d, path)
+             for name, d in meta["extra"].items()}
+    children = {name: load_stage(os.path.join(path, name))
+                for name in meta.get("children", [])}
+    return cls._from_saved(params, extra, children)

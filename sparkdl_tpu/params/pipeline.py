@@ -72,6 +72,14 @@ class PipelineModel(Model):
         that = PipelineModel([s.copy(extra) for s in self.stages])
         return that
 
+    def _child_stages(self):
+        return {f"stage_{i:04d}_{type(s).__name__}": s
+                for i, s in enumerate(self.stages)}
+
+    @classmethod
+    def _from_saved(cls, params, extra, children):
+        return cls([children[k] for k in sorted(children)])
+
 
 class Pipeline(Estimator):
     """Chain of Transformers/Estimators, fitted front-to-back."""

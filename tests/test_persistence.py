@@ -1,0 +1,244 @@
+"""Fitted-model persistence (VERDICT r2 missing #4): save(dir)/load(dir)
+for LogisticRegressionModel, KerasImageFileModel, PipelineModel, and the
+tuning models — pyspark ML persistence semantics the reference inherited
+(SURVEY §2.1 param-system row). The headline test reloads in a FRESH
+process and asserts identical transform output."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import sparkdl_tpu
+from sparkdl_tpu.data.frame import DataFrame
+from sparkdl_tpu.data.tensors import append_tensor_column
+from sparkdl_tpu.estimators.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+from sparkdl_tpu.params.pipeline import Pipeline, PipelineModel
+
+
+def _feature_df(n=40, d=6, seed=3):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    X = rng.normal(0, 1, (n, d)).astype(np.float32) + 2.5 * y[:, None]
+    batch = pa.RecordBatch.from_pylist([{"label": int(v)} for v in y])
+    batch = append_tensor_column(batch, "features", X)
+    return DataFrame.from_batches([batch]), X, y
+
+
+class TestLogisticRegressionPersistence:
+    def test_round_trip_identical_transform(self, tmp_path):
+        df, X, y = _feature_df()
+        model = LogisticRegression(maxIter=60, learningRate=0.2).fit(df)
+        path = str(tmp_path / "lr")
+        model.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        assert isinstance(back, LogisticRegressionModel)
+        np.testing.assert_array_equal(back.coefficients,
+                                      model.coefficients)
+        np.testing.assert_array_equal(back.intercept, model.intercept)
+        assert back.objectiveHistory == pytest.approx(
+            model.objectiveHistory)
+        a = model.transform(df).tensor("probability")
+        b = back.transform(df).tensor("probability")
+        np.testing.assert_array_equal(a, b)
+
+    def test_no_silent_overwrite(self, tmp_path):
+        df, _, _ = _feature_df(n=10)
+        model = LogisticRegression(maxIter=2).fit(df)
+        path = str(tmp_path / "lr")
+        model.save(path)
+        with pytest.raises(FileExistsError, match="fresh"):
+            model.save(path)
+
+    def test_load_rejects_non_stage_dir(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="metadata"):
+            sparkdl_tpu.load_model(str(tmp_path))
+        bogus = tmp_path / "bogus"
+        bogus.mkdir()
+        (bogus / "metadata.json").write_text(json.dumps({"format": "x"}))
+        with pytest.raises(ValueError, match="not written"):
+            sparkdl_tpu.load_model(str(bogus))
+
+
+class TestPipelinePersistence:
+    def test_featurizer_pipeline_round_trip(self, tmp_path, image_dir):
+        """The reference's headline flow — DeepImageFeaturizer →
+        LogisticRegression — saved and reloaded as ONE PipelineModel."""
+        from sparkdl_tpu.image import imageIO
+
+        table = imageIO.readImages(image_dir, numPartitions=2,
+                                   dropImageFailures=True).collect()
+        labels = pa.array([i % 2 for i in range(table.num_rows)],
+                          type=pa.int64())
+        df = DataFrame.from_table(table.append_column("label", labels), 2)
+        pipe = Pipeline(stages=[
+            sparkdl_tpu.DeepImageFeaturizer(
+                inputCol="image", outputCol="features",
+                modelName="TestNet"),
+            LogisticRegression(maxIter=20, learningRate=0.2),
+        ])
+        fitted = pipe.fit(df)
+        path = str(tmp_path / "pipe")
+        fitted.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        assert isinstance(back, PipelineModel)
+        assert [type(s).__name__ for s in back.stages] == \
+            ["DeepImageFeaturizer", "LogisticRegressionModel"]
+        a = fitted.transform(df).tensor("probability")
+        b = back.transform(df).tensor("probability")
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    def test_fresh_process_round_trip(self, tmp_path):
+        """fit → save → load in a NEW python process → identical
+        output (the round-trip bar VERDICT set)."""
+        df, X, y = _feature_df()
+        model = LogisticRegression(maxIter=40, learningRate=0.2).fit(df)
+        pm = PipelineModel([model])
+        path = str(tmp_path / "pm")
+        pm.save(path)
+        expected = pm.transform(df).tensor("probability")
+        np.save(tmp_path / "X.npy", X)
+        np.save(tmp_path / "expected.npy", expected)
+
+        script = f"""
+import numpy as np, pyarrow as pa
+import sparkdl_tpu
+from sparkdl_tpu.data.frame import DataFrame
+from sparkdl_tpu.data.tensors import append_tensor_column
+
+X = np.load({str(tmp_path / 'X.npy')!r})
+expected = np.load({str(tmp_path / 'expected.npy')!r})
+batch = pa.RecordBatch.from_pylist([{{"i": int(i)}} for i in range(len(X))])
+batch = append_tensor_column(batch, "features", X)
+df = DataFrame.from_batches([batch])
+model = sparkdl_tpu.load_model({path!r})
+got = model.transform(df).tensor("probability")
+np.testing.assert_array_equal(got, expected)
+print("FRESH_PROCESS_OK")
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = "/root/repo"
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, env=env,
+                             timeout=300)
+        assert out.returncode == 0, out.stderr
+        assert "FRESH_PROCESS_OK" in out.stdout
+
+
+class TestTransformerPersistence:
+    def test_tensor_transformer_with_model_fn_param(self, tmp_path):
+        """A ModelFunction-valued param persists as StableHLO and the
+        reloaded stage produces identical output."""
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(4, 3)).astype(np.float32)
+        mf = ModelFunction(
+            lambda p, d: {"out": d["x"] @ p["W"]}, {"W": W},
+            {"x": ((4,), np.float32)}, output_names=["out"], name="lin")
+        t = TensorTransformer(modelFunction=mf,
+                              inputMapping={"x": "x"},
+                              outputMapping={"out": "y"}, batchSize=8)
+        path = str(tmp_path / "tt")
+        t.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        batch = pa.RecordBatch.from_pylist(
+            [{"i": int(i)} for i in range(10)])
+        batch = append_tensor_column(batch, "x", X)
+        df = DataFrame.from_batches([batch])
+        a = t.transform(df).tensor("y")
+        b = back.transform(df).tensor("y")
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+class TestTuningPersistence:
+    def test_cross_validator_model_round_trip(self, tmp_path):
+        from sparkdl_tpu.estimators.evaluators import (
+            ClassificationEvaluator,
+        )
+        from sparkdl_tpu.params.tuning import CrossValidator
+
+        df, X, y = _feature_df()
+        lr = LogisticRegression(maxIter=30, learningRate=0.2)
+        cv = CrossValidator(
+            estimator=lr,
+            estimatorParamMaps=[{lr.regParam: 0.0},
+                                {lr.regParam: 0.1}],
+            evaluator=ClassificationEvaluator(
+                predictionCol="prediction"),
+            numFolds=2)
+        cvm = cv.fit(df)
+        path = str(tmp_path / "cvm")
+        cvm.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        assert back.avgMetrics == pytest.approx(cvm.avgMetrics)
+        a = cvm.transform(df).tensor("probability")
+        b = back.transform(df).tensor("probability")
+        np.testing.assert_array_equal(a, b)
+
+
+class TestKerasModelPersistence:
+    def test_keras_image_file_model_round_trip(self, tmp_path):
+        """The fitted Keras model (trained weights inside a
+        ModelFunction) survives save/load with identical predictions."""
+        import keras
+        from PIL import Image
+
+        from sparkdl_tpu.estimators import KerasImageFileEstimator
+
+        keras.utils.set_random_seed(7)
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        model_file = str(tmp_path / "m.keras")
+        m.save(model_file)
+
+        def loader(uri):
+            from PIL import Image as PILImage
+            img = PILImage.open(uri).convert("RGB").resize((8, 8))
+            return np.asarray(img, dtype=np.float32) / 255.0
+
+        rng = np.random.default_rng(11)
+        rows = []
+        for i in range(8):
+            label = i % 2
+            base = 50 if label == 0 else 200
+            arr = np.clip(rng.normal(base, 10, (8, 8, 3)),
+                          0, 255).astype(np.uint8)
+            p = str(tmp_path / f"img{i}.png")
+            Image.fromarray(arr, "RGB").save(p)
+            rows.append({"uri": p, "label": label})
+        df = DataFrame.from_pylist(rows, num_partitions=2)
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="pred", labelCol="label",
+            modelFile=model_file, imageLoader=loader,
+            kerasFitParams={"epochs": 1, "batch_size": 4,
+                            "learning_rate": 0.01, "seed": 0},
+            batchSize=4, useMesh=False)
+        fitted = est.fit(df)
+        path = str(tmp_path / "kifm")
+        fitted.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        assert back.history == pytest.approx(fitted.history)
+        a = fitted.transform(df).tensor("pred")
+        b = back.transform(df).tensor("pred")
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
