@@ -76,8 +76,14 @@ def max_pool(x, window=(3, 3), strides=(2, 2), padding="VALID"):
 
 
 def avg_pool(x, window=(3, 3), strides=(1, 1), padding="SAME"):
+    # count_include_pad=False: TF/Keras same-padded average pooling
+    # divides edge windows by the number of VALID elements, not the
+    # full window size (flax's default). With the default, every
+    # Inception mixed block's pool branch diverged at the borders —
+    # invisible to the softmax oracle, caught by the featurize-layer
+    # oracle (tests/test_import_keras.py).
     return nn.avg_pool(x, window_shape=window, strides=strides,
-                       padding=padding)
+                       padding=padding, count_include_pad=False)
 
 
 def global_avg_pool(x):

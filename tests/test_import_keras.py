@@ -20,7 +20,8 @@ from sparkdl_tpu.models.import_keras import (
 )
 
 
-def _oracle(name, keras_builder, module, size, tol):
+def _oracle(name, keras_builder, module, size, tol, feat_layer,
+            feat_tol):
     import keras
     keras.utils.set_random_seed(7)
     kmodel = keras_builder(weights=None)
@@ -32,6 +33,23 @@ def _oracle(name, keras_builder, module, size, tol):
     theirs = np.asarray(kmodel(x))
     diff = float(np.abs(np.asarray(ours) - theirs).max())
     assert diff <= tol, f"{name}: max prob diff {diff} > {tol}"
+
+    # FEATURIZE-layer equivalence, not just softmax: the penultimate
+    # vector is what DeepImageFeaturizer actually serves (transfer
+    # learning, BASELINE config #1) — a head-only match could hide a
+    # divergent trunk behind softmax saturation
+    feats_ours = np.asarray(module.apply(variables, jnp.asarray(x),
+                                         train=False, features_only=True))
+    feat_extractor = keras.Model(kmodel.inputs,
+                                 kmodel.get_layer(feat_layer).output)
+    feats_theirs = np.asarray(feat_extractor(x))
+    assert feats_ours.shape == feats_theirs.shape, \
+        f"{name}: featurize shape {feats_ours.shape} != " \
+        f"{feats_theirs.shape}"
+    scale = max(1.0, float(np.abs(feats_theirs).max()))
+    fdiff = float(np.abs(feats_ours - feats_theirs).max()) / scale
+    assert fdiff <= feat_tol, \
+        f"{name}: featurize relative diff {fdiff} > {feat_tol}"
     return variables
 
 
@@ -40,25 +58,28 @@ class TestConversionOracles:
         import keras
         from sparkdl_tpu.models.inception import InceptionV3
         _oracle("InceptionV3", keras.applications.inception_v3.InceptionV3,
-                InceptionV3(dtype=jnp.float32), 299, 1e-4)
+                InceptionV3(dtype=jnp.float32), 299, 1e-4,
+                "avg_pool", 1e-4)
 
     def test_vgg16(self):
         import keras
         from sparkdl_tpu.models.vgg import VGG16
         _oracle("VGG16", keras.applications.vgg16.VGG16,
-                VGG16(dtype=jnp.float32), 224, 1e-5)
+                VGG16(dtype=jnp.float32), 224, 1e-5, "fc2", 1e-5)
 
     def test_resnet50(self):
         import keras
         from sparkdl_tpu.models.resnet import ResNet50
         _oracle("ResNet50", keras.applications.resnet50.ResNet50,
-                ResNet50(dtype=jnp.float32), 224, 1e-5)
+                ResNet50(dtype=jnp.float32), 224, 1e-5,
+                "avg_pool", 1e-5)
 
     def test_xception(self):
         import keras
         from sparkdl_tpu.models.xception import Xception
         _oracle("Xception", keras.applications.xception.Xception,
-                Xception(dtype=jnp.float32), 299, 1e-4)
+                Xception(dtype=jnp.float32), 299, 1e-4,
+                "avg_pool", 1e-4)
 
 
 class TestZooIntegration:
