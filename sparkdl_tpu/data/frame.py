@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import threading
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
@@ -408,6 +409,69 @@ class DataFrame:
         return DataFrame.from_table(self.collect(),
                                     max(1, len(self._sources)),
                                     self._engine)
+
+    def cache_to_disk(self, directory: str) -> "DataFrame":
+        """A frame whose partitions spill to Arrow IPC files on first
+        load and re-read from disk afterwards — the multi-pass analogue
+        of :meth:`cache` for data too big (or too numerous in epochs) to
+        pin in memory. Each partition runs this frame's FULL plan once,
+        writes the result atomically (tmp + rename), and every later
+        materialization streams the file back; partition identity
+        (``logical_index``) is preserved so per-epoch partition shuffles
+        (``with_partition_order``) compose. Intended for host-stage
+        plans (decode/resize); a device stage inside the spilled plan
+        would run outside the engine's device lock on first load, and
+        the spilled stages run inside ``Source.load`` so StageMetrics
+        does not time them (the trade for running them at most once).
+        Each executing machine spills to ITS OWN ``directory`` — on a
+        distributed engine the cache is per-machine, not shared."""
+        os.makedirs(directory, exist_ok=True)
+        plan = list(self._plan)
+        preserving = all(st.row_preserving for st in plan)
+
+        def make(i: int, src: Source) -> Source:
+            logical = (src.logical_index
+                       if src.logical_index is not None else i)
+            path = os.path.join(directory, f"part_{logical:05d}.arrow")
+
+            def _load(src=src, logical=logical, path=path
+                      ) -> pa.RecordBatch:
+                if os.path.exists(path):
+                    with pa.memory_map(path) as source:
+                        table = pa.ipc.open_file(source).read_all()
+                    return table.combine_chunks().to_batches()[0] \
+                        if table.num_rows else \
+                        pa.RecordBatch.from_pylist([],
+                                                   schema=table.schema)
+                from sparkdl_tpu.data.spark_binding import apply_plan
+                batch = apply_plan(plan, src.load(), logical)
+                # tmp unique per pid AND thread: the engine's
+                # early-stop cancel() doesn't stop already-running
+                # loads, so a re-submitted partition can overlap one —
+                # a shared tmp would interleave writers. The closure
+                # may also run on a remote executor where the calling
+                # process's makedirs never happened.
+                os.makedirs(directory, exist_ok=True)
+                tmp = (f"{path}.tmp.{os.getpid()}"
+                       f".{threading.get_ident()}")
+                with pa.OSFile(tmp, "wb") as sink:
+                    with pa.ipc.new_file(sink, batch.schema) as w:
+                        w.write_batch(batch)
+                os.replace(tmp, path)
+                return batch
+
+            return Source(_load,
+                          src.num_rows if preserving else None,
+                          logical_index=src.logical_index)
+
+        out = DataFrame([make(i, s) for i, s in enumerate(self._sources)],
+                        engine=self._engine)
+        # schema from the UNDERLYING frame's zero-row probe: the cached
+        # frame's plan is empty and its load IS the spilled plan, so
+        # the default probe would decode+spill a whole partition just
+        # to answer .columns / union schema checks
+        out._schema = self.schema
+        return out
 
     def filter_rows(self, mask: np.ndarray) -> "DataFrame":
         """Keep rows where the GLOBAL boolean mask is true (mask indexed in

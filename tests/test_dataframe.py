@@ -221,6 +221,62 @@ class TestEngine:
         np.testing.assert_array_equal(xs, np.arange(50))
 
 
+class TestCacheToDisk:
+    def test_spills_once_and_rereads_identically(self, tmp_path):
+        calls = {"n": 0}
+
+        def expensive(batch):
+            if batch.num_rows:  # zero-row schema probes are free
+                calls["n"] += 1
+            return batch.append_column(
+                "y", pa.array(np.asarray(batch.column("x")) * 2.0))
+
+        df = DataFrame.from_table(
+            pa.table({"x": np.arange(12.0)}), 3).map_batches(expensive)
+        cached = df.cache_to_disk(str(tmp_path / "spill"))
+        first = cached.collect()
+        assert calls["n"] == 3  # one plan run per partition
+        second = cached.collect()
+        assert calls["n"] == 3  # later passes stream the Arrow files
+        assert first.equals(second)
+        assert second.column("y").to_pylist() == \
+            list(np.arange(12.0) * 2.0)
+
+    def test_preserves_partition_identity_for_shuffles(self, tmp_path):
+        df = DataFrame.from_table(pa.table({"x": np.arange(9.0)}), 3)
+        cached = df.cache_to_disk(str(tmp_path / "spill"))
+        cached.collect()  # spill
+        reordered = cached.with_partition_order([2, 0, 1])
+        got = reordered.collect().column("x").to_pylist()
+        assert got == [6.0, 7.0, 8.0, 0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_schema_probe_does_not_spill(self, tmp_path):
+        """.columns / union schema checks must come from the underlying
+        frame's zero-row probe, not a full decode+spill of partition 0."""
+        calls = {"n": 0}
+
+        def expensive(batch):
+            if batch.num_rows:  # zero-row probes are free
+                calls["n"] += 1
+            return batch
+
+        df = DataFrame.from_table(
+            pa.table({"x": np.arange(6.0)}), 2).map_batches(expensive)
+        cached = df.cache_to_disk(str(tmp_path / "spill"))
+        assert cached.columns == ["x"]
+        assert calls["n"] == 0  # schema answered without materializing
+
+    def test_tensor_columns_round_trip(self, tmp_path):
+        X = np.arange(24, dtype=np.float32).reshape(6, 4)
+        batch = pa.RecordBatch.from_pylist(
+            [{"i": int(i)} for i in range(6)])
+        batch = append_tensor_column(batch, "t", X)
+        df = DataFrame.from_batches([batch])
+        cached = df.cache_to_disk(str(tmp_path / "spill"))
+        cached.collect()
+        np.testing.assert_array_equal(cached.tensor("t"), X)
+
+
 class TestFrameUsability:
     def _df(self, n=20, parts=4):
         return DataFrame.from_pylist(
