@@ -12,6 +12,16 @@ Reports, in ONE JSON line (driver contract):
 * ``link_h2d_MBps`` / ``link_d2h_MBps`` — measured host↔device
   bandwidth, and ``host_fed_ceiling_ips`` — the hard upper bound the
   link imposes on ANY host-fed pipeline (bandwidth ÷ bytes/image).
+* ``value_packed`` — end-to-end with the byte-shrunk payload
+  (VERDICT r2 next #3): the host packs uint8 at a smaller source size
+  (``packed_src_hw``) and bilinear resize to 299² runs ON DEVICE,
+  fused into the same XLA program (``deviceResizeFrom`` mode) — the
+  wire carries ~4× fewer bytes/image, lifting the link ceiling
+  (``host_fed_ceiling_ips_packed``) in proportion.
+* ``host_decode_ips`` — the fused decode→resize→pack reader
+  (``readImagesPacked``, native libjpeg+OpenMP shim) measured on
+  synthesized JPEGs: proof the host decode stage outruns the device
+  featurize rate budgeted in SURVEY §6.
 
 Separating these is the point (round-1 lesson): on a tunneled TPU the
 link moves ~10-35 MB/s, capping end-to-end at ~40-134 img/s regardless
@@ -56,6 +66,37 @@ def _probe_accelerator(timeout_s: int = 180) -> bool:
         return proc.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def measure_host_decode(size=(299, 299), n_images: int = 64,
+                        src_hw=(375, 500)) -> float:
+    """images/sec through the fused decode→resize→pack reader on
+    synthesized JPEGs (tf_flowers-like source dims), best of 2 passes
+    (pass 1 also warms the page cache and builds the shim)."""
+    import os
+    import shutil
+    import tempfile
+
+    from PIL import Image
+
+    from sparkdl_tpu.image import imageIO
+
+    d = tempfile.mkdtemp(prefix="sparkdl_bench_decode_")
+    try:
+        rng = np.random.default_rng(7)
+        for i in range(n_images):
+            arr = rng.integers(0, 255, size=src_hw + (3,), dtype=np.uint8)
+            Image.fromarray(arr, "RGB").save(
+                os.path.join(d, f"i{i:03d}.jpg"), quality=90)
+        df = imageIO.readImagesPacked(d, size, numPartitions=4)
+        rates = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            table = df.collect()
+            rates.append(table.num_rows / (time.perf_counter() - t0))
+        return float(max(rates))
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def main() -> None:
@@ -116,8 +157,32 @@ def main() -> None:
         rates.append(n_rows / elapsed)
     e2e_ips = float(np.median(rates))
 
+    # packed path: ship small uint8, resize on device (fused). The only
+    # in-env lever on the link-bound headline — bytes/image shrinks
+    # (150²/299²≈¼) so the ceiling and the measured value lift together.
+    from sparkdl_tpu.transformers.utils import deviceResizeModel
+    packed_src = (150, 150)
+    runner_packed = BatchRunner(deviceResizeModel(mf, packed_src),
+                                batch_size=batch_size)
+    images_small = rng.integers(
+        0, 255, size=(n_rows,) + packed_src + (3,), dtype=np.uint8)
+    runner_packed.run({"image": images_small[:batch_size]})  # warmup
+    rates_packed = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = runner_packed.run({"image": images_small})
+        elapsed = time.perf_counter() - t0
+        assert out["features"].shape == (n_rows, 2048)
+        rates_packed.append(n_rows / elapsed)
+    packed_ips = float(np.median(rates_packed))
+
+    host_decode_ips = measure_host_decode(
+        n_images=64 if on_tpu else 24)
+
     image_mb = 299 * 299 * 3 / (1024.0 * 1024.0)  # uint8 NHWC on the wire
+    packed_mb = packed_src[0] * packed_src[1] * 3 / (1024.0 * 1024.0)
     ceiling = link["h2d_MBps"] / image_mb
+    ceiling_packed = link["h2d_MBps"] / packed_mb
     print(json.dumps({
         "metric": (f"images_per_sec_per_chip_inceptionv3_featurize"
                    f"[{platform}]"),
@@ -132,10 +197,18 @@ def main() -> None:
         "link_h2d_MBps": link["h2d_MBps"],
         "link_d2h_MBps": link["d2h_MBps"],
         "host_fed_ceiling_ips": round(ceiling, 1),
+        "value_packed": round(packed_ips, 1),
+        "vs_baseline_packed": round(packed_ips / PER_CHIP_TARGET, 3),
+        "packed_src_hw": list(packed_src),
+        "host_fed_ceiling_ips_packed": round(ceiling_packed, 1),
+        "host_decode_ips": round(host_decode_ips, 1),
         "runner_strategy": runner.strategy,
         "note": ("end-to-end is host-link-bound when value ~= "
-                 "host_fed_ceiling_ips; device_resident_ips is the "
-                 "chip's compute capability with transfers excluded"),
+                 "host_fed_ceiling_ips; value_packed ships "
+                 "device-resized small uint8 (~4x fewer bytes/image); "
+                 "device_resident_ips is the chip's compute capability "
+                 "with transfers excluded; host_decode_ips is the fused "
+                 "JPEG decode-resize-pack reader"),
     }))
 
 
