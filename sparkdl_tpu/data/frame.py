@@ -424,10 +424,37 @@ class DataFrame:
         the spilled stages run inside ``Source.load`` so StageMetrics
         does not time them (the trade for running them at most once).
         Each executing machine spills to ITS OWN ``directory`` — on a
-        distributed engine the cache is per-machine, not shared."""
+        distributed engine the cache is per-machine, not shared.
+
+        A populated ``directory`` is only reused when its manifest
+        matches this frame (schema + partition count) — a warm cache
+        from an identical earlier run is served; anything else raises
+        rather than silently returning another frame's rows."""
+        import json
+
         os.makedirs(directory, exist_ok=True)
         plan = list(self._plan)
         preserving = all(st.row_preserving for st in plan)
+        manifest_path = os.path.join(directory, "_manifest.json")
+        manifest = {"schema": self.schema.to_string(),
+                    "num_partitions": len(self._sources)}
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                existing = json.load(f)
+            if existing != manifest:
+                raise ValueError(
+                    f"cache directory {directory!r} holds a spill of a "
+                    "DIFFERENT frame (schema or partition count "
+                    "mismatch); use a fresh directory")
+        elif os.listdir(directory):
+            raise ValueError(
+                f"cache directory {directory!r} is not empty and has "
+                "no spill manifest; use a fresh directory")
+        else:
+            tmp = f"{manifest_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, manifest_path)
 
         def make(i: int, src: Source) -> Source:
             logical = (src.logical_index

@@ -690,7 +690,33 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             # shard dry, quota unmet: stream it again (re-decode)
 
     def _trainStreaming(self, dataset, paramMap: dict,
-                        checkpoint_tag: str = "fit") -> KerasImageFileModel:
+                        checkpoint_tag: str = "fit",
+                        spill_dir: Optional[str] = None
+                        ) -> KerasImageFileModel:
+        """Entry for one streaming trial: resolves the effective
+        estimator and owns the decoded-spill directory's lifetime
+        (created here when ``cacheDecoded`` and none was passed, removed
+        on ANY exit — early validation failures included). A caller
+        passing ``spill_dir`` (fitMultiple's shared trial cache) keeps
+        ownership."""
+        est = self.copy(paramMap) if paramMap else self
+        if not est.getOrDefault("cacheDecoded"):
+            spill_dir = None  # a trial override can disable the cache
+        own_dir = None
+        if spill_dir is None and est.getOrDefault("cacheDecoded"):
+            import tempfile
+            own_dir = spill_dir = tempfile.mkdtemp(
+                prefix="sparkdl_tpu_decoded_")
+        try:
+            return self._trainStreamingImpl(dataset, est, spill_dir,
+                                            checkpoint_tag)
+        finally:
+            if own_dir is not None:
+                import shutil
+                shutil.rmtree(own_dir, ignore_errors=True)
+
+    def _trainStreamingImpl(self, dataset, est, spill_dir: Optional[str],
+                            checkpoint_tag: str) -> KerasImageFileModel:
         """Train one configuration by streaming decoded partitions
         through the engine — no driver-memory materialization of the
         image tensor (the reference's hard boundary, SURVEY §3.4: the
@@ -711,7 +737,6 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         """
         import jax
 
-        est = self.copy(paramMap) if paramMap else self
         est._validateParams()
         fit_params = est.getKerasFitParams()
         epochs = int(fit_params.get("epochs", 1))
@@ -742,14 +767,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         loaded = est.loadImagesInternal(base, in_col, _LOADED_COL)
         loaded_local = (dist.host_shard_dataframe(loaded) if multihost
                         else loaded)
-        spill_dir = None
-        if est.getOrDefault("cacheDecoded"):
+        if spill_dir is not None:
             # epoch 1 decodes and spills THIS host's shard to Arrow
             # files; later epochs stream the cache — decode runs once
-            # per fit, not once per epoch (VERDICT r2 weak #5). The
-            # spill is a per-fit temp dir, deleted when training ends.
-            import tempfile
-            spill_dir = tempfile.mkdtemp(prefix="sparkdl_tpu_decoded_")
+            # per fit, not once per epoch (VERDICT r2 weak #5). Dir
+            # lifetime is owned by _trainStreaming / fitMultiple.
             loaded_local = loaded_local.cache_to_disk(spill_dir)
 
         # cheap manifest (strings + labels): sizing + fingerprint —
@@ -878,38 +900,32 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         epoch_seeds = [int(s) for s in
                        rng.integers(0, 2**63 - 1, size=epochs)]
 
-        try:
-            for epoch in range(start_epoch, epochs):
-                losses = []
-                for xb, yb in self._epoch_stream(
-                        loaded_local, label_col, rows_per_step, n_out,
-                        est.getKerasLoss(), epoch_seeds[epoch], shuffle,
-                        num_steps=steps_per_epoch):
-                    gx, gy = place(xb, yb)
-                    trainable, non_trainable, opt_state, loss = jitted(
-                        trainable, non_trainable, opt_state, gx, gy)
-                    losses.append(loss)
-                history.append(float(np.mean(jax.device_get(losses))))
-                if checkpointer is not None:
-                    # live arrays, not device_get copies: jax arrays are
-                    # immutable and the step doesn't donate, so the
-                    # async save reads them safely — and multi-host
-                    # orbax needs the global arrays to run its
-                    # every-host-participates write protocol (a
-                    # host-local numpy copy would not carry the global
-                    # sharding)
-                    checkpointer.save(
-                        len(history),
-                        {"trainable": trainable,
-                         "non_trainable": non_trainable,
-                         "opt_state": opt_state,
-                         "history": np.asarray(history, np.float64)})
+        for epoch in range(start_epoch, epochs):
+            losses = []
+            for xb, yb in self._epoch_stream(
+                    loaded_local, label_col, rows_per_step, n_out,
+                    est.getKerasLoss(), epoch_seeds[epoch], shuffle,
+                    num_steps=steps_per_epoch):
+                gx, gy = place(xb, yb)
+                trainable, non_trainable, opt_state, loss = jitted(
+                    trainable, non_trainable, opt_state, gx, gy)
+                losses.append(loss)
+            history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
-                checkpointer.close()
-        finally:
-            if spill_dir is not None:
-                import shutil
-                shutil.rmtree(spill_dir, ignore_errors=True)
+                # live arrays, not device_get copies: jax arrays are
+                # immutable and the step doesn't donate, so the async
+                # save reads them safely — and multi-host orbax needs
+                # the global arrays to run its every-host-participates
+                # write protocol (a host-local numpy copy would not
+                # carry the global sharding)
+                checkpointer.save(
+                    len(history),
+                    {"trainable": trainable,
+                     "non_trainable": non_trainable,
+                     "opt_state": opt_state,
+                     "history": np.asarray(history, np.float64)})
+        if checkpointer is not None:
+            checkpointer.close()
 
         trained = {
             "trainable": jax.device_get(trainable),
@@ -971,22 +987,46 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     parallelism)
                 parallelism = 1
 
+        # one decoded-spill cache SHARED by every trial that keeps the
+        # data params — the cache depends only on (inputCol, labelCol,
+        # imageLoader), so per-trial caches would re-decode the dataset
+        # k times, exactly the cost cacheDecoded exists to remove.
+        # Concurrent trials spilling the same partition are safe:
+        # unique tmp + atomic rename, deterministic decode.
+        shared_spill = None
+        if streaming and self.getOrDefault("cacheDecoded"):
+            import tempfile
+            shared_spill = tempfile.mkdtemp(
+                prefix="sparkdl_tpu_decoded_shared_")
+
         def trial(i, pm):
             if streaming:
+                names = {p.name if isinstance(p, Param) else str(p)
+                         for p in pm}
+                use_shared = (shared_spill
+                              if not (names & self._DATA_PARAMS)
+                              else None)
                 return self._trainStreaming(dataset, pm,
-                                            checkpoint_tag=f"trial_{i}")
+                                            checkpoint_tag=f"trial_{i}",
+                                            spill_dir=use_shared)
             X, y = self._trialData(dataset, pm, shared)
             return self._trainOne(X, y, pm, checkpoint_tag=f"trial_{i}")
 
-        if parallelism == 1 or len(paramMaps) <= 1:
-            for i, pm in enumerate(paramMaps):
-                yield i, trial(i, pm)
-            return
+        try:
+            if parallelism == 1 or len(paramMaps) <= 1:
+                for i, pm in enumerate(paramMaps):
+                    yield i, trial(i, pm)
+                return
 
-        with ThreadPoolExecutor(max_workers=parallelism,
-                                thread_name_prefix="sparkdl-tpu-trial") as ex:
-            futs = {ex.submit(trial, i, pm): i
-                    for i, pm in enumerate(paramMaps)}
-            from concurrent.futures import as_completed
-            for fut in as_completed(futs):
-                yield futs[fut], fut.result()
+            with ThreadPoolExecutor(
+                    max_workers=parallelism,
+                    thread_name_prefix="sparkdl-tpu-trial") as ex:
+                futs = {ex.submit(trial, i, pm): i
+                        for i, pm in enumerate(paramMaps)}
+                from concurrent.futures import as_completed
+                for fut in as_completed(futs):
+                    yield futs[fut], fut.result()
+        finally:
+            if shared_spill is not None:
+                import shutil
+                shutil.rmtree(shared_spill, ignore_errors=True)

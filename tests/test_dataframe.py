@@ -250,6 +250,36 @@ class TestCacheToDisk:
         got = reordered.collect().column("x").to_pylist()
         assert got == [6.0, 7.0, 8.0, 0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
 
+    def test_rejects_foreign_or_unmanifested_directory(self, tmp_path):
+        """A populated cache dir is reused only when its manifest
+        matches this frame — never silently serving another frame's
+        spilled rows."""
+        d = str(tmp_path / "spill")
+        df1 = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 2)
+        df1.cache_to_disk(d).collect()
+
+        # same schema + partitions → warm reuse is allowed
+        again = DataFrame.from_table(
+            pa.table({"x": np.arange(6.0)}), 2).cache_to_disk(d)
+        assert again.collect().column("x").to_pylist() == \
+            list(np.arange(6.0))
+
+        # different schema → refuse
+        df2 = DataFrame.from_table(pa.table({"y": np.arange(6.0)}), 2)
+        with pytest.raises(ValueError, match="DIFFERENT frame"):
+            df2.cache_to_disk(d)
+        # different partition count → refuse
+        df3 = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 3)
+        with pytest.raises(ValueError, match="DIFFERENT frame"):
+            df3.cache_to_disk(d)
+
+        # non-empty dir without a manifest → refuse
+        stray = tmp_path / "stray"
+        stray.mkdir()
+        (stray / "junk.bin").write_bytes(b"x")
+        with pytest.raises(ValueError, match="no spill manifest"):
+            df1.cache_to_disk(str(stray))
+
     def test_schema_probe_does_not_spill(self, tmp_path):
         """.columns / union schema checks must come from the underlying
         frame's zero-row probe, not a full decode+spill of partition 0."""

@@ -148,7 +148,9 @@ class TestKerasImageFileEstimator:
 
     def test_cache_decoded_spill_dir_removed(self, keras_cls_file,
                                              uri_label_df, monkeypatch):
-        """The per-fit spill directory is deleted when training ends."""
+        """The per-fit spill directory is deleted when training ends —
+        on success AND when the fit fails before the epoch loop."""
+        import os
         import tempfile
         made = []
         orig = tempfile.mkdtemp
@@ -165,8 +167,52 @@ class TestKerasImageFileEstimator:
         make_estimator(keras_cls_file, kerasFitParams=fit_params,
                        streaming=True,
                        cacheDecoded=True).fit(uri_label_df)
-        import os
         assert made and not any(os.path.exists(d) for d in made)
+
+        # early-failure path: empty dataset raises before any epoch —
+        # the spill dir must still be cleaned up (review r3 finding)
+        made.clear()
+        import pyarrow as pa
+
+        from sparkdl_tpu.data import DataFrame
+        empty = DataFrame.from_table(
+            pa.table({"uri": pa.array([], type=pa.string()),
+                      "label": pa.array([], type=pa.int64())}), 1)
+        est = make_estimator(keras_cls_file, kerasFitParams=fit_params,
+                             streaming=True, cacheDecoded=True)
+        with pytest.raises(ValueError, match="empty"):
+            est.fit(empty)
+        assert made and not any(os.path.exists(d) for d in made)
+
+    def test_cache_decoded_shared_across_trials(self, keras_cls_file,
+                                                uri_label_df):
+        """fitMultiple's trials share ONE decoded spill cache when the
+        paramMaps leave the data params untouched — k trials decode the
+        dataset once, not k times."""
+        calls = {"n": 0}
+
+        def counting_loader(uri):
+            calls["n"] += 1
+            return loader(uri)
+
+        n_img = uri_label_df.count()
+        est = make_estimator(
+            keras_cls_file, imageLoader=counting_loader, streaming=True,
+            cacheDecoded=True, parallelism=1,
+            kerasFitParams={"epochs": 2, "batch_size": 8,
+                            "learning_rate": 0.05, "shuffle": False,
+                            "seed": 1})
+        grid = [
+            {est.getParam("kerasFitParams"):
+             {"epochs": 2, "batch_size": 8, "learning_rate": 0.01,
+              "shuffle": False, "seed": 1}},
+            {est.getParam("kerasFitParams"):
+             {"epochs": 2, "batch_size": 8, "learning_rate": 0.05,
+              "shuffle": False, "seed": 1}},
+        ]
+        got = dict(est.fitMultiple(uri_label_df, grid))
+        assert set(got) == {0, 1}
+        assert calls["n"] == n_img  # one decode pass for BOTH trials
 
     def test_streaming_matches_inmemory_exactly(self, keras_cls_file,
                                                 uri_label_df):
