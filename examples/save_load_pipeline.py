@@ -1,0 +1,80 @@
+"""Fit a pipeline, save it, reload it, serve identical predictions.
+
+The reference era got ``Pipeline.save``/``load`` from pyspark ML;
+sparkdl_tpu's native counterpart persists any stage — fitted or not —
+to a directory (params as JSON, trained ModelFunctions as StableHLO
+with weights baked in, child stages as nested saves) and
+``sparkdl_tpu.load_model`` rebuilds it, including in a fresh process.
+
+Run:  python examples/save_load_pipeline.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+from PIL import Image
+
+import sparkdl_tpu
+
+
+def synthesize_dataset(n=16):
+    d = tempfile.mkdtemp(prefix="sparkdl_tpu_sl_")
+    rng = np.random.default_rng(4)
+    rows = []
+    for i in range(n):
+        label = i % 2
+        base = 60 if label == 0 else 190
+        arr = np.clip(rng.normal(base, 25, (32, 32, 3)), 0,
+                      255).astype(np.uint8)
+        # zero-padded names: readImages globs in sorted order, so the
+        # positional label list below stays aligned for any n
+        Image.fromarray(arr, "RGB").save(os.path.join(d, f"i{i:04d}.png"))
+        rows.append(label)
+    return d, rows
+
+
+def main():
+    import pyarrow as pa
+
+    from sparkdl_tpu.data import DataFrame
+
+    data_dir, labels = synthesize_dataset()
+    table = sparkdl_tpu.readImages(data_dir, numPartitions=2).collect()
+    labeled = DataFrame.from_table(
+        table.append_column("label", pa.array(labels, type=pa.int64())),
+        num_partitions=2)
+
+    pipeline = sparkdl_tpu.Pipeline(stages=[
+        sparkdl_tpu.DeepImageFeaturizer(modelName="TestNet",
+                                        inputCol="image",
+                                        outputCol="features"),
+        sparkdl_tpu.LogisticRegression(featuresCol="features",
+                                       labelCol="label", maxIter=60,
+                                       learningRate=0.2),
+    ])
+    fitted = pipeline.fit(labeled)
+
+    save_dir = os.path.join(tempfile.mkdtemp(prefix="sparkdl_tpu_sl_"),
+                            "model")
+    fitted.save(save_dir)
+    reloaded = sparkdl_tpu.load_model(save_dir)
+
+    a = fitted.transform(labeled).tensor("probability")
+    b = reloaded.transform(labeled).tensor("probability")
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    # the UNFITTED pipeline saves too (its stages nest as child saves)
+    est_dir = os.path.join(tempfile.mkdtemp(prefix="sparkdl_tpu_sl_"),
+                           "estimator")
+    pipeline.save(est_dir)
+    refit = sparkdl_tpu.load_model(est_dir).fit(labeled)
+    c = refit.transform(labeled).tensor("probability")
+    np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
+    print(f"saved → loaded → identical predictions "
+          f"({a.shape[0]} rows); unfitted pipeline round-trips too")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,8 @@ _EXPORTS = {
     "DataFrame": ("sparkdl_tpu.data.frame", "DataFrame"),
     "Pipeline": ("sparkdl_tpu.params.pipeline", "Pipeline"),
     "CrossValidator": ("sparkdl_tpu.params.tuning", "CrossValidator"),
+    "TrainValidationSplit": ("sparkdl_tpu.params.tuning",
+                             "TrainValidationSplit"),
     "ParamGridBuilder": ("sparkdl_tpu.params.tuning", "ParamGridBuilder"),
     "ClassificationEvaluator": ("sparkdl_tpu.estimators.evaluators",
                                 "ClassificationEvaluator"),

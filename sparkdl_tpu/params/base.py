@@ -12,7 +12,6 @@ from __future__ import annotations
 import copy
 import functools
 import inspect
-import threading
 from typing import Any, Callable, Dict, Iterable, Optional
 
 
@@ -72,9 +71,12 @@ class Params:
     """
 
     def __init__(self):
+        # no lock: param maps are written at construction / explicit
+        # set() and read afterwards; keeping instances lock-free also
+        # keeps every stage picklable (Spark task shipping, the
+        # persistence layer's pickle codec for estimator-valued params)
         self._paramMap: Dict[Param, Any] = {}
         self._defaultParamMap: Dict[Param, Any] = {}
-        self._params_lock = threading.RLock()
         uid_cls = type(self).__name__
         self.uid = f"{uid_cls}_{id(self):x}"
 

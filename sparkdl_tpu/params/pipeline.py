@@ -56,6 +56,22 @@ class Model(Transformer):
     """A Transformer produced by an Estimator."""
 
 
+def _stages_as_children(stages):
+    """Stage list → persistence child map (shared by Pipeline and
+    PipelineModel; sorted keys are the reload order)."""
+    return {f"stage_{i:04d}_{type(s).__name__}": s
+            for i, s in enumerate(stages)}
+
+
+def _stages_from_saved(params, children):
+    """Reload order from child saves; falls back to a ``stages`` param
+    value for artifacts saved before stages nested as children (the
+    early save layout pickled the list into params)."""
+    if children:
+        return [children[k] for k in sorted(children)]
+    return list(params.get("stages") or [])
+
+
 class PipelineModel(Model):
     """Sequentially applies fitted stages."""
 
@@ -73,12 +89,11 @@ class PipelineModel(Model):
         return that
 
     def _child_stages(self):
-        return {f"stage_{i:04d}_{type(s).__name__}": s
-                for i, s in enumerate(self.stages)}
+        return _stages_as_children(self.stages)
 
     @classmethod
     def _from_saved(cls, params, extra, children):
-        return cls([children[k] for k in sorted(children)])
+        return cls(_stages_from_saved(params, children))
 
 
 class Pipeline(Estimator):
@@ -97,6 +112,16 @@ class Pipeline(Estimator):
 
     def getStages(self) -> List[Params]:
         return self.getOrDefault("stages")
+
+    def _unsaved_param_names(self):
+        return {"stages"}  # persisted as child stages, not a pickle
+
+    def _child_stages(self):
+        return _stages_as_children(self.getStages())
+
+    @classmethod
+    def _from_saved(cls, params, extra, children):
+        return cls(stages=_stages_from_saved(params, children))
 
     def _fit(self, dataset) -> PipelineModel:
         stages = self.getStages()

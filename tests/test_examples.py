@@ -13,6 +13,7 @@ import pytest
     "examples/multi_chip.py",
     "examples/fast_infeed.py",
     "examples/export_deploy.py",
+    "examples/save_load_pipeline.py",
 ])
 def test_example_runs(script, capsys):
     runpy.run_path(script, run_name="__main__")
