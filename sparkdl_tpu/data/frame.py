@@ -181,6 +181,85 @@ class DataFrame:
         sources = [Source((lambda b=b: b), b.num_rows) for b in batches]
         return DataFrame(sources, engine=engine)
 
+    @staticmethod
+    def read_parquet(path: str, engine=None) -> "DataFrame":
+        """Lazy frame over a parquet directory written by
+        :meth:`write_parquet` (or any directory of part files): one
+        partition per file, loaded on demand; row counts come from
+        parquet footers so ``count()`` never reads data. Tensor-column
+        shape metadata survives the round-trip (Arrow schema is stored
+        in the parquet file)."""
+        import glob
+
+        import pyarrow.parquet as pq
+
+        if os.path.isdir(path):
+            files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        else:
+            files = [path]
+        if not files:
+            raise FileNotFoundError(
+                f"no .parquet files under {path!r}")
+
+        schema = None
+
+        def make(f: str) -> Source:
+            nonlocal schema
+            pf = pq.ParquetFile(f)
+            if schema is None:
+                schema = pf.schema_arrow
+            num_rows = pf.metadata.num_rows
+
+            def _load(f=f) -> pa.RecordBatch:
+                table = pq.read_table(f).combine_chunks()
+                if table.num_rows == 0:
+                    return pa.RecordBatch.from_pylist(
+                        [], schema=table.schema)
+                return table.to_batches()[0]
+
+            return Source(_load, num_rows)
+
+        out = DataFrame([make(f) for f in files], engine=engine)
+        # schema from the footer already parsed for num_rows — the
+        # default zero-row probe would read and decode a whole part
+        # file to answer .columns
+        out._schema = schema
+        return out
+
+    def write_parquet(self, path: str) -> str:
+        """Materialize the plan and write one parquet part file per
+        partition under ``path`` (Spark's ``df.write.parquet`` shape),
+        STREAMING — one partition's result is in memory at a time, so
+        featurized output larger than RAM still writes. Refuses a
+        directory already holding part files. Returns ``path``."""
+        import glob
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        if glob.glob(os.path.join(path, "*.parquet")):
+            raise FileExistsError(
+                f"{path!r} already holds parquet part files; write to "
+                "a fresh directory (overwrite is never implicit)")
+        # Spark-committer shape: stage every part into a temp subdir and
+        # rename into place only after the whole stream succeeds — a
+        # crash mid-stream must not leave a partial dataset that
+        # read_parquet would silently serve as complete.
+        import shutil
+        tmp_dir = os.path.join(path, f"_tmp.{os.getpid()}")
+        os.makedirs(tmp_dir)
+        try:
+            staged = []
+            for i, batch in enumerate(self.stream()):
+                f = os.path.join(tmp_dir, f"part-{i:05d}.parquet")
+                pq.write_table(pa.Table.from_batches([batch]), f)
+                staged.append(f)
+            for f in staged:
+                os.replace(f, os.path.join(path, os.path.basename(f)))
+        finally:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+        return path
+
     # -- plan building ------------------------------------------------------
 
     def map_batches(self, fn: Callable[..., pa.RecordBatch],

@@ -27,21 +27,36 @@ def _collect_pred_and_labels(dataset, predictionCol: str, labelCol: str):
     return preds, labels
 
 
+_CLS_METRICS = ("accuracy", "f1", "weightedPrecision", "weightedRecall")
+
+
 class ClassificationEvaluator(Evaluator):
-    """Accuracy of argmax(prediction vector) vs an integer (or one-hot)
-    label column. Larger is better."""
+    """Scores argmax(prediction vector) — or a class-label column — vs
+    an integer (or one-hot) label column. ``metricName`` follows
+    pyspark's MulticlassClassificationEvaluator: ``accuracy`` (default),
+    ``f1`` / ``weightedPrecision`` / ``weightedRecall`` (per-class
+    values weighted by true-class support). Larger is better."""
 
     predictionCol = Param("ClassificationEvaluator", "predictionCol",
                           "prediction vector column",
                           TypeConverters.toString)
     labelCol = Param("ClassificationEvaluator", "labelCol", "label column",
                      TypeConverters.toString)
+    metricName = Param("ClassificationEvaluator", "metricName",
+                       f"one of {_CLS_METRICS}", TypeConverters.toString)
 
     @keyword_only
-    def __init__(self, *, predictionCol="prediction", labelCol="label"):
+    def __init__(self, *, predictionCol="prediction", labelCol="label",
+                 metricName="accuracy"):
         super().__init__()
-        self._setDefault(predictionCol="prediction", labelCol="label")
-        self._set(predictionCol=predictionCol, labelCol=labelCol)
+        self._setDefault(predictionCol="prediction", labelCol="label",
+                         metricName="accuracy")
+        self._set(predictionCol=predictionCol, labelCol=labelCol,
+                  metricName=metricName)
+        if self.getOrDefault("metricName") not in _CLS_METRICS:
+            raise ValueError(
+                f"metricName must be one of {_CLS_METRICS}, got "
+                f"{metricName!r}")
 
     def evaluate(self, dataset) -> float:
         preds, labels = _collect_pred_and_labels(
@@ -49,18 +64,57 @@ class ClassificationEvaluator(Evaluator):
             self.getOrDefault("labelCol"))
         if labels.ndim > 1:  # one-hot labels
             labels = labels.argmax(-1)
+        labels = labels.astype(np.int64)
         if preds.ndim > 1 and preds.shape[-1] == 1:
             preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
         if preds.ndim == 1:
             if np.all(preds == np.round(preds)):
                 # integral values: already class labels (e.g.
                 # LogisticRegressionModel's predictionCol)
-                hit = preds.astype(np.int64) == labels
+                pred_ids = preds.astype(np.int64)
             else:
-                hit = (preds > 0.5).astype(np.int64) == labels
+                pred_ids = (preds > 0.5).astype(np.int64)
         else:
-            hit = preds.argmax(-1) == labels
-        return float(np.mean(hit))
+            pred_ids = preds.argmax(-1)
+        metric = self.getOrDefault("metricName")
+        if metric not in _CLS_METRICS:
+            # re-validate here too: set()/copy(extra) bypass __init__,
+            # and _weighted_prf's dispatch must never silently treat an
+            # unknown name as f1
+            raise ValueError(
+                f"metricName must be one of {_CLS_METRICS}, got "
+                f"{metric!r}")
+        if metric == "accuracy":
+            return float(np.mean(pred_ids == labels))
+        return _weighted_prf(pred_ids, labels, metric)
+
+
+def _weighted_prf(pred_ids: np.ndarray, labels: np.ndarray,
+                  metric: str) -> float:
+    """Support-weighted precision / recall / f1 over the classes present
+    in the labels (pyspark MulticlassClassificationEvaluator semantics:
+    each class's metric weighted by its true count; a class never
+    predicted contributes precision 0)."""
+    total = len(labels)
+    if total == 0:
+        return 0.0
+    out = 0.0
+    for c in np.unique(labels):
+        tp = float(np.sum((pred_ids == c) & (labels == c)))
+        fp = float(np.sum((pred_ids == c) & (labels != c)))
+        fn = float(np.sum((pred_ids != c) & (labels == c)))
+        support = tp + fn
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / support if support else 0.0
+        if metric == "weightedPrecision":
+            value = precision
+        elif metric == "weightedRecall":
+            value = recall
+        else:  # f1
+            value = (2 * precision * recall / (precision + recall)
+                     if precision + recall else 0.0)
+        out += value * support / total
+    return float(out)
 
 
 class LossEvaluator(Evaluator):

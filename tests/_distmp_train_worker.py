@@ -51,14 +51,14 @@ def main() -> None:
         return np.asarray(Image.open(uri).convert("RGB"),
                           dtype=np.float32) / 255.0
 
-    def make_est(epochs, checkpointDir=None):
+    def make_est(epochs, checkpointDir=None, cacheDecoded=False):
         kw = dict(
             inputCol="uri", outputCol="pred", labelCol="label",
             imageLoader=loader, modelFile=model_file,
             kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
             kerasFitParams={"epochs": epochs, "batch_size": 8,
                             "learning_rate": 0.05, "seed": 3},
-            streaming=True, useMesh=True)
+            streaming=True, useMesh=True, cacheDecoded=cacheDecoded)
         if checkpointDir:
             kw["checkpointDir"] = checkpointDir
         return KerasImageFileEstimator(**kw)
@@ -77,6 +77,14 @@ def main() -> None:
         "weight_digest": digest_of(model),
         "local_partitions": dist.host_shard_dataframe(df).num_partitions,
     }
+
+    if not ckpt_dir:
+        # cacheDecoded in the multi-host path: each host spills only
+        # ITS shard; epoch 2 streams the cache. Must land on the exact
+        # same replicated state as the uncached fit above.
+        cached = make_est(epochs=2, cacheDecoded=True).fit(df)
+        result["cached_history"] = cached.history
+        result["cached_digest"] = digest_of(cached)
 
     if ckpt_dir:
         # interrupted: 1 epoch saved, then the same config extended to

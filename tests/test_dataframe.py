@@ -221,6 +221,100 @@ class TestEngine:
         np.testing.assert_array_equal(xs, np.arange(50))
 
 
+class TestParquetIO:
+    def test_round_trip_with_tensor_columns(self, tmp_path):
+        X = np.arange(40, dtype=np.float32).reshape(10, 4)
+        batch = pa.RecordBatch.from_pylist(
+            [{"i": int(i)} for i in range(10)])
+        batch = append_tensor_column(batch, "feat", X)
+        df = DataFrame.from_batches([batch, batch])
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+
+        back = DataFrame.read_parquet(out)
+        assert back.num_partitions == 2
+        assert back.columns == ["i", "feat"]
+        np.testing.assert_array_equal(back.tensor("feat"),
+                                      np.concatenate([X, X]))
+        # shape metadata survived (multi-dim reshaping still works)
+        assert tensor_shape_of(back.collect().schema.field("feat")) \
+            == (4,)
+
+    def test_count_reads_footers_not_data(self, tmp_path):
+        df = _df(100, 4)
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+        back = DataFrame.read_parquet(out)
+        assert back.count() == 100  # from parquet metadata (num_rows)
+
+    def test_image_struct_round_trip(self, tmp_path, image_dir):
+        from sparkdl_tpu.image import imageIO
+
+        df = imageIO.readImages(image_dir, numPartitions=2)
+        out = str(tmp_path / "imgs_pq")
+        df.write_parquet(out)
+        back = DataFrame.read_parquet(out)
+        a = df.collect()
+        b = back.collect()
+        assert a.column("filePath").to_pylist() == \
+            b.column("filePath").to_pylist()
+        assert a.column("image").to_pylist() == \
+            b.column("image").to_pylist()
+
+    def test_no_silent_overwrite_and_missing_path(self, tmp_path):
+        df = _df(10, 2)
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+        with pytest.raises(FileExistsError, match="fresh"):
+            df.write_parquet(out)
+        with pytest.raises(FileNotFoundError):
+            DataFrame.read_parquet(str(tmp_path / "empty_dir"))
+
+    def test_failed_write_leaves_no_partial_dataset(self, tmp_path):
+        """A crash mid-stream must not leave part files a later
+        read_parquet would silently serve as a complete dataset — parts
+        stage in a temp subdir and only rename into place on success."""
+        import glob
+        import os
+
+        boom = {"n": 0}
+
+        def failing(batch):
+            boom["n"] += 1
+            if boom["n"] == 2:
+                raise RuntimeError("decode exploded on partition 2")
+            return batch
+
+        df = _df(30, 3).map_batches(failing)
+        out = str(tmp_path / "pq")
+        with pytest.raises(RuntimeError, match="exploded"):
+            df.write_parquet(out)
+        assert glob.glob(os.path.join(out, "*.parquet")) == []
+        assert not glob.glob(os.path.join(out, "_tmp*"))
+        # the directory is reusable after the failure
+        boom["n"] = -100
+        df.write_parquet(out)
+        assert DataFrame.read_parquet(out).count() == 30
+
+    def test_schema_from_footer_not_data(self, tmp_path):
+        """Reading .columns on a read_parquet frame must come from the
+        parquet footer, not a full read of part 0."""
+        df = _df(10, 2)
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+        import pyarrow.parquet as pq
+        orig = pq.read_table
+        reads = []
+        pq.read_table = lambda *a, **k: (reads.append(a),
+                                         orig(*a, **k))[1]
+        try:
+            back = DataFrame.read_parquet(out)
+            assert back.columns == ["x", "s"]
+        finally:
+            pq.read_table = orig
+        assert reads == []  # schema answered without touching data
+
+
 class TestCacheToDisk:
     def test_spills_once_and_rereads_identically(self, tmp_path):
         calls = {"n": 0}

@@ -155,6 +155,23 @@ def test_multihost_streaming_fit_identical_models(streaming_fit_results):
                                                rel=1e-6)
 
 
+def test_multihost_cache_decoded_matches_uncached(streaming_fit_results):
+    """cacheDecoded multi-host: each host spills only its shard and
+    later epochs stream the per-host cache — the replicated state must
+    end exactly where the uncached fit ends, on every host."""
+    _, results = streaming_fit_results
+    a, b = results
+    if "cached_history" not in a:
+        pytest.skip("cached scenario runs in the non-ckpt params")
+    for r in results:
+        assert r["cached_history"] == pytest.approx(r["history"],
+                                                    rel=1e-6)
+        assert r["cached_digest"] == pytest.approx(r["weight_digest"],
+                                                   rel=1e-6)
+    assert a["cached_digest"] == pytest.approx(b["cached_digest"],
+                                               rel=1e-6)
+
+
 def test_multihost_checkpoint_resume(streaming_fit_results):
     """Interrupted multi-host streaming training (1 epoch saved, budget
     extended to 2) must resume from the per-host checkpoints — resume

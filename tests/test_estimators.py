@@ -442,6 +442,43 @@ class TestEvaluators:
         assert ev.evaluate(self._df()) == pytest.approx(2.0 / 3.0)
         assert ev.isLargerBetter()
 
+    def test_weighted_metrics_match_hand_computation(self):
+        """metricName f1 / weightedPrecision / weightedRecall follow
+        pyspark MulticlassClassificationEvaluator semantics: per-class
+        values weighted by true-class support."""
+        import pyarrow as pa
+
+        # labels: 0,0,0,1,1,2 — preds: 0,0,1,1,2,2
+        labels = [0, 0, 0, 1, 1, 2]
+        pred = [0.0, 0.0, 1.0, 1.0, 2.0, 2.0]
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": l, "prediction": p}
+             for l, p in zip(labels, pred)])
+        df = DataFrame.from_batches([batch])
+
+        # class 0: tp=2 fp=0 fn=1 → P=1, R=2/3, F1=0.8 (support 3)
+        # class 1: tp=1 fp=1 fn=1 → P=.5, R=.5, F1=.5 (support 2)
+        # class 2: tp=1 fp=1 fn=0 → P=.5, R=1, F1=2/3 (support 1)
+        exp = {
+            "accuracy": 4 / 6,
+            "weightedPrecision": (1.0 * 3 + 0.5 * 2 + 0.5 * 1) / 6,
+            "weightedRecall": (2 / 3 * 3 + 0.5 * 2 + 1.0 * 1) / 6,
+            "f1": (0.8 * 3 + 0.5 * 2 + (2 / 3) * 1) / 6,
+        }
+        for name, want in exp.items():
+            ev = ClassificationEvaluator(predictionCol="prediction",
+                                         labelCol="label",
+                                         metricName=name)
+            assert ev.evaluate(df) == pytest.approx(want), name
+        with pytest.raises(ValueError, match="metricName"):
+            ClassificationEvaluator(metricName="bogus")
+        # set() bypasses __init__ validation — evaluate must re-check
+        ev = ClassificationEvaluator(predictionCol="prediction",
+                                     labelCol="label")
+        ev.set(ev.metricName, "precisionByLabel")
+        with pytest.raises(ValueError, match="metricName"):
+            ev.evaluate(df)
+
     def _binary_df(self):
         import pyarrow as pa
         from sparkdl_tpu.data.tensors import append_tensor_column

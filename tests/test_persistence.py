@@ -229,6 +229,36 @@ class TestTuningPersistence:
         np.testing.assert_array_equal(a, b)
 
 
+class TestEstimatorPersistence:
+    def test_configured_cross_validator_round_trip(self, tmp_path):
+        """An unfitted CrossValidator (estimator + grid + evaluator as
+        params) saves and reloads ready to fit — enabled by stages
+        being picklable."""
+        from sparkdl_tpu.estimators.evaluators import (
+            ClassificationEvaluator,
+        )
+        from sparkdl_tpu.params.tuning import CrossValidator
+
+        lr = LogisticRegression(maxIter=25, learningRate=0.2)
+        cv = CrossValidator(
+            estimator=lr,
+            estimatorParamMaps=[{lr.regParam: 0.0},
+                                {lr.regParam: 0.1}],
+            evaluator=ClassificationEvaluator(
+                predictionCol="prediction"),
+            numFolds=2, seed=5)
+        path = str(tmp_path / "cv_est")
+        cv.save(path)
+
+        back = sparkdl_tpu.load_model(path)
+        assert back.getOrDefault("numFolds") == 2
+        assert back.getOrDefault("seed") == 5
+        df, X, y = _feature_df()
+        model = back.fit(df)
+        probs = model.transform(df).tensor("probability")
+        assert np.mean(probs.argmax(-1) == y) >= 0.9
+
+
 class TestKerasModelPersistence:
     def test_keras_image_file_model_round_trip(self, tmp_path):
         """The fitted Keras model (trained weights inside a
