@@ -44,13 +44,10 @@ def main():
     # 1. read images (decode on host threads, lazily per partition)
     df = sparkdl_tpu.readImages(data_dir, numPartitions=4)
 
-    # 2. attach labels (join by file path)
-    label_of = {r["filePath"]: r["label"] for r in rows}
-    import pyarrow as pa
-    labeled = df.with_column(
-        "label", lambda b: pa.array(
-            [label_of[p] for p in b.column(0).to_pylist()],
-            type=pa.int64()))
+    # 2. attach labels: broadcast hash join on the file path (the small
+    # label table ships into the streamed probe, Spark-style)
+    labels_df = DataFrame.from_pylist(rows, num_partitions=1)
+    labeled = df.join(labels_df, on="filePath")
 
     # 3. featurizer + logistic regression as ONE pipeline
     pipeline = sparkdl_tpu.Pipeline(stages=[

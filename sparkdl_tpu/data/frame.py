@@ -440,6 +440,72 @@ class DataFrame:
         return DataFrame(deferred(self) + deferred(other),
                          engine=self._engine)
 
+    def join(self, other: "DataFrame", on, how: str = "inner"
+             ) -> "DataFrame":
+        """Broadcast hash join: ``other`` (the small side — e.g. a label
+        table) materializes ONCE and ships into a per-batch probe;
+        this frame streams. The Spark-shaped affordance behind every
+        "attach labels to images" flow (reference README's
+        transfer-learning example joined labels onto readImages output).
+
+        ``on``: key column name or list of names present on both sides;
+        ``how``: ``inner`` (drop unmatched left rows) or ``left`` (keep
+        them, right columns null). Keys must be UNIQUE on the right
+        side — duplicate right keys raise (this is a broadcast lookup,
+        not a general shuffle join)."""
+        keys = [on] if isinstance(on, str) else list(on)
+        if not keys:
+            raise ValueError("join needs at least one key column")
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        right = other.collect()
+        for k in keys:
+            column_index(right, k)   # raise early on a bad key
+            column_index(self.schema, k)
+        overlap = (set(self.schema.names) & set(right.schema.names)) \
+            - set(keys)
+        if overlap:
+            raise ValueError(
+                f"non-key columns {sorted(overlap)} exist on both "
+                "sides; rename or drop one side first")
+
+        def key_tuples(table_or_batch):
+            cols = [table_or_batch.column(column_index(table_or_batch, k))
+                    .to_pylist() for k in keys]
+            return list(zip(*cols)) if cols else []
+
+        right_rows = {}
+        for i, kt in enumerate(key_tuples(right)):
+            if kt in right_rows:
+                raise ValueError(
+                    f"duplicate join key {kt!r} on the right side; "
+                    "broadcast join needs unique right keys")
+            right_rows[kt] = i
+        payload = right.drop_columns(keys)
+
+        def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            idx = [right_rows.get(kt) for kt in key_tuples(batch)]
+            if how == "inner":
+                # explicit bool type: an empty list infers type null,
+                # which filter() rejects — and the schema probe runs
+                # this stage on a zero-row batch
+                keep = pa.array([j is not None for j in idx],
+                                type=pa.bool_())
+                batch = batch.filter(keep)
+                take = pa.array([j for j in idx if j is not None],
+                                type=pa.int64())
+            else:
+                take = pa.array(idx, type=pa.int64())  # None → null row
+            picked = payload.take(take)
+            for col_i, field in enumerate(picked.schema):
+                batch = batch.append_column(
+                    field, picked.column(col_i).combine_chunks())
+            return batch
+
+        return self.map_batches(
+            _stage, name=f"join({','.join(keys)})",
+            row_preserving=(how == "left"))
+
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         """Bernoulli row sample (per-row coin flip, like Spark's).
         Deterministic per (seed, partition): re-materializations return

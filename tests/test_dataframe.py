@@ -221,6 +221,82 @@ class TestEngine:
         np.testing.assert_array_equal(xs, np.arange(50))
 
 
+class TestJoin:
+    def _frames(self):
+        left = DataFrame.from_table(
+            pa.table({"path": [f"p{i}" for i in range(8)],
+                      "x": np.arange(8.0)}), 3)
+        right = DataFrame.from_table(
+            pa.table({"path": [f"p{i}" for i in range(0, 8, 2)],
+                      "label": [10, 12, 14, 16]}), 2)
+        return left, right
+
+    def test_inner_join_attaches_and_drops(self):
+        left, right = self._frames()
+        out = left.join(right, on="path").collect()
+        assert out.column("path").to_pylist() == \
+            ["p0", "p2", "p4", "p6"]
+        assert out.column("label").to_pylist() == [10, 12, 14, 16]
+        assert out.column("x").to_pylist() == [0.0, 2.0, 4.0, 6.0]
+
+    def test_left_join_keeps_unmatched_with_nulls(self):
+        left, right = self._frames()
+        out = left.join(right, on="path", how="left").collect()
+        assert out.num_rows == 8
+        labels = out.column("label").to_pylist()
+        assert labels[0::2] == [10, 12, 14, 16]
+        assert labels[1::2] == [None] * 4
+
+    def test_join_preserves_tensor_columns(self):
+        feats = np.arange(12, dtype=np.float32).reshape(4, 3)
+        rb = pa.RecordBatch.from_pylist(
+            [{"path": f"p{i}"} for i in range(4)])
+        rb = append_tensor_column(rb, "feat", feats)
+        right = DataFrame.from_batches([rb])
+        left = DataFrame.from_table(
+            pa.table({"path": [f"p{i}" for i in range(4)]}), 2)
+        out = left.join(right, on="path")
+        np.testing.assert_array_equal(out.tensor("feat"), feats)
+
+    def test_join_validation(self):
+        left, right = self._frames()
+        with pytest.raises(KeyError):
+            left.join(right, on="nope")
+        with pytest.raises(ValueError, match="how"):
+            left.join(right, on="path", how="outer")
+        with pytest.raises(ValueError, match="at least one"):
+            left.join(right, on=[])
+        dup = DataFrame.from_table(
+            pa.table({"path": ["p0", "p0"], "label": [1, 2]}), 1)
+        with pytest.raises(ValueError, match="duplicate join key"):
+            left.join(dup, on="path").collect()
+        clash = DataFrame.from_table(
+            pa.table({"path": ["p0"], "x": [9.0]}), 1)
+        with pytest.raises(ValueError, match="both"):
+            left.join(clash, on="path")
+
+    def test_join_schema_probe_and_empty_partitions(self):
+        """.schema / .columns on a joined frame probes the stage with a
+        zero-row batch — the inner-join mask must stay boolean-typed
+        there (regression: empty pa.array infers type null, which
+        filter() rejects)."""
+        left, right = self._frames()
+        joined = left.join(right, on="path")
+        assert joined.columns == ["path", "x", "label"]
+        assert joined.limit(2).collect().num_rows == 2
+
+    def test_multi_key_join(self):
+        left = DataFrame.from_table(
+            pa.table({"a": [1, 1, 2], "b": ["x", "y", "x"],
+                      "v": [1.0, 2.0, 3.0]}), 2)
+        right = DataFrame.from_table(
+            pa.table({"a": [1, 2], "b": ["y", "x"],
+                      "tag": ["one-y", "two-x"]}), 1)
+        out = left.join(right, on=["a", "b"]).collect()
+        assert out.column("v").to_pylist() == [2.0, 3.0]
+        assert out.column("tag").to_pylist() == ["one-y", "two-x"]
+
+
 class TestParquetIO:
     def test_round_trip_with_tensor_columns(self, tmp_path):
         X = np.arange(40, dtype=np.float32).reshape(10, 4)
