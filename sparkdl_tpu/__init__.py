@@ -50,6 +50,8 @@ _EXPORTS = {
     "ParamGridBuilder": ("sparkdl_tpu.params.tuning", "ParamGridBuilder"),
     "ClassificationEvaluator": ("sparkdl_tpu.estimators.evaluators",
                                 "ClassificationEvaluator"),
+    "BinaryClassificationEvaluator": ("sparkdl_tpu.estimators.evaluators",
+                                      "BinaryClassificationEvaluator"),
     "LossEvaluator": ("sparkdl_tpu.estimators.evaluators",
                       "LossEvaluator"),
     # fitted-stage persistence (pyspark ML save/load semantics)

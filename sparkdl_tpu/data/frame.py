@@ -469,33 +469,46 @@ class DataFrame:
                 f"non-key columns {sorted(overlap)} exist on both "
                 "sides; rename or drop one side first")
 
-        def key_tuples(table_or_batch):
-            cols = [table_or_batch.column(column_index(table_or_batch, k))
-                    .to_pylist() for k in keys]
-            return list(zip(*cols)) if cols else []
+        import pyarrow.compute as pc
 
-        right_rows = {}
-        for i, kt in enumerate(key_tuples(right)):
-            if kt in right_rows:
-                raise ValueError(
-                    f"duplicate join key {kt!r} on the right side; "
-                    "broadcast join needs unique right keys")
-            right_rows[kt] = i
+        def key_array(table_or_batch) -> pa.Array:
+            """Key column(s) → one hashable array, all in C++ — the
+            probe is a per-batch hot stage and must not drop to
+            per-row Python. Multi-key: columns cast to string and
+            joined with a separator (a composite hash key)."""
+            arrs = []
+            for k in keys:
+                col = table_or_batch.column(
+                    column_index(table_or_batch, k))
+                if isinstance(col, pa.ChunkedArray):
+                    col = col.combine_chunks()
+                arrs.append(col)
+            if len(arrs) == 1:
+                return arrs[0]
+            return pc.binary_join_element_wise(
+                *[pc.cast(a, pa.string()) for a in arrs], "\x1f")
+
+        right_keys = key_array(right)
+        if right_keys.null_count:
+            raise ValueError("right-side join keys contain nulls")
+        if pc.count_distinct(right_keys).as_py() != len(right_keys):
+            dup = [k for k, c in
+                   zip(*np.unique(np.asarray(right_keys.to_pylist(),
+                                             dtype=object),
+                                  return_counts=True)) if c > 1][0]
+            raise ValueError(
+                f"duplicate join key {dup!r} on the right side; "
+                "broadcast join needs unique right keys")
         payload = right.drop_columns(keys)
 
         def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
-            idx = [right_rows.get(kt) for kt in key_tuples(batch)]
+            idx = pc.index_in(key_array(batch), value_set=right_keys)
             if how == "inner":
-                # explicit bool type: an empty list infers type null,
-                # which filter() rejects — and the schema probe runs
-                # this stage on a zero-row batch
-                keep = pa.array([j is not None for j in idx],
-                                type=pa.bool_())
+                keep = idx.is_valid()
                 batch = batch.filter(keep)
-                take = pa.array([j for j in idx if j is not None],
-                                type=pa.int64())
+                take = idx.drop_null()
             else:
-                take = pa.array(idx, type=pa.int64())  # None → null row
+                take = idx  # null index → null payload row
             picked = payload.take(take)
             for col_i, field in enumerate(picked.schema):
                 batch = batch.append_column(

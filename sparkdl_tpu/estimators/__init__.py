@@ -6,6 +6,7 @@ composition needed from Spark ML.
 """
 
 from sparkdl_tpu.estimators.evaluators import (
+    BinaryClassificationEvaluator,
     ClassificationEvaluator,
     LossEvaluator,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "KerasImageFileModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "BinaryClassificationEvaluator",
     "ClassificationEvaluator",
     "LossEvaluator",
 ]

@@ -495,6 +495,74 @@ class TestEvaluators:
                                      labelCol="label")
         assert ev.evaluate(self._binary_df()) == pytest.approx(1.0)
 
+    def test_binary_auc_metrics(self):
+        """areaUnderROC / areaUnderPR against hand-computed values,
+        including score ties (average-rank handling) and the (N,2)
+        probability-vector input shape."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        from sparkdl_tpu.estimators import BinaryClassificationEvaluator
+
+        # scores: .9 .8 .8 .4 .2 — labels: 1 1 0 0 1 (tie at .8)
+        scores = np.array([0.9, 0.8, 0.8, 0.4, 0.2], np.float64)
+        labels = [1, 1, 0, 0, 1]
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": l, "probability": s}
+             for l, s in zip(labels, scores)])
+        df = DataFrame.from_batches([batch])
+
+        # ranks asc: .2→1, .4→2, .8→(3+4)/2=3.5 each, .9→5
+        # pos rank sum = 5 + 3.5 + 1 = 9.5 → AUC = (9.5 - 6) / (3*2)
+        ev = BinaryClassificationEvaluator()
+        assert ev.evaluate(df) == pytest.approx((9.5 - 6.0) / 6.0)
+        assert ev.isLargerBetter()
+
+        # AP with the .8 tie grouped into ONE threshold:
+        # .9 → tp 1, prec 1/1; .8 → tp 1, prec 2/3; .2 → tp 1, prec 3/5
+        # AP = (1·1 + 1·(2/3) + 1·0.6) / 3
+        ap = BinaryClassificationEvaluator(metricName="areaUnderPR")
+        assert ap.evaluate(df) == pytest.approx(
+            (1.0 + 2.0 / 3.0 + 0.6) / 3.0)
+
+        # tie handling must be row-order invariant: the same
+        # (score, label) multiset in any order gives one value
+        for labs in ([1, 0], [0, 1]):
+            bt = pa.RecordBatch.from_pylist(
+                [{"label": l, "probability": 0.8} for l in labs]
+                + [{"label": 0, "probability": 0.1}])
+            v = ap.evaluate(DataFrame.from_batches([bt]))
+            assert v == pytest.approx(0.5), labs
+
+        # (N,2) probability vectors: class-1 column is the score
+        probs = np.stack([1.0 - scores, scores], axis=1) \
+            .astype(np.float32)
+        b2 = pa.RecordBatch.from_pylist([{"label": l} for l in labels])
+        b2 = append_tensor_column(b2, "probability", probs)
+        df2 = DataFrame.from_batches([b2])
+        assert BinaryClassificationEvaluator().evaluate(df2) == \
+            pytest.approx((9.5 - 6.0) / 6.0)
+
+    def test_binary_auc_validation(self):
+        import pyarrow as pa
+
+        from sparkdl_tpu.estimators import BinaryClassificationEvaluator
+
+        with pytest.raises(ValueError, match="metricName"):
+            BinaryClassificationEvaluator(metricName="rocCurve")
+        multi = pa.RecordBatch.from_pylist(
+            [{"label": 2, "probability": 0.5},
+             {"label": 0, "probability": 0.1}])
+        with pytest.raises(ValueError, match="binary"):
+            BinaryClassificationEvaluator().evaluate(
+                DataFrame.from_batches([multi]))
+        one_class = pa.RecordBatch.from_pylist(
+            [{"label": 1, "probability": 0.5},
+             {"label": 1, "probability": 0.1}])
+        with pytest.raises(ValueError, match="single class"):
+            BinaryClassificationEvaluator().evaluate(
+                DataFrame.from_batches([one_class]))
+
     def test_binary_sigmoid_loss(self):
         ev = LossEvaluator(predictionCol="prediction", labelCol="label")
         expected = -np.mean(np.log([0.9, 0.8, 0.8]))
