@@ -61,16 +61,19 @@ _DETERMINISTIC_JAX_STATUSES = (
 
 def is_deterministic_jax_error(exc: BaseException) -> bool:
     """True when a jax/PJRT runtime error carries a status code that a
-    re-run cannot fix. XlaRuntimeError IS JaxRuntimeError, and its
-    message leads with the absl status name ("INVALID_ARGUMENT: ...")."""
+    re-run cannot fix. XlaRuntimeError IS JaxRuntimeError; the absl
+    status name is searched as a ``NAME:`` token in the message's first
+    line rather than only at position 0 — wrapping layers commonly
+    prefix context ("Execution failed: INVALID_ARGUMENT: ...")."""
     try:
         from jax.errors import JaxRuntimeError
     except ImportError:  # pragma: no cover
         return False
     if not isinstance(exc, JaxRuntimeError):
         return False
-    msg = str(exc).lstrip()
-    return any(msg.startswith(s) for s in _DETERMINISTIC_JAX_STATUSES)
+    first_line = str(exc).lstrip().splitlines()[0] if str(exc) else ""
+    return any(f"{s}:" in first_line
+               for s in _DETERMINISTIC_JAX_STATUSES)
 
 
 class LocalEngine:

@@ -195,6 +195,17 @@ class DataFrame:
 
         if os.path.isdir(path):
             files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+            if files and not os.path.exists(
+                    os.path.join(path, "_SUCCESS")):
+                # externally-written dirs legitimately lack the marker,
+                # but a write_parquet output without it was interrupted
+                # mid-commit — surface that instead of silently serving
+                # a partial dataset
+                import logging
+                logging.getLogger(__name__).warning(
+                    "%r has no _SUCCESS marker: either written by "
+                    "another tool, or a write_parquet was interrupted "
+                    "mid-commit and the dataset may be PARTIAL", path)
         else:
             files = [path]
         if not files:
@@ -256,6 +267,11 @@ class DataFrame:
                 staged.append(f)
             for f in staged:
                 os.replace(f, os.path.join(path, os.path.basename(f)))
+            # commit marker (Spark's _SUCCESS): the rename loop itself
+            # is not atomic, so a kill mid-commit leaves part files but
+            # no marker — read_parquet warns on its absence
+            with open(os.path.join(path, "_SUCCESS"), "w"):
+                pass
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
         return path
@@ -485,8 +501,17 @@ class DataFrame:
                 arrs.append(col)
             if len(arrs) == 1:
                 return arrs[0]
-            return pc.binary_join_element_wise(
-                *[pc.cast(a, pa.string()) for a in arrs], "\x1f")
+            # escape the separator inside each field before joining, or
+            # values containing \x1f would make distinct key tuples
+            # collide (('x\x1fy','z') vs ('x','y\x1fz')) — wrong
+            # matches / spurious duplicate-key errors
+            parts = []
+            for a in arrs:
+                s = pc.cast(a, pa.string())
+                s = pc.replace_substring(s, "\\", "\\\\")
+                s = pc.replace_substring(s, "\x1f", "\\u")
+                parts.append(s)
+            return pc.binary_join_element_wise(*parts, "\x1f")
 
         right_keys = key_array(right)
         if right_keys.null_count:
@@ -568,7 +593,10 @@ class DataFrame:
                                     max(1, len(self._sources)),
                                     self._engine)
 
-    def cache_to_disk(self, directory: str) -> "DataFrame":
+    _spill_manifest_lock = threading.Lock()
+
+    def cache_to_disk(self, directory: str,
+                      fingerprint: str = "") -> "DataFrame":
         """A frame whose partitions spill to Arrow IPC files on first
         load and re-read from disk afterwards — the multi-pass analogue
         of :meth:`cache` for data too big (or too numerous in epochs) to
@@ -585,9 +613,12 @@ class DataFrame:
         distributed engine the cache is per-machine, not shared.
 
         A populated ``directory`` is only reused when its manifest
-        matches this frame (schema + partition count) — a warm cache
-        from an identical earlier run is served; anything else raises
-        rather than silently returning another frame's rows."""
+        matches this frame's SHAPE (schema + partition count) and the
+        caller-supplied ``fingerprint``. Shape alone cannot distinguish
+        two datasets with identical schema — callers reusing a cache
+        directory across runs should pass a content fingerprint (e.g. a
+        hash of source paths); mismatches raise rather than silently
+        returning another dataset's rows."""
         import json
 
         os.makedirs(directory, exist_ok=True)
@@ -595,24 +626,32 @@ class DataFrame:
         preserving = all(st.row_preserving for st in plan)
         manifest_path = os.path.join(directory, "_manifest.json")
         manifest = {"schema": self.schema.to_string(),
-                    "num_partitions": len(self._sources)}
-        if os.path.exists(manifest_path):
-            with open(manifest_path) as f:
-                existing = json.load(f)
-            if existing != manifest:
+                    "num_partitions": len(self._sources),
+                    "fingerprint": str(fingerprint)}
+        # in-process lock + atomic rename: concurrent callers sharing a
+        # spill dir (fitMultiple trials) must not race the
+        # check-then-act below into spurious "not empty" errors
+        with DataFrame._spill_manifest_lock:
+            if os.path.exists(manifest_path):
+                with open(manifest_path) as f:
+                    existing = json.load(f)
+                if existing != manifest:
+                    raise ValueError(
+                        f"cache directory {directory!r} holds a spill "
+                        "of a DIFFERENT frame (schema, partition count "
+                        "or fingerprint mismatch); use a fresh "
+                        "directory")
+            elif [n for n in os.listdir(directory)
+                  if not n.startswith("_manifest.json.tmp")]:
                 raise ValueError(
-                    f"cache directory {directory!r} holds a spill of a "
-                    "DIFFERENT frame (schema or partition count "
-                    "mismatch); use a fresh directory")
-        elif os.listdir(directory):
-            raise ValueError(
-                f"cache directory {directory!r} is not empty and has "
-                "no spill manifest; use a fresh directory")
-        else:
-            tmp = f"{manifest_path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            os.replace(tmp, manifest_path)
+                    f"cache directory {directory!r} is not empty and "
+                    "has no spill manifest; use a fresh directory")
+            else:
+                tmp = (f"{manifest_path}.tmp.{os.getpid()}"
+                       f".{threading.get_ident()}")
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, manifest_path)
 
         def make(i: int, src: Source) -> Source:
             logical = (src.logical_index

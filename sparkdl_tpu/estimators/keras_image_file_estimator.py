@@ -993,18 +993,21 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # k times, exactly the cost cacheDecoded exists to remove.
         # Concurrent trials spilling the same partition are safe:
         # unique tmp + atomic rename, deterministic decode.
+        def _keeps_data_params(pm) -> bool:
+            names = {p.name if isinstance(p, Param) else str(p)
+                     for p in pm}
+            return not (names & self._DATA_PARAMS)
+
         shared_spill = None
-        if streaming and self.getOrDefault("cacheDecoded"):
+        if streaming and self.getOrDefault("cacheDecoded") \
+                and any(_keeps_data_params(pm) for pm in paramMaps):
             import tempfile
             shared_spill = tempfile.mkdtemp(
                 prefix="sparkdl_tpu_decoded_shared_")
 
         def trial(i, pm):
             if streaming:
-                names = {p.name if isinstance(p, Param) else str(p)
-                         for p in pm}
-                use_shared = (shared_spill
-                              if not (names & self._DATA_PARAMS)
+                use_shared = (shared_spill if _keeps_data_params(pm)
                               else None)
                 return self._trainStreaming(dataset, pm,
                                             checkpoint_tag=f"trial_{i}",

@@ -140,6 +140,13 @@ def save_stage(stage, path: str) -> None:
     params = {p.name: _encode_value(f"param_{p.name}", v, path)
               for p, v in stage._paramMap.items()
               if p.name not in stage._unsaved_param_names()}
+    # defaults are saved too (pyspark DefaultParamsWriter): a stage
+    # reloaded under a library version whose constructor defaults
+    # changed must behave as it did when saved, not silently shift
+    defaults = {p.name: _encode_value(f"default_{p.name}", v, path)
+                for p, v in stage._defaultParamMap.items()
+                if p.name not in stage._unsaved_param_names()
+                and p.name not in {q.name for q in stage._paramMap}}
     extra = {k: _encode_value(f"extra_{k}", v, path)
              for k, v in stage._extra_state().items()}
     children = {}
@@ -152,6 +159,7 @@ def save_stage(stage, path: str) -> None:
         "version": VERSION,
         "class": f"{cls.__module__}.{cls.__qualname__}",
         "params": params,
+        "defaults": defaults,
         "extra": extra,
         "children": sorted(children),
     }
@@ -182,4 +190,11 @@ def load_stage(path: str):
              for name, d in meta["extra"].items()}
     children = {name: load_stage(os.path.join(path, name))
                 for name in meta.get("children", [])}
-    return cls._from_saved(params, extra, children)
+    stage = cls._from_saved(params, extra, children)
+    # restore the SAVED defaults over whatever this library version's
+    # constructor set (unknown names are skipped for forward compat)
+    for name, d in meta.get("defaults", {}).items():
+        if stage.hasParam(name):
+            stage._defaultParamMap[stage.getParam(name)] = \
+                _decode_value(d, path)
+    return stage

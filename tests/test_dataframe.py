@@ -275,6 +275,18 @@ class TestJoin:
         with pytest.raises(ValueError, match="both"):
             left.join(clash, on="path")
 
+    def test_multi_key_separator_safety(self):
+        """Key values containing the composite separator must neither
+        collide (('x\\x1fy','z') vs ('x','y\\x1fz')) nor mis-match."""
+        left = DataFrame.from_table(
+            pa.table({"a": ["x\x1fy", "x"], "b": ["z", "y\x1fz"],
+                      "v": [1.0, 2.0]}), 1)
+        right = DataFrame.from_table(
+            pa.table({"a": ["x\x1fy", "x"], "b": ["z", "y\x1fz"],
+                      "tag": ["first", "second"]}), 1)
+        out = left.join(right, on=["a", "b"]).collect()
+        assert out.column("tag").to_pylist() == ["first", "second"]
+
     def test_join_schema_probe_and_empty_partitions(self):
         """.schema / .columns on a joined frame probes the stage with a
         zero-row batch — the inner-join mask must stay boolean-typed
@@ -345,6 +357,25 @@ class TestParquetIO:
             df.write_parquet(out)
         with pytest.raises(FileNotFoundError):
             DataFrame.read_parquet(str(tmp_path / "empty_dir"))
+
+    def test_success_marker_written_and_absence_warns(self, tmp_path,
+                                                      caplog):
+        import logging
+        import os
+
+        df = _df(10, 2)
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+        assert os.path.exists(os.path.join(out, "_SUCCESS"))
+        with caplog.at_level(logging.WARNING):
+            DataFrame.read_parquet(out)
+        assert "PARTIAL" not in caplog.text
+
+        os.remove(os.path.join(out, "_SUCCESS"))
+        with caplog.at_level(logging.WARNING):
+            back = DataFrame.read_parquet(out)
+        assert "PARTIAL" in caplog.text  # interrupted-commit signal
+        assert back.count() == 10        # still readable (external dirs)
 
     def test_failed_write_leaves_no_partial_dataset(self, tmp_path):
         """A crash mid-stream must not leave part files a later
@@ -449,6 +480,35 @@ class TestCacheToDisk:
         (stray / "junk.bin").write_bytes(b"x")
         with pytest.raises(ValueError, match="no spill manifest"):
             df1.cache_to_disk(str(stray))
+
+    def test_concurrent_callers_share_a_spill_dir(self, tmp_path):
+        """fitMultiple's trials call cache_to_disk on the SAME dir from
+        threads; the manifest check-then-act must not race into
+        spurious 'not empty' errors."""
+        import concurrent.futures
+
+        d = str(tmp_path / "spill")
+        table = pa.table({"x": np.arange(30.0)})
+
+        def run(_):
+            df = DataFrame.from_table(table, 3)
+            return df.cache_to_disk(d).collect().num_rows
+
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            got = list(ex.map(run, range(8)))
+        assert got == [30] * 8
+
+    def test_fingerprint_distinguishes_same_shape_content(self, tmp_path):
+        """Same schema + partition count but a different caller
+        fingerprint must refuse the warm cache (shape alone cannot see
+        content)."""
+        d = str(tmp_path / "spill")
+        df1 = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 2)
+        df1.cache_to_disk(d, fingerprint="day1").collect()
+        df2 = DataFrame.from_table(pa.table({"x": np.arange(6.0) * 9}),
+                                   2)
+        with pytest.raises(ValueError, match="fingerprint"):
+            df2.cache_to_disk(d, fingerprint="day2")
 
     def test_schema_probe_does_not_spill(self, tmp_path):
         """.columns / union schema checks must come from the underlying

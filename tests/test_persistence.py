@@ -229,6 +229,39 @@ class TestTuningPersistence:
         np.testing.assert_array_equal(a, b)
 
 
+class TestDefaultsPersistence:
+    def test_saved_defaults_pin_behavior(self, tmp_path):
+        """Defaults are persisted alongside explicit params (pyspark
+        DefaultParamsWriter): a reload must use the defaults as they
+        were AT SAVE TIME, not whatever this library version's
+        constructor sets — proven by tampering the saved default and
+        observing the loaded stage follow it."""
+        import json
+        import os
+
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+
+        t = TensorTransformer()  # tfHParams stays a pure default (None)
+        path = str(tmp_path / "tt")
+        t.save(path)
+        meta_path = os.path.join(path, "metadata.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        assert meta["defaults"]["tfHParams"]["value"] is None
+
+        # simulate "the library default changed since the save": the
+        # artifact's recorded defaults must win on reload
+        meta["defaults"]["tfHParams"]["value"] = {"gain": 2.5}
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        back = sparkdl_tpu.load_model(path)
+        assert back.getTFHParams() == {"gain": 2.5}
+        # explicitly-set-at-construction params are unaffected
+        assert back.getBatchSize() == 64
+
+
 class TestEstimatorPersistence:
     def test_configured_cross_validator_round_trip(self, tmp_path):
         """An unfitted CrossValidator (estimator + grid + evaluator as

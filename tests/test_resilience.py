@@ -117,7 +117,10 @@ class TestEngineRetry:
         from jax.errors import JaxRuntimeError
 
         for status in ("INVALID_ARGUMENT: operand shapes",
-                       "RESOURCE_EXHAUSTED: allocating 40G exceeds HBM"):
+                       "RESOURCE_EXHAUSTED: allocating 40G exceeds HBM",
+                       # wrapping layers prefix context; the status
+                       # token must still classify as deterministic
+                       "Execution failed: INVALID_ARGUMENT: bad dims"):
             engine = LocalEngine(num_workers=1, max_retries=3)
             calls = {"n": 0}
 
