@@ -71,7 +71,8 @@ def is_deterministic_jax_error(exc: BaseException) -> bool:
         return False
     if not isinstance(exc, JaxRuntimeError):
         return False
-    first_line = str(exc).lstrip().splitlines()[0] if str(exc) else ""
+    msg = str(exc).lstrip()
+    first_line = msg.splitlines()[0] if msg else ""
     return any(f"{s}:" in first_line
                for s in _DETERMINISTIC_JAX_STATUSES)
 

@@ -635,6 +635,9 @@ class DataFrame:
             if os.path.exists(manifest_path):
                 with open(manifest_path) as f:
                     existing = json.load(f)
+                # manifests written before the fingerprint field count
+                # as the default fingerprint, not as a mismatch
+                existing.setdefault("fingerprint", "")
                 if existing != manifest:
                     raise ValueError(
                         f"cache directory {directory!r} holds a spill "

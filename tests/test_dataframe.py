@@ -498,6 +498,26 @@ class TestCacheToDisk:
             got = list(ex.map(run, range(8)))
         assert got == [30] * 8
 
+    def test_pre_fingerprint_manifest_still_reusable(self, tmp_path):
+        """Manifests written before the fingerprint field existed must
+        count as the default fingerprint, not as a mismatch."""
+        import json
+        import os
+
+        d = str(tmp_path / "spill")
+        df = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 2)
+        df.cache_to_disk(d).collect()
+        mp = os.path.join(d, "_manifest.json")
+        with open(mp) as f:
+            manifest = json.load(f)
+        del manifest["fingerprint"]  # simulate an old-version spill
+        with open(mp, "w") as f:
+            json.dump(manifest, f)
+        warm = DataFrame.from_table(
+            pa.table({"x": np.arange(6.0)}), 2).cache_to_disk(d)
+        assert warm.collect().column("x").to_pylist() == \
+            list(np.arange(6.0))
+
     def test_fingerprint_distinguishes_same_shape_content(self, tmp_path):
         """Same schema + partition count but a different caller
         fingerprint must refuse the warm cache (shape alone cannot see

@@ -1,0 +1,86 @@
+"""Whole-stack integration: every round-3 surface in ONE flow.
+
+The reference's user story end-to-end at test scale: read images →
+join labels → featurize (committed trained TestNet) → persist the
+features as parquet → train a minibatch LogisticRegression on the
+reloaded features → score with all evaluators → save the fitted
+pipeline → reload in-process and serve identical predictions. Each
+piece has focused tests elsewhere; this exercises their interactions.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import sparkdl_tpu
+from sparkdl_tpu.data.frame import DataFrame
+
+
+@pytest.fixture(scope="module")
+def labeled_images(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("capstone")
+    rng = np.random.default_rng(33)
+    rows = []
+    for i in range(60):
+        label = i % 2
+        base = 45 if label == 0 else 205
+        arr = np.clip(rng.normal(base, 14, (24, 24, 3)),
+                      0, 255).astype(np.uint8)
+        p = str(d / f"img_{i:04d}.png")
+        Image.fromarray(arr, "RGB").save(p)
+        rows.append({"filePath": p, "label": label})
+    return str(d), rows
+
+
+def test_full_pipeline_capstone(tmp_path, labeled_images):
+    data_dir, rows = labeled_images
+    images = sparkdl_tpu.readImages(data_dir, numPartitions=4)
+    labels_df = DataFrame.from_pylist(rows, num_partitions=1)
+    labeled = images.join(labels_df, on="filePath")
+    assert labeled.count() == 60
+
+    # featurize once, persist the feature table as parquet
+    feats = sparkdl_tpu.DeepImageFeaturizer(
+        modelName="TestNet", inputCol="image",
+        outputCol="features").transform(labeled)
+    pq_dir = str(tmp_path / "features")
+    feats.select("filePath", "features", "label").write_parquet(pq_dir)
+
+    # train the head on the RELOADED features (the featurize-once,
+    # train-many workflow parquet exists for), minibatch path
+    table = DataFrame.read_parquet(pq_dir)
+    assert table.count() == 60
+    lr = sparkdl_tpu.LogisticRegression(maxIter=40, learningRate=0.2,
+                                        batchSize=16)
+    head = lr.fit(table)
+    scored = head.transform(table)
+
+    y = np.array([r["label"] for r in scored.collect_rows()])
+    acc = sparkdl_tpu.ClassificationEvaluator(
+        predictionCol="prediction").evaluate(scored)
+    f1 = sparkdl_tpu.ClassificationEvaluator(
+        predictionCol="prediction", metricName="f1").evaluate(scored)
+    auc = sparkdl_tpu.BinaryClassificationEvaluator().evaluate(scored)
+    loss = sparkdl_tpu.LossEvaluator().evaluate(scored)
+    assert acc >= 0.9 and f1 >= 0.9 and auc >= 0.95
+    assert loss < 0.5
+    assert np.mean(
+        scored.tensor("probability").argmax(-1) == y) == acc
+
+    # persist the FULL fitted pipeline (featurizer + head) and serve
+    # identical predictions from the reload
+    from sparkdl_tpu.params.pipeline import PipelineModel
+    pipeline_model = PipelineModel([
+        sparkdl_tpu.DeepImageFeaturizer(
+            modelName="TestNet", inputCol="image",
+            outputCol="features"),
+        head,
+    ])
+    save_dir = str(tmp_path / "model")
+    pipeline_model.save(save_dir)
+    served = sparkdl_tpu.load_model(save_dir)
+    a = pipeline_model.transform(labeled).tensor("probability")
+    b = served.transform(labeled).tensor("probability")
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

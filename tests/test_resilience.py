@@ -133,6 +133,18 @@ class TestEngineRetry:
                                     [Stage(stage, kind="device")]))
             assert calls["n"] == 1, status
 
+    def test_classifier_tolerates_degenerate_messages(self):
+        """Empty / whitespace-only jax error messages must classify
+        (as non-deterministic), not crash the classifier and mask the
+        original device error."""
+        from jax.errors import JaxRuntimeError
+
+        from sparkdl_tpu.data.engine import is_deterministic_jax_error
+
+        for msg in ("", "\n", "   ", "\n\nINVALID_ARGUMENT: late"):
+            assert is_deterministic_jax_error(JaxRuntimeError(msg)) \
+                == ("INVALID_ARGUMENT" in msg)
+
     def test_custom_retryable_set(self):
         """retryable_exceptions is configurable; an exception outside
         the set propagates on first failure."""
