@@ -182,13 +182,20 @@ class DataFrame:
         return DataFrame(sources, engine=engine)
 
     @staticmethod
-    def read_parquet(path: str, engine=None) -> "DataFrame":
+    def read_parquet(path: str, engine=None,
+                     allow_uncommitted: bool = False) -> "DataFrame":
         """Lazy frame over a parquet directory written by
         :meth:`write_parquet` (or any directory of part files): one
         partition per file, loaded on demand; row counts come from
         parquet footers so ``count()`` never reads data. Tensor-column
         shape metadata survives the round-trip (Arrow schema is stored
-        in the parquet file)."""
+        in the parquet file).
+
+        A directory holding part files but no ``_SUCCESS`` marker is an
+        interrupted :meth:`write_parquet` commit — refused by default
+        (Spark's committer semantics: uncommitted output is not
+        readable). For directories written by other tools, pass
+        ``allow_uncommitted=True``."""
         import glob
 
         import pyarrow.parquet as pq
@@ -197,15 +204,17 @@ class DataFrame:
             files = sorted(glob.glob(os.path.join(path, "*.parquet")))
             if files and not os.path.exists(
                     os.path.join(path, "_SUCCESS")):
-                # externally-written dirs legitimately lack the marker,
-                # but a write_parquet output without it was interrupted
-                # mid-commit — surface that instead of silently serving
-                # a partial dataset
+                if not allow_uncommitted:
+                    raise FileNotFoundError(
+                        f"{path!r} holds part files but no _SUCCESS "
+                        "marker: a write_parquet was interrupted "
+                        "mid-commit and the dataset may be PARTIAL. "
+                        "Pass allow_uncommitted=True to read a "
+                        "directory written by another tool.")
                 import logging
                 logging.getLogger(__name__).warning(
-                    "%r has no _SUCCESS marker: either written by "
-                    "another tool, or a write_parquet was interrupted "
-                    "mid-commit and the dataset may be PARTIAL", path)
+                    "%r has no _SUCCESS marker (allow_uncommitted): "
+                    "serving possibly-partial dataset", path)
         else:
             files = [path]
         if not files:
@@ -456,8 +465,9 @@ class DataFrame:
         return DataFrame(deferred(self) + deferred(other),
                          engine=self._engine)
 
-    def join(self, other: "DataFrame", on, how: str = "inner"
-             ) -> "DataFrame":
+    def join(self, other: "DataFrame", on, how: str = "inner", *,
+             broadcast_limit_rows: int = 2_000_000,
+             broadcast_limit_bytes: int = 256 << 20) -> "DataFrame":
         """Broadcast hash join: ``other`` (the small side — e.g. a label
         table) materializes ONCE and ships into a per-batch probe;
         this frame streams. The Spark-shaped affordance behind every
@@ -468,13 +478,45 @@ class DataFrame:
         ``how``: ``inner`` (drop unmatched left rows) or ``left`` (keep
         them, right columns null). Keys must be UNIQUE on the right
         side — duplicate right keys raise (this is a broadcast lookup,
-        not a general shuffle join)."""
+        not a general shuffle join).
+
+        The right side must fit the broadcast contract: at most
+        ``broadcast_limit_rows`` rows / ``broadcast_limit_bytes``
+        materialized bytes (Spark's autoBroadcastJoinThreshold shape,
+        sized for driver RAM rather than shuffle traffic). Joining two
+        big frames raises a named error instead of an OOM; raise the
+        limits explicitly if the right side genuinely fits in memory."""
         keys = [on] if isinstance(on, str) else list(on)
         if not keys:
             raise ValueError("join needs at least one key column")
         if how not in ("inner", "left"):
             raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
-        right = other.collect()
+        # single streamed pass over the right side: both guards fire as
+        # soon as a limit is crossed, BEFORE the full table is held (and
+        # the build side's plan executes once, not count()+collect())
+        r_batches, n_right, nbytes_right = [], 0, 0
+        for rb in other.stream():
+            n_right += rb.num_rows
+            nbytes_right += rb.nbytes
+            if n_right > broadcast_limit_rows:
+                raise ValueError(
+                    f"broadcast join: right side exceeds "
+                    f"broadcast_limit_rows={broadcast_limit_rows:,} "
+                    "(the right side materializes in full on every "
+                    "process). Swap the sides, pre-aggregate, or pass a "
+                    "higher broadcast_limit_rows if it truly fits in "
+                    "memory.")
+            if nbytes_right > broadcast_limit_bytes:
+                raise ValueError(
+                    f"broadcast join: right side exceeds "
+                    f"broadcast_limit_bytes={broadcast_limit_bytes:,} "
+                    f"({nbytes_right:,} bytes so far; the right side "
+                    "materializes in full on every process). Swap the "
+                    "sides, drop payload columns, or pass a higher "
+                    "broadcast_limit_bytes if it truly fits.")
+            r_batches.append(rb)
+        right = (pa.Table.from_batches(r_batches) if r_batches
+                 else other.schema.empty_table())
         for k in keys:
             column_index(right, k)   # raise early on a bad key
             column_index(self.schema, k)

@@ -16,8 +16,7 @@ from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
 from sparkdl_tpu.params.pipeline import Evaluator
 
 
-def _collect_pred_and_labels(dataset, predictionCol: str, labelCol: str):
-    table = dataset.collect()
+def _pred_and_labels(table, predictionCol: str, labelCol: str):
     from sparkdl_tpu.data.tensors import arrow_to_tensor
     pidx = column_index(table, predictionCol)
     preds = np.asarray(arrow_to_tensor(table.column(pidx),
@@ -25,6 +24,10 @@ def _collect_pred_and_labels(dataset, predictionCol: str, labelCol: str):
     labels = np.asarray(
         table.column(column_index(table, labelCol)).to_pylist())
     return preds, labels
+
+
+def _collect_pred_and_labels(dataset, predictionCol: str, labelCol: str):
+    return _pred_and_labels(dataset.collect(), predictionCol, labelCol)
 
 
 _CLS_METRICS = ("accuracy", "f1", "weightedPrecision", "weightedRecall")
@@ -128,7 +131,13 @@ class BinaryClassificationEvaluator(Evaluator):
     handling) or ``areaUnderPR`` (average precision). The score column
     may be a scalar score, an (N,1) sigmoid output, or an (N,2)
     probability vector (class-1 column used). Labels must be binary
-    {0,1}. Larger is better."""
+    {0,1}. Larger is better.
+
+    ``rawPredictionCol`` defaults to ``"rawPrediction"`` (pyspark's
+    default, for drop-in parity); when that column is absent the
+    evaluator accepts ``"probability"`` — the column this build's
+    LogisticRegressionModel writes, and a monotone transform of the
+    margin, so both ranking metrics agree (see PARITY.md)."""
 
     rawPredictionCol = Param("BinaryClassificationEvaluator",
                              "rawPredictionCol",
@@ -140,10 +149,10 @@ class BinaryClassificationEvaluator(Evaluator):
                        f"one of {_BIN_METRICS}", TypeConverters.toString)
 
     @keyword_only
-    def __init__(self, *, rawPredictionCol="probability",
+    def __init__(self, *, rawPredictionCol="rawPrediction",
                  labelCol="label", metricName="areaUnderROC"):
         super().__init__()
-        self._setDefault(rawPredictionCol="probability",
+        self._setDefault(rawPredictionCol="rawPrediction",
                          labelCol="label", metricName="areaUnderROC")
         self._set(rawPredictionCol=rawPredictionCol, labelCol=labelCol,
                   metricName=metricName)
@@ -152,9 +161,33 @@ class BinaryClassificationEvaluator(Evaluator):
                 f"metricName must be one of {_BIN_METRICS}, got "
                 f"{metricName!r}")
 
+    def _score_column(self, table) -> str:
+        """Resolve against the already-collected table (not
+        dataset.columns, whose schema probe re-loads partition 0)."""
+        col = self.getOrDefault("rawPredictionCol")
+        names = set(table.schema.names)
+        if (col == "rawPrediction" and col not in names
+                and "probability" in names):
+            # default fallback: this build's LR head writes
+            # 'probability'; a monotone transform of the raw margin, so
+            # both ranking metrics are identical on either column.
+            # (keyword_only _sets the default kwarg, so explicit vs
+            # unset is indistinguishable here — warn once per instance,
+            # naming the substitution, in case a real column was meant.)
+            if not getattr(self, "_warned_prob_fallback", False):
+                self._warned_prob_fallback = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "BinaryClassificationEvaluator: no 'rawPrediction' "
+                    "column; scoring 'probability' instead (set "
+                    "rawPredictionCol explicitly to silence)")
+            return "probability"
+        return col  # let the column-lookup error name the missing col
+
     def evaluate(self, dataset) -> float:
-        scores, labels = _collect_pred_and_labels(
-            dataset, self.getOrDefault("rawPredictionCol"),
+        table = dataset.collect()
+        scores, labels = _pred_and_labels(
+            table, self._score_column(table),
             self.getOrDefault("labelCol"))
         if scores.ndim > 1:
             if scores.shape[-1] == 1:

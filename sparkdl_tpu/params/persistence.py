@@ -28,7 +28,7 @@ from __future__ import annotations
 import importlib
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional
 
 import numpy as np
 
@@ -167,9 +167,24 @@ def save_stage(stage, path: str) -> None:
         json.dump(meta, f, indent=2, sort_keys=True)
 
 
-def load_stage(path: str):
+def load_stage(path: str, *, trusted_modules: Optional[Iterable[str]] = None):
     """Load a stage saved by :func:`save_stage` (also exported as
-    ``sparkdl_tpu.load_model``)."""
+    ``sparkdl_tpu.load_model``).
+
+    .. warning:: Saved stages may contain cloudpickle sidecars, so
+       loading ALWAYS may execute code from the artifact — only load
+       directories you trust, exactly as with any pickle-based ML
+       loader (Keras ``.h5``, torch ``.pt``, pyspark pickled params).
+       The ``trusted_modules`` gate below is a guard against
+       instantiating arbitrary classes by path, NOT a sandbox: it does
+       not make loading an untrusted directory safe. Class resolution
+       is restricted to ``sparkdl_tpu`` modules by default; pass
+       ``trusted_modules=["my_pkg"]`` (prefix match) to load stages of
+       your own classes, or ``trusted_modules=["*"]`` to disable the
+       restriction entirely.
+    """
+    if isinstance(trusted_modules, str):
+        trusted_modules = [trusted_modules]  # not char-by-char prefixes
     meta_path = os.path.join(path, "metadata.json")
     if not os.path.exists(meta_path):
         raise FileNotFoundError(
@@ -181,6 +196,16 @@ def load_stage(path: str):
             f"{path} was not written by sparkdl_tpu persistence "
             f"(format={meta.get('format')!r})")
     module, _, qualname = meta["class"].rpartition(".")
+    allowed = ["sparkdl_tpu"] + sorted(trusted_modules or [])
+    if "*" not in allowed and not any(
+            module == m or module.startswith(m + ".") for m in allowed):
+        raise ValueError(
+            f"{path} declares stage class {meta['class']!r}, outside "
+            f"the trusted module prefixes {allowed}; pass "
+            "trusted_modules=[...] to load_model if you trust this "
+            "artifact. (Loading any artifact can run code from it — "
+            "this gate only blocks arbitrary class paths, it is not a "
+            "sandbox.)")
     cls = importlib.import_module(module)
     for part in qualname.split("."):
         cls = getattr(cls, part)
@@ -188,7 +213,8 @@ def load_stage(path: str):
               for name, d in meta["params"].items()}
     extra = {name: _decode_value(d, path)
              for name, d in meta["extra"].items()}
-    children = {name: load_stage(os.path.join(path, name))
+    children = {name: load_stage(os.path.join(path, name),
+                                 trusted_modules=trusted_modules)
                 for name in meta.get("children", [])}
     stage = cls._from_saved(params, extra, children)
     # restore the SAVED defaults over whatever this library version's
@@ -197,4 +223,15 @@ def load_stage(path: str):
         if stage.hasParam(name):
             stage._defaultParamMap[stage.getParam(name)] = \
                 _decode_value(d, path)
+    # keyword_only constructors _set every kwarg explicitly, which would
+    # shadow the restored saved defaults (getOrDefault reads _paramMap
+    # before _defaultParamMap). Drop explicit entries the save did not
+    # record as explicit — but only where a default still resolves the
+    # param: _from_saved overrides legitimately fill params.get(name,
+    # fallback) for params with no default (older-artifact compat), and
+    # clearing those would leave getOrDefault raising KeyError.
+    keep = set(meta["params"]) | stage._unsaved_param_names()
+    for p in [p for p in stage._paramMap if p.name not in keep]:
+        if p in stage._defaultParamMap:
+            stage.clear(p)
     return stage

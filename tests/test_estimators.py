@@ -543,6 +543,27 @@ class TestEvaluators:
         assert BinaryClassificationEvaluator().evaluate(df2) == \
             pytest.approx((9.5 - 6.0) / 6.0)
 
+    def test_binary_default_col_matches_pyspark(self):
+        """ADVICE r3: default rawPredictionCol is 'rawPrediction'
+        (pyspark parity); 'probability' is only a fallback when that
+        column is absent, and never shadows a real 'rawPrediction'."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.estimators import BinaryClassificationEvaluator
+
+        ev = BinaryClassificationEvaluator()
+        assert ev.getOrDefault("rawPredictionCol") == "rawPrediction"
+        # margins in rawPrediction rank opposite to the decoy column:
+        # the default must read rawPrediction, not probability
+        both = pa.RecordBatch.from_pylist(
+            [{"label": 1, "rawPrediction": 2.0, "probability": 0.1},
+             {"label": 0, "rawPrediction": -1.0, "probability": 0.9}])
+        assert ev.evaluate(DataFrame.from_batches([both])) == 1.0
+        only_prob = pa.RecordBatch.from_pylist(
+            [{"label": 1, "probability": 0.9},
+             {"label": 0, "probability": 0.2}])
+        assert ev.evaluate(DataFrame.from_batches([only_prob])) == 1.0
+
     def test_binary_auc_validation(self):
         import pyarrow as pa
 

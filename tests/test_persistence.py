@@ -262,6 +262,65 @@ class TestDefaultsPersistence:
         assert back.getBatchSize() == 64
 
 
+class _Widget(sparkdl_tpu.params.base.Params):
+    """keyword_only stage whose constructor explicitly _sets every
+    kwarg — the pattern that used to shadow restored saved defaults."""
+
+    from sparkdl_tpu.params.base import (
+        Param as _P,
+        TypeConverters as _TC,
+    )
+    gain = _P("_Widget", "gain", "gain", _TC.toFloat)
+    mode = _P("_Widget", "mode", "mode", _TC.toString)
+
+    @sparkdl_tpu.params.base.keyword_only
+    def __init__(self, *, gain=1.0, mode="auto"):
+        super().__init__()
+        self._setDefault(gain=1.0, mode="auto")
+        self._set(gain=gain, mode=mode)
+
+
+class TestDefaultsNotShadowed:
+    def test_load_restricts_class_resolution(self, tmp_path):
+        """Classes outside sparkdl_tpu refuse to load unless their
+        module prefix is explicitly trusted (pickle-loader hygiene)."""
+        w = _Widget(gain=3.0)
+        path = str(tmp_path / "w")
+        w.save(path)
+        with pytest.raises(ValueError, match="trusted"):
+            sparkdl_tpu.load_model(path)
+        back = sparkdl_tpu.load_model(
+            path, trusted_modules=[type(w).__module__.split(".")[0]])
+        assert back.getOrDefault("gain") == 3.0
+
+    def test_reloaded_stage_reports_saved_set_state(self, tmp_path):
+        """ADVICE r3 (persistence.py:194): the keyword_only constructor
+        _sets every kwarg explicitly, so without the post-construction
+        clear a reloaded stage (a) reported isSet() for never-set params
+        and (b) resolved constructor values over the SAVED defaults."""
+        w = _Widget(gain=3.0)
+        w.clear("mode")            # mode governed by its default
+        assert not w.isSet("mode")
+        path = str(tmp_path / "w")
+        w.save(path)
+
+        meta_path = os.path.join(path, "metadata.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        assert "mode" in meta["defaults"] and "mode" not in meta["params"]
+        # simulate "library default changed since the save": the saved
+        # default must govern the reloaded stage
+        meta["defaults"]["mode"]["value"] = "fancy"
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+
+        trusted = [type(w).__module__.split(".")[0]]
+        back = sparkdl_tpu.load_model(path, trusted_modules=trusted)
+        assert back.isSet("gain") and back.getOrDefault("gain") == 3.0
+        assert not back.isSet("mode")          # as saved
+        assert back.getOrDefault("mode") == "fancy"  # saved default wins
+
+
 class TestEstimatorPersistence:
     def test_configured_cross_validator_round_trip(self, tmp_path):
         """An unfitted CrossValidator (estimator + grid + evaluator as
