@@ -496,6 +496,10 @@ class DataFrame:
         # the build side's plan executes once, not count()+collect())
         r_batches, n_right, nbytes_right = [], 0, 0
         for rb in other.stream():
+            if rb.num_rows == 0:
+                # emptied partitions may carry imprecise computed-column
+                # types (see collect()) — and contribute nothing
+                continue
             n_right += rb.num_rows
             nbytes_right += rb.nbytes
             if n_right > broadcast_limit_rows:
@@ -791,6 +795,16 @@ class DataFrame:
         batches = list(self.stream())
         if not batches:
             return pa.table({})
+        non_empty = [b for b in batches if b.num_rows]
+        if non_empty and len(non_empty) != len(batches):
+            # A zero-row batch contributes no rows but MAY carry
+            # imprecise column types: a computed column (e.g. a decoded
+            # image tensor) cannot infer its row shape from an empty
+            # input, so an emptied partition's schema can disagree with
+            # the populated ones (plan-stage filters — CV folds,
+            # sample — routinely empty whole partitions). Drop them
+            # rather than fail the concat.
+            batches = non_empty
         return pa.Table.from_batches(batches)
 
     def collect_rows(self) -> List[Row]:

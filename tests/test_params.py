@@ -206,6 +206,127 @@ class TestCrossValidator:
         assert calls["n"] == df.num_partitions  # one pass, ever
 
 
+class StreamingMean(Estimator, HasInputCol, HasOutputCol):
+    """Mean estimator that only ever streams partition batches."""
+
+    shift = Param("StreamingMean", "shift", "added to the learned mean",
+                  TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, shift=0.0):
+        super().__init__()
+        self._setDefault(shift=0.0)
+        self._set(inputCol=inputCol, outputCol=outputCol, shift=shift)
+
+    def _fit(self, dataset):
+        tot, n = 0.0, 0
+        idx = None
+        for b in dataset.stream():
+            if idx is None:
+                idx = b.schema.get_field_index(self.getInputCol())
+            x = b.column(idx).to_numpy(zero_copy_only=False)
+            tot += float(x.sum())
+            n += len(x)
+        return MeanModel(tot / n + self.getOrDefault("shift"),
+                         self.getInputCol(), self.getOutputCol())
+
+
+class StreamingMAE(Evaluator):
+    def evaluate(self, dataset):
+        tot, n = 0.0, 0
+        for b in dataset.stream():
+            m = b.column(b.schema.get_field_index("m")) \
+                .to_numpy(zero_copy_only=False)
+            x = b.column(b.schema.get_field_index("x")) \
+                .to_numpy(zero_copy_only=False)
+            tot += float(np.abs(m - x).sum())
+            n += len(x)
+        return tot / n
+
+    def isLargerBetter(self):
+        return False
+
+
+class TestOutOfCoreTuning:
+    def test_folds_disjoint_and_covering(self):
+        """Plan-stage fold membership: per fold, train+valid partition
+        the rows exactly, deterministically across materializations."""
+        cv = CrossValidator(estimator=MeanEstimator(inputCol="x"),
+                            estimatorParamMaps=[{}], evaluator=MAE(),
+                            numFolds=3, seed=11)
+        df = _df(60, parts=5)
+        seen = []
+        for train, valid in cv._kfold(df):
+            tr = set(train.collect().column("x").to_pylist())
+            va = set(valid.collect().column("x").to_pylist())
+            assert tr | va == set(np.arange(60.0))
+            assert not (tr & va)
+            # deterministic on re-materialization
+            assert set(valid.collect().column("x").to_pylist()) == va
+            seen.append(va)
+        # the k validation folds partition the dataset
+        assert set().union(*seen) == set(np.arange(60.0))
+        assert sum(len(s) for s in seen) == 60
+
+    def test_cv_cachedir_fit_never_collects(self, tmp_path,
+                                            monkeypatch):
+        """VERDICT r3 #3 'done' criterion: with cacheDir, a 3-fold fit
+        runs with NO full-table collect() anywhere in the tuning layer
+        (streaming estimator + evaluator prove the layer itself is
+        bounded-memory), while the upstream plan still runs once."""
+        calls = {"n": 0}
+
+        def counting(batch):
+            if batch.num_rows:
+                calls["n"] += 1
+            return batch
+
+        df = _df(60, parts=5).map_batches(counting, name="decode")
+        e = StreamingMean(inputCol="x", outputCol="m")
+        cv = CrossValidator(estimator=e,
+                            estimatorParamMaps=[{e.shift: 0.0},
+                                                {e.shift: 100.0}],
+                            evaluator=StreamingMAE(), numFolds=3,
+                            cacheDir=str(tmp_path))
+
+        def no_collect(self):
+            raise AssertionError(
+                "tuning layer collected a full table in cacheDir mode")
+
+        monkeypatch.setattr(DataFrame, "collect", no_collect)
+        try:
+            cvm = cv.fit(df)
+        finally:
+            monkeypatch.undo()
+        assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
+        assert calls["n"] == df.num_partitions  # decode-once preserved
+        # the per-fit spill subdirectory is cleaned up afterwards
+        assert list(tmp_path.iterdir()) == []
+
+    def test_tvs_cachedir_fit_never_collects(self, tmp_path,
+                                             monkeypatch):
+        e = StreamingMean(inputCol="x", outputCol="m")
+        tvs = TrainValidationSplit(
+            estimator=e,
+            estimatorParamMaps=[{e.shift: 0.0}, {e.shift: 100.0}],
+            evaluator=StreamingMAE(), trainRatio=0.75, seed=3,
+            cacheDir=str(tmp_path))
+        df = _df(80, parts=4)
+
+        def no_collect(self):
+            raise AssertionError(
+                "tuning layer collected a full table in cacheDir mode")
+
+        monkeypatch.setattr(DataFrame, "collect", no_collect)
+        try:
+            m = tvs.fit(df)
+        finally:
+            monkeypatch.undo()
+        assert m.validationMetrics[0] < m.validationMetrics[1]
+        assert abs(m.bestModel.mean - np.arange(80.0).mean()) < 1e-9
+        assert list(tmp_path.iterdir()) == []
+
+
 class TestTrainValidationSplit:
     def test_selects_best_and_refits_on_full_data(self):
         e = MeanEstimator(inputCol="x", outputCol="m")
