@@ -248,11 +248,21 @@ class DataFrame:
 
     def write_parquet(self, path: str) -> str:
         """Materialize the plan and write one parquet part file per
-        partition under ``path`` (Spark's ``df.write.parquet`` shape),
-        STREAMING — one partition's result is in memory at a time, so
-        featurized output larger than RAM still writes. Refuses a
+        partition under ``path`` (Spark's ``df.write.parquet`` shape).
+
+        Part writing is a PLAN STAGE: each partition's task writes its
+        own part into a staging subdirectory and returns only a tiny
+        (file name, row count) summary — on :class:`SparkEngine` the
+        parts are written ON THE EXECUTORS (Spark's committer model:
+        ``path`` must be storage every executor reaches — NFS/GCS/
+        fuse), and the driver never sees the data, only summaries. The
+        driver then commits: renames staged parts into place in
+        partition order and writes ``_SUCCESS``. A crash mid-stream
+        leaves no part files; a kill mid-commit leaves parts without
+        ``_SUCCESS``, which :meth:`read_parquet` refuses. Refuses a
         directory already holding part files. Returns ``path``."""
         import glob
+        import shutil
 
         import pyarrow.parquet as pq
 
@@ -261,28 +271,66 @@ class DataFrame:
             raise FileExistsError(
                 f"{path!r} already holds parquet part files; write to "
                 "a fresh directory (overwrite is never implicit)")
-        # Spark-committer shape: stage every part into a temp subdir and
-        # rename into place only after the whole stream succeeds — a
-        # crash mid-stream must not leave a partial dataset that
-        # read_parquet would silently serve as complete.
-        import shutil
-        tmp_dir = os.path.join(path, f"_tmp.{os.getpid()}")
-        os.makedirs(tmp_dir)
+        staging = os.path.join(path, f"_tmp.{os.getpid()}")
+        # bare makedirs: a second same-process writer racing into the
+        # same path must fail HERE (FileExistsError), not interleave
+        # commits with this writer (tasks re-create it with exist_ok
+        # because remote executors start without it)
+        os.makedirs(staging)
+        summary_schema = pa.schema([("part", pa.string()),
+                                    ("rows", pa.int64())])
+
+        def _write_part(batch: pa.RecordBatch, index: int
+                        ) -> pa.RecordBatch:
+            # runs INSIDE the task; tmp + os.replace makes retried /
+            # duplicate task attempts idempotent (last writer wins on
+            # an identical part name)
+            if batch.num_rows == 0:
+                # emptied partitions may carry imprecise computed-column
+                # types (see collect()); they contribute no rows
+                return pa.RecordBatch.from_pylist(
+                    [], schema=summary_schema)
+            os.makedirs(staging, exist_ok=True)
+            import uuid
+            # unique per attempt: repeated logical indices (partition
+            # repeats) and task retries each stage their own file; only
+            # names returned in summaries commit, orphans are swept
+            # with the staging dir
+            fname = f"part-{index:05d}-{uuid.uuid4().hex[:8]}.parquet"
+            tmp = os.path.join(
+                staging,
+                f"{fname}.tmp.{os.getpid()}.{threading.get_ident()}")
+            pq.write_table(pa.Table.from_batches([batch]), tmp)
+            os.replace(tmp, os.path.join(staging, fname))
+            return pa.RecordBatch.from_pylist(
+                [{"part": fname, "rows": batch.num_rows}],
+                schema=summary_schema)
+
         try:
-            staged = []
-            for i, batch in enumerate(self.stream()):
-                f = os.path.join(tmp_dir, f"part-{i:05d}.parquet")
-                pq.write_table(pa.Table.from_batches([batch]), f)
-                staged.append(f)
-            for f in staged:
-                os.replace(f, os.path.join(path, os.path.basename(f)))
+            entries = []
+            for b in self.map_batches(_write_part, name="write_parquet",
+                                      row_preserving=False,
+                                      with_index=True).stream():
+                entries.extend(b.to_pylist())
+            if not entries:
+                # all-empty frame: one empty part so the dataset (and
+                # its schema) still round-trips through read_parquet
+                f = os.path.join(staging, "part-empty.parquet")
+                pq.write_table(self.schema.empty_table(), f)
+                entries = [{"part": "part-empty.parquet", "rows": 0}]
+            # commit in stream (= partition) order: read_parquet sorts
+            # part files lexicographically, so sequential names keep
+            # row order stable even when logical indices are sparse
+            for seq, e in enumerate(entries):
+                os.replace(os.path.join(staging, e["part"]),
+                           os.path.join(path, f"part-{seq:05d}.parquet"))
             # commit marker (Spark's _SUCCESS): the rename loop itself
             # is not atomic, so a kill mid-commit leaves part files but
-            # no marker — read_parquet warns on its absence
+            # no marker — read_parquet refuses to read without it
             with open(os.path.join(path, "_SUCCESS"), "w"):
                 pass
         finally:
-            shutil.rmtree(tmp_dir, ignore_errors=True)
+            shutil.rmtree(staging, ignore_errors=True)
         return path
 
     # -- plan building ------------------------------------------------------

@@ -97,6 +97,101 @@ def plan_to_map_in_arrow(plan: Sequence) -> Callable[
     return apply_batches
 
 
+def udf_to_column_fn(model_udf, outputMode: str = "vector"
+                     ) -> Callable:
+    """Compile a :class:`~sparkdl_tpu.udf.registry.ModelUDF` into a pure
+    column → column function — the SQL scalar-function body the
+    reference's ``makeGraphUDF`` registered through TensorFrames (SURVEY
+    §3.5: the call stack ends in ``spark.sql("SELECT udf(image)...")``).
+
+    The returned function accepts an Arrow ``Array``/``ChunkedArray``
+    (or a pandas ``Series``, returning a ``Series`` — the
+    ``pandas_udf`` calling convention) holding the UDF's input column —
+    image structs for ``kind="image"``, numeric/tensor rows for
+    ``kind="tensor"`` — and returns the model output as a
+    ``list<float>`` column. Execution routes through
+    ``ModelUDF.apply`` on a single-batch LocalEngine frame, so a SQL
+    call computes exactly what the pipeline transformers compute.
+    Cloudpickle-shippable: the ModelFunction drops process-local
+    jit/device caches on the wire (same contract as plan stages)."""
+    if outputMode != "vector":
+        raise ValueError(
+            "SQL UDF registration supports outputMode='vector' (a "
+            f"list<float> column); got {outputMode!r} — use the "
+            "Image/Tensor transformers for struct outputs")
+
+    def column_fn(col):
+        pandas_in = False
+        if isinstance(col, pa.ChunkedArray):
+            arr = col.combine_chunks()
+        elif isinstance(col, pa.Array):
+            arr = col
+        elif hasattr(col, "index") and hasattr(col, "columns"):
+            # pandas DataFrame: how pyspark hands a STRUCT column (the
+            # image struct) to a scalar pandas_udf — one frame column
+            # per struct field
+            pandas_in = True
+            tbl = pa.Table.from_pandas(col, preserve_index=False)
+            arr = pa.StructArray.from_arrays(
+                [tbl.column(i).combine_chunks()
+                 for i in range(tbl.num_columns)],
+                names=list(tbl.column_names))
+        elif hasattr(col, "index") and hasattr(col, "dtype"):
+            # pandas Series: scalar / list (tensor) columns
+            pandas_in = True
+            arr = pa.Array.from_pandas(col)
+        else:  # ndarray / sequence
+            arr = pa.array(col)
+        from sparkdl_tpu.data.frame import DataFrame
+        batch = pa.RecordBatch.from_arrays([arr], names=["__in__"])
+        frame = DataFrame.from_batches([batch])
+        out = model_udf.apply(frame, "__in__", "__out__",
+                              outputMode=outputMode)
+        res = out.collect().column("__out__").combine_chunks()
+        if pandas_in:
+            import pandas as pd
+            return pd.Series(res.to_pylist())
+        return res
+
+    return column_fn
+
+
+def register_udf(session, model_udf, name: str = None,
+                 outputMode: str = "vector") -> Callable:
+    """Register a ModelUDF as a named SQL function on a Spark session —
+    the catalog-registration half of the reference's ``makeGraphUDF``.
+
+    With real pyspark, the column function wraps in a ``pandas_udf``
+    returning ``array<float>`` and registers via
+    ``session.udf.register(name, ...)``, after which
+    ``spark.sql(f"SELECT {name}(col) FROM t")`` works. A duck-typed
+    session only needs ``udf.register(name, fn)`` — the contract tests
+    drive that seam, cloudpickle round-trips included. Returns the
+    registered callable."""
+    name = name or model_udf.name
+    column_fn = udf_to_column_fn(model_udf, outputMode=outputMode)
+    # wrap in pandas_udf only for a REAL SparkSession — keyed on the
+    # session's type, not pyspark importability, so duck-typed sessions
+    # keep the raw column function even where pyspark is installed
+    fn = column_fn
+    try:
+        from pyspark.sql import SparkSession
+        if isinstance(session, SparkSession):
+            from pyspark.sql.functions import pandas_udf
+            from pyspark.sql.types import ArrayType, FloatType
+            fn = pandas_udf(column_fn,
+                            returnType=ArrayType(FloatType()))
+    except ImportError:
+        pass
+    registrar = getattr(session, "udf", None)
+    if registrar is None or not hasattr(registrar, "register"):
+        raise TypeError(
+            "session does not expose udf.register(name, fn) — pass a "
+            "SparkSession (or a duck-typed session with that seam)")
+    registrar.register(name, fn)
+    return fn
+
+
 class SparkEngine:
     """Engine running partition plans as Spark tasks.
 

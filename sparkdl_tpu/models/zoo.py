@@ -233,11 +233,40 @@ def _imagenet_class_names() -> Dict[int, Tuple[str, str]]:
     return {i: (f"n{i:08d}", f"class_{i}") for i in range(1000)}
 
 
-def decode_predictions(logits: np.ndarray, top: int = 5):
+def load_class_index(path: str) -> Dict[int, Tuple[str, str]]:
+    """Read a class-index JSON (keras ``imagenet_class_index`` layout:
+    ``{"0": ["id", "name"], ...}``) into ``{idx: (id, name)}``."""
+    with open(path) as f:
+        raw = json.load(f)
+    return {int(k): tuple(v) for k, v in raw.items()}
+
+
+def model_class_index(name: str,
+                      fetcher: Optional[ModelFetcher] = None
+                      ) -> Optional[Dict[int, Tuple[str, str]]]:
+    """Class-index METADATA traveling with a model's weights:
+    ``<name>.class_index.json`` in the fetcher cache, else next to the
+    committed artifact (the reference's ``decode_predictions`` shipped
+    its imagenet index file the same way). None when the model has no
+    index — decoding then falls back to the ImageNet index."""
+    fileName = f"{name}.class_index.json"
+    fetcher = fetcher or ModelFetcher()
+    for directory in (fetcher.cache_dir, ARTIFACTS_DIR):
+        path = os.path.join(directory, fileName)
+        if os.path.exists(path):
+            return load_class_index(path)
+    return None
+
+
+def decode_predictions(logits: np.ndarray, top: int = 5,
+                       class_index: Optional[Dict[int, Tuple[str, str]]]
+                       = None):
     """logits/probs [N, C] → per-row list of (class_id, class_name,
-    score), best first."""
+    score), best first. ``class_index`` overrides the default ImageNet
+    index (see :func:`model_class_index`)."""
     logits = np.asarray(logits)
-    names = _imagenet_class_names()
+    names = class_index if class_index is not None \
+        else _imagenet_class_names()
     out = []
     for row in logits:
         idx = np.argsort(row)[::-1][:top]

@@ -72,7 +72,13 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
                          HasBatchSize, HasUseMesh, HasDeviceResizeFrom):
     """Image column → class scores of a named model; optionally decoded
     to top-K (class, description, score) rows (reference
-    ``DeepImagePredictor`` params ``decodePredictions``, ``topK``)."""
+    ``DeepImagePredictor`` params ``decodePredictions``, ``topK``).
+
+    Decoded class names resolve, in order: ``classIndexFile`` (a JSON
+    in keras ``imagenet_class_index`` layout), the model's own
+    class-index metadata (``<model>.class_index.json`` beside its
+    weights — the committed TestNet artifact ships one), then the
+    ImageNet index."""
 
     decodePredictions = Param("DeepImagePredictor", "decodePredictions",
                               "emit top-K decoded classes instead of the "
@@ -80,18 +86,25 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
                               TypeConverters.toBoolean)
     topK = Param("DeepImagePredictor", "topK", "how many classes to keep",
                  TypeConverters.toInt)
+    classIndexFile = Param("DeepImagePredictor", "classIndexFile",
+                           "class-index JSON overriding the model's "
+                           "own / the ImageNet index",
+                           TypeConverters.toString)
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  decodePredictions=False, topK=5, batchSize=64,
-                 useMesh=False, deviceResizeFrom=None):
+                 useMesh=False, deviceResizeFrom=None,
+                 classIndexFile=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5, batchSize=64,
-                         useMesh=False, deviceResizeFrom=None)
+                         useMesh=False, deviceResizeFrom=None,
+                         classIndexFile=None)
         self._set(inputCol=inputCol, outputCol=outputCol,
                   modelName=modelName, decodePredictions=decodePredictions,
                   topK=topK, batchSize=batchSize, useMesh=useMesh,
-                  deviceResizeFrom=deviceResizeFrom)
+                  deviceResizeFrom=deviceResizeFrom,
+                  classIndexFile=classIndexFile)
         self.metrics = None
 
     def _transform(self, dataset):
@@ -111,6 +124,9 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
             return result
 
         k = self.getOrDefault("topK")
+        index_file = self.getOrDefault("classIndexFile")
+        class_index = (zoo.load_class_index(index_file) if index_file
+                       else zoo.model_class_index(self.getModelName()))
         pred_type = pa.list_(pa.struct([
             pa.field("class", pa.string()),
             pa.field("description", pa.string()),
@@ -122,7 +138,8 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
             idx = batch.schema.get_field_index(raw_col)
             logits = arrow_to_tensor(batch.column(idx),
                                      batch.schema.field(idx))
-            decoded = zoo.decode_predictions(logits, top=k)
+            decoded = zoo.decode_predictions(logits, top=k,
+                                             class_index=class_index)
             rows = [[{"class": c, "description": d, "score": s}
                      for (c, d, s) in row] for row in decoded]
             batch = batch.remove_column(idx)

@@ -69,13 +69,18 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
                           input_size: Optional[Tuple[int, int]] = None,
                           batch_size: int = 64,
                           register: bool = True,
-                          replace: bool = False) -> ModelUDF:
+                          replace: bool = False,
+                          session=None) -> ModelUDF:
     """Register a Keras model (object or ``.h5``/``.keras`` path) as a
     named image UDF.
 
     Returns the :class:`ModelUDF`; apply it with
     ``callUDF(udf_name, df, "image", "out")`` or ``udf.apply(...)`` —
     the reference's ``spark.sql("SELECT udf(image) ...")`` analogue.
+    Passing ``session=`` additionally registers it as a named SQL
+    function on that Spark session
+    (:func:`sparkdl_tpu.data.spark_binding.register_udf`), completing
+    the reference's ``spark.sql("SELECT udf(image) FROM t")`` flow.
     """
     from sparkdl_tpu.graph.ingest import ModelIngest
 
@@ -86,6 +91,10 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
 
     composed = _composed_image_fn(model_mf, preprocessor, input_size,
                                   name=f"udf:{udf_name}")
-    return makeModelUDF(composed, udf_name, kind="image",
-                        batch_size=batch_size, register=register,
-                        replace=replace)
+    udf = makeModelUDF(composed, udf_name, kind="image",
+                       batch_size=batch_size, register=register,
+                       replace=replace)
+    if session is not None:
+        from sparkdl_tpu.data.spark_binding import register_udf
+        register_udf(session, udf)
+    return udf

@@ -334,6 +334,223 @@ def test_spark_engine_prefers_toLocalIterator_over_collect():
     assert out.column("x").to_pylist() == list(np.arange(8.0))
 
 
+class _SizeRecordingSession:
+    """Duck session that records each task's result-payload size — the
+    observable proof of WHERE data was produced: a task that writes its
+    part inside the executor returns a tiny summary, one that ships its
+    batch to the driver returns megabytes."""
+
+    def __init__(self):
+        self.result_sizes = []
+        outer = self
+
+        class _RDD(_FakeRDD):
+            def map(self, fn):
+                return _RDD(super().map(fn).items)
+
+            def collect(self):
+                out = [f(i) for f, i in self.items]
+                outer.result_sizes.extend(len(r) for r in out)
+                return out
+
+        class Ctx:
+            def parallelize(self, seq, n):
+                assert n == len(list(seq))
+                return _RDD(seq)
+
+        self.sparkContext = Ctx()
+
+
+class TestExecutorSideParquetWrite:
+    """VERDICT r3 #8: part files are written inside tasks (executors on
+    SparkEngine); the driver only commits summaries + _SUCCESS."""
+
+    def test_parts_written_inside_tasks(self, tmp_path):
+        n_rows = 20_000
+        table = pa.table({"x": np.arange(float(n_rows)),
+                          "s": ["wide-payload-" * 8] * n_rows})
+        session = _SizeRecordingSession()
+        df = DataFrame.from_table(table, 4,
+                                  engine=SparkEngine(spark=session))
+        out = str(tmp_path / "pq")
+        df.write_parquet(out)
+
+        # every task's result is a summary, not the partition data
+        assert len(session.result_sizes) == 4
+        assert all(sz < 2_000 for sz in session.result_sizes), \
+            session.result_sizes
+        # the dataset itself is complete and ordered
+        back = DataFrame.read_parquet(out)
+        assert back.count() == n_rows
+        assert back.collect().column("x").to_pylist() == \
+            table.column("x").to_pylist()
+        import glob
+        import os
+        assert len(glob.glob(os.path.join(out, "*.parquet"))) == 4
+        assert not glob.glob(os.path.join(out, "_tmp*"))
+
+    def test_repeated_partitions_write_distinct_parts(self, tmp_path):
+        """with_partition_order repeats are legal; each occurrence must
+        commit its own part (identical logical index notwithstanding)."""
+        df = DataFrame.from_table(pa.table({"x": np.arange(6.0)}), 2)
+        rep = df.with_partition_order([1, 1, 0])
+        out = str(tmp_path / "pq")
+        rep.write_parquet(out)
+        back = DataFrame.read_parquet(out)
+        assert back.collect().column("x").to_pylist() == \
+            [3.0, 4.0, 5.0, 3.0, 4.0, 5.0, 0.0, 1.0, 2.0]
+
+
+class _FakeUDFRegistrar:
+    """The udf.register(name, fn) seam of a SparkSession, with a
+    SELECT-shaped invocation helper: sql_select pulls the named column
+    off an Arrow table and calls the registered function on it — the
+    shape of ``spark.sql(f"SELECT {name}(col) FROM t")`` — after
+    round-tripping the function through cloudpickle, the way Spark
+    ships a registered python UDF to its executors."""
+
+    def __init__(self):
+        self.registered = {}
+
+    def register(self, name, fn):
+        self.registered[name] = fn
+        return fn
+
+    def sql_select(self, name, table: pa.Table, col: str):
+        import cloudpickle
+        fn = cloudpickle.loads(cloudpickle.dumps(self.registered[name]))
+        return fn(table.column(col))
+
+
+class _FakeUDFSession:
+    def __init__(self):
+        self.udf = _FakeUDFRegistrar()
+
+
+class TestSqlUdfRegistration:
+    """VERDICT r3 missing #1: the reference's makeGraphUDF registered a
+    named Spark SQL function (SURVEY §3.5); register_udf is that seam —
+    contract-tested against the duck-typed session like SparkEngine."""
+
+    def _tensor_udf(self):
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.udf.registry import makeModelUDF
+        mf = ModelFunction.fromSingle(
+            lambda x: x.astype("float32") * 3.0, None,
+            input_shape=(4,), input_dtype=np.float32, name="triple")
+        return makeModelUDF(mf, "triple", kind="tensor", register=False)
+
+    def test_select_matches_model_udf_apply(self):
+        from sparkdl_tpu.data.spark_binding import register_udf
+
+        udf = self._tensor_udf()
+        session = _FakeUDFSession()
+        register_udf(session, udf)
+        assert "triple" in session.udf.registered
+
+        rows = [{"x": [float(i), 1.0, 2.0, 3.0]} for i in range(7)]
+        table = pa.table({"x": [r["x"] for r in rows]})
+        got = session.udf.sql_select("triple", table, "x")
+
+        frame = DataFrame.from_pylist(rows, num_partitions=2)
+        expected = udf.apply(frame, "x", "y").collect().column("y")
+        assert got.to_pylist() == expected.combine_chunks().to_pylist()
+
+    def test_pandas_series_convention(self):
+        """pandas_udf hands the function a pandas Series and expects a
+        Series back — the calling convention pyspark uses when the real
+        pandas_udf wrapper is unavailable in-env."""
+        import pandas as pd
+
+        from sparkdl_tpu.data.spark_binding import udf_to_column_fn
+
+        fn = udf_to_column_fn(self._tensor_udf())
+        s = pd.Series([[1.0, 2.0, 3.0, 4.0], [0.0, 0.0, 0.0, 0.5]])
+        out = fn(s)
+        assert isinstance(out, pd.Series)
+        np.testing.assert_allclose(out.iloc[0], [3.0, 6.0, 9.0, 12.0])
+        np.testing.assert_allclose(out.iloc[1], [0.0, 0.0, 0.0, 1.5])
+
+    def test_pandas_dataframe_struct_convention(self, image_dir):
+        """Real pyspark hands a STRUCT column (the image struct) to a
+        scalar pandas_udf as a pandas DataFrame (one column per field)
+        — the column fn must rebuild the struct array from it."""
+        import keras
+        import pandas as pd
+
+        from sparkdl_tpu.data.spark_binding import udf_to_column_fn
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.udf import registerKerasImageUDF, unregisterUDF
+
+        keras.utils.set_random_seed(6)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        udf = registerKerasImageUDF("pd_struct_udf", m)
+        try:
+            df = imageIO.readImages(image_dir, numPartitions=2,
+                                    dropImageFailures=True)
+            table = df.collect()
+            img = table.column("image").combine_chunks()
+            pdf = pd.DataFrame(img.to_pylist())  # pyspark's shape
+            fn = udf_to_column_fn(udf)
+            out = fn(pdf)
+            assert isinstance(out, pd.Series)
+            expected = udf.apply(df, "image", "p") \
+                .collect().column("p").combine_chunks()
+            np.testing.assert_allclose(
+                np.stack(out.tolist()),
+                np.stack(expected.to_pylist()), rtol=1e-5, atol=1e-6)
+        finally:
+            unregisterUDF("pd_struct_udf")
+
+    def test_image_udf_over_sql_seam(self, image_dir):
+        """The reference's headline flow: register a Keras image model,
+        SELECT it over an image-struct column — rows must equal the
+        pipeline transformer's output."""
+        import keras
+
+        from sparkdl_tpu.data.spark_binding import register_udf
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.udf import registerKerasImageUDF, unregisterUDF
+
+        keras.utils.set_random_seed(5)
+        m = keras.Sequential([
+            keras.layers.Input((12, 12, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        session = _FakeUDFSession()
+        udf = registerKerasImageUDF("sql_img_udf", m, session=session)
+        try:
+            df = imageIO.readImages(image_dir, numPartitions=2,
+                                    dropImageFailures=True)
+            table = df.collect()
+            got = session.udf.sql_select("sql_img_udf", table, "image")
+            expected = udf.apply(df, "image", "probs") \
+                .collect().column("probs")
+            np.testing.assert_allclose(
+                np.stack(got.to_pylist()),
+                np.stack(expected.combine_chunks().to_pylist()),
+                rtol=1e-5, atol=1e-6)
+        finally:
+            unregisterUDF("sql_img_udf")
+
+    def test_register_validates_session_and_mode(self):
+        from sparkdl_tpu.data.spark_binding import (
+            register_udf,
+            udf_to_column_fn,
+        )
+
+        udf = self._tensor_udf()
+        with pytest.raises(TypeError, match="udf.register"):
+            register_udf(object(), udf)
+        with pytest.raises(ValueError, match="vector"):
+            udf_to_column_fn(udf, outputMode="image")
+
+
 def test_spark_engine_with_index_uses_logical_identity():
     """A reordered frame's with_index stages must see each partition's
     pinned LOGICAL index on the Spark engine too, not the task position

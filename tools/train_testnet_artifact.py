@@ -101,6 +101,13 @@ def main() -> None:
             "held_out_accuracy": acc,
             "trained_by": "tools/train_testnet_artifact.py",
         }, f, indent=2)
+    # class-index metadata traveling with the weights (the dataset's
+    # classes are the fixed prototype patterns) — DeepImagePredictor's
+    # decodePredictions resolves names from this sidecar
+    with open(os.path.join(ARTIFACTS_DIR, "TestNet.class_index.json"),
+              "w") as f:
+        json.dump({str(i): [f"proto_{i}", f"prototype_{i}"]
+                   for i in range(10)}, f, indent=2)
     print(f"wrote {ARTIFACTS_DIR}/TestNet.msgpack (sha256 {digest[:12]}…)")
 
 
