@@ -20,8 +20,15 @@ Reports, in ONE JSON line (driver contract):
   (``host_fed_ceiling_ips_packed``) in proportion.
 * ``host_decode_ips`` — the fused decode→resize→pack reader
   (``readImagesPacked``, native libjpeg+OpenMP shim) measured on
-  synthesized JPEGs: proof the host decode stage outruns the device
-  featurize rate budgeted in SURVEY §6.
+  synthesized TEXTURED JPEGs (photo-like compressibility): proof the
+  host decode stage outruns the device featurize rate budgeted in
+  SURVEY §6.
+* ``value_pipeline`` — the FULL measured pipeline: JPEG files on disk
+  → fused native decode/resize/pack on engine host threads →
+  packed-uint8 ship → device-resized featurize, as one stream (the
+  north-star metric's true shape — it includes read+decode);
+  ``pipeline_bound_by`` names the stage (decode | link | compute)
+  whose own measured ceiling binds it.
 
 Separating these is the point (round-1 lesson): on a tunneled TPU the
 link moves ~10-35 MB/s, capping end-to-end at ~40-134 img/s regardless
@@ -68,32 +75,75 @@ def _probe_accelerator(timeout_s: int = 180) -> bool:
         return False
 
 
-def measure_host_decode(size=(299, 299), n_images: int = 64,
-                        src_hw=(375, 500)) -> float:
-    """images/sec through the fused decode→resize→pack reader on
-    synthesized JPEGs (tf_flowers-like source dims), best of 2 passes
-    (pass 1 also warms the page cache and builds the shim)."""
-    import os
+def measure_host_decode(size=(299, 299), n_images: int = 64) -> float:
+    """images/sec through the fused decode→resize→pack reader on a
+    TEXTURED corpus (photo-like ~2 bits/pixel; round-3's noise JPEGs
+    sat at ~7 bpp and understated throughput ~3× — VERDICT r3 weak #8),
+    best of 2 passes (pass 1 warms the page cache, builds the shim)."""
     import shutil
     import tempfile
 
-    from PIL import Image
-
     from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.utils.synth import write_textured_jpegs
 
     d = tempfile.mkdtemp(prefix="sparkdl_bench_decode_")
     try:
-        rng = np.random.default_rng(7)
-        for i in range(n_images):
-            arr = rng.integers(0, 255, size=src_hw + (3,), dtype=np.uint8)
-            Image.fromarray(arr, "RGB").save(
-                os.path.join(d, f"i{i:03d}.jpg"), quality=90)
+        write_textured_jpegs(d, n_images)
         df = imageIO.readImagesPacked(d, size, numPartitions=4)
         rates = []
         for _ in range(2):
             t0 = time.perf_counter()
             table = df.collect()
             rates.append(table.num_rows / (time.perf_counter() - t0))
+        return float(max(rates))
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def measure_pipeline(mf, packed_src, batch_size: int,
+                     n_images: int) -> float:
+    """THE full-pipeline headline (VERDICT r3 next #1): JPEG files on
+    disk → ``readImagesPacked(packed_src)`` (fused native
+    decode→resize→pack on engine host threads) → device-resized
+    featurize — ONE streamed pipeline, decode running ahead of device
+    dispatch (host stages parallelize across partitions while the
+    device stage serializes under the device lock). images/sec over the
+    whole corpus, single pass per repeat, best of 2 (pass 1 is
+    steady-state warmup for the jit + page cache)."""
+    import shutil
+    import tempfile
+
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.transformers.tensor_transform import TensorTransformer
+    from sparkdl_tpu.transformers.utils import deviceResizeModel, single_io
+    from sparkdl_tpu.utils.synth import write_textured_jpegs
+
+    d = tempfile.mkdtemp(prefix="sparkdl_bench_pipe_")
+    try:
+        write_textured_jpegs(d, n_images)
+        mf_packed = deviceResizeModel(mf, packed_src)
+        in_name, out_name = single_io(mf_packed)
+        t = TensorTransformer(modelFunction=mf_packed,
+                              inputMapping={"image": in_name},
+                              outputMapping={out_name: "features"},
+                              batchSize=batch_size)
+        # partitions sized to the device batch: a partition smaller
+        # than batch_size pads up to the static shape and ships the
+        # padding — 32-row partitions at batch 128 measured 130 img/s
+        # where 128-row partitions measure ~310 (sweep 2026-07-30)
+        parts = max(2, n_images // batch_size)
+        rates = []
+        for _ in range(2):
+            df = imageIO.readImagesPacked(d, packed_src,
+                                          numPartitions=parts)
+            out = t.transform(df)
+            n = 0
+            t0 = time.perf_counter()
+            for b in out.stream():
+                n += b.num_rows
+            elapsed = time.perf_counter() - t0
+            assert n == n_images, (n, n_images)
+            rates.append(n / elapsed)
         return float(max(rates))
     finally:
         shutil.rmtree(d, ignore_errors=True)
@@ -178,11 +228,26 @@ def main() -> None:
 
     host_decode_ips = measure_host_decode(
         n_images=64 if on_tpu else 24)
+    # the pipeline decodes at the PACKED size (cheaper resize/pack than
+    # 299²) — its decode ceiling must be measured at the same size
+    host_decode_ips_packed = measure_host_decode(
+        size=packed_src, n_images=64 if on_tpu else 24)
+
+    # the full-pipeline headline: disk → decode → pack → ship → featurize
+    pipeline_ips = measure_pipeline(mf, packed_src, batch_size,
+                                    n_images=256 if on_tpu else 24)
 
     image_mb = 299 * 299 * 3 / (1024.0 * 1024.0)  # uint8 NHWC on the wire
     packed_mb = packed_src[0] * packed_src[1] * 3 / (1024.0 * 1024.0)
     ceiling = link["h2d_MBps"] / image_mb
     ceiling_packed = link["h2d_MBps"] / packed_mb
+    # which stage's own ceiling binds the measured pipeline: the
+    # smallest of (host decode rate at the pipeline's size, packed link
+    # ceiling, device compute rate) is the constraint it runs against
+    stage_ceilings = {"decode": host_decode_ips_packed,
+                      "link": ceiling_packed,
+                      "compute": device["ips"]}
+    pipeline_bound_by = min(stage_ceilings, key=stage_ceilings.get)
     print(json.dumps({
         "metric": (f"images_per_sec_per_chip_inceptionv3_featurize"
                    f"[{platform}]"),
@@ -202,13 +267,25 @@ def main() -> None:
         "packed_src_hw": list(packed_src),
         "host_fed_ceiling_ips_packed": round(ceiling_packed, 1),
         "host_decode_ips": round(host_decode_ips, 1),
+        "host_decode_ips_packed": round(host_decode_ips_packed, 1),
+        "value_pipeline": round(pipeline_ips, 1),
+        "vs_baseline_pipeline": round(pipeline_ips / PER_CHIP_TARGET, 3),
+        "pipeline_bound_by": pipeline_bound_by,
+        "pipeline_stage_ceilings_ips": {
+            k: round(v, 1) for k, v in stage_ceilings.items()},
         "runner_strategy": runner.strategy,
-        "note": ("end-to-end is host-link-bound when value ~= "
-                 "host_fed_ceiling_ips; value_packed ships "
-                 "device-resized small uint8 (~4x fewer bytes/image); "
-                 "device_resident_ips is the chip's compute capability "
-                 "with transfers excluded; host_decode_ips is the fused "
-                 "JPEG decode-resize-pack reader"),
+        "note": ("value_pipeline is the full measured pipeline (JPEG "
+                 "files -> fused native decode/resize/pack on engine "
+                 "host threads -> ship packed uint8 -> device-resized "
+                 "featurize, ONE stream); pipeline_bound_by names the "
+                 "stage whose own ceiling binds it. On this 1-core "
+                 "host decode and ship-side host work serialize "
+                 "(1/decode + 1/ship ~= 1/pipeline); on a many-core "
+                 "host they overlap and the pipeline converges to the "
+                 "binding ceiling. value/value_packed feed pre-decoded "
+                 "arrays (transfer-only shapes); device_resident_ips "
+                 "is compute with transfers excluded; host_decode_ips "
+                 "uses a textured (photo-compressibility) corpus"),
     }))
 
 

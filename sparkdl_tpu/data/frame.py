@@ -271,6 +271,16 @@ class DataFrame:
             raise FileExistsError(
                 f"{path!r} already holds parquet part files; write to "
                 "a fresh directory (overwrite is never implicit)")
+        stale = glob.glob(os.path.join(path, "_tmp.*"))
+        if stale:
+            # staging leftovers: a concurrent writer, or a writer killed
+            # mid-stream. Refusing (not sweeping) is the safe call — a
+            # sweep would delete a LIVE concurrent writer's staged parts
+            raise FileExistsError(
+                f"{path!r} holds staging leftovers ({stale[0]}): "
+                "another write_parquet is in progress, or a previous "
+                "one was killed mid-stream — delete the _tmp.* "
+                "directory if no writer is running")
         staging = os.path.join(path, f"_tmp.{os.getpid()}")
         # bare makedirs: a second same-process writer racing into the
         # same path must fail HERE (FileExistsError), not interleave

@@ -176,13 +176,16 @@ def register_udf(session, model_udf, name: str = None,
     fn = column_fn
     try:
         from pyspark.sql import SparkSession
-        if isinstance(session, SparkSession):
-            from pyspark.sql.functions import pandas_udf
-            from pyspark.sql.types import ArrayType, FloatType
-            fn = pandas_udf(column_fn,
-                            returnType=ArrayType(FloatType()))
+        is_spark = isinstance(session, SparkSession)
     except ImportError:
-        pass
+        is_spark = False
+    if is_spark:
+        # errors here (e.g. pyarrow missing/too old for pandas_udf)
+        # must PROPAGATE: silently registering the raw Series-convention
+        # function as a row-wise UDF would fail per-row at query time
+        from pyspark.sql.functions import pandas_udf
+        from pyspark.sql.types import ArrayType, FloatType
+        fn = pandas_udf(column_fn, returnType=ArrayType(FloatType()))
     registrar = getattr(session, "udf", None)
     if registrar is None or not hasattr(registrar, "register"):
         raise TypeError(

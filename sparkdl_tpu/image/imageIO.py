@@ -386,7 +386,8 @@ def readImages(imageDirectory: str, numPartitions: int = 8,
 def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                      nChannels: int = 3, numPartitions: int = 8,
                      dropImageFailures: bool = True,
-                     engine=None) -> DataFrame:
+                     engine=None,
+                     decodeThreads: Optional[int] = None) -> DataFrame:
     """Infeed fast path: read images directly into a fixed-size uint8
     tensor column ``image`` ([h, w, c] per row) — for pipelines that
     feed one model size, this fuses decode → resize → NHWC pack into a
@@ -395,18 +396,39 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     Consume with ``TensorTransformer(inputMapping={"image": ...})`` or a
     runner; ``readImages`` remains the general (original-size, image
     struct) reader.
+
+    ``decodeThreads``: OpenMP threads per partition's native call.
+    ``None`` divides the EXECUTING host's cores by the partitions that
+    can run concurrently there — engine host threads (and Spark task
+    slots) already parallelize partitions, so the naive OpenMP default
+    (all cores) would run cores² decode threads and thrash. Computed
+    inside the stage, so on a cluster each executor uses its own core
+    count, not the driver's. 0 = OpenMP default (use when partitions
+    run one-at-a-time on the executing host, e.g. a dedicated decode
+    box or the one-task-per-executor accelerator config).
     """
     height, width = int(size[0]), int(size[1])
     paths = listImageFiles(imageDirectory)
     df = filesToDF(paths, numPartitions=numPartitions, engine=engine)
+    actual_parts = df.num_partitions  # filesToDF clamps to len(paths)
 
     def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+        import os as _os
+
         from sparkdl_tpu.data.tensors import append_tensor_column
         fp = batch.column(0).to_pylist()
         blobs = batch.column(1).to_pylist()
         n = len(blobs)
         out = np.zeros((n, height, width, nChannels), np.uint8)
         ok = np.zeros(n, bool)
+
+        if decodeThreads is None:
+            # EXECUTING host's cores ÷ partitions that can run here
+            # concurrently (engine pools cap at the core count)
+            cores = _os.cpu_count() or 1
+            nt = max(1, cores // max(1, min(actual_parts, cores)))
+        else:
+            nt = decodeThreads
 
         jpeg_idx = [i for i, b in enumerate(blobs)
                     if isinstance(b, (bytes, bytearray))
@@ -417,7 +439,7 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                 from sparkdl_tpu import native
                 fused = native.decode_resize_pack(
                     [blobs[i] for i in jpeg_idx], height, width,
-                    nChannels)
+                    nChannels, num_threads=nt)
             except Exception:
                 fused = None
         if fused is not None:

@@ -37,15 +37,27 @@ class ModelFetcher:
     def has(self, fileName: str) -> bool:
         return os.path.exists(self._path(fileName))
 
+    def _commit(self, fileName: str, blob: bytes, digest: str) -> None:
+        """Atomic cache commit: sidecar first, then the blob renamed
+        into place — a crash can leave an orphan sidecar (harmless) but
+        never a blob without its hash (which get() would load
+        unverified when no explicit hash is passed)."""
+        os.makedirs(self.cache_dir, exist_ok=True)
+        path = self._path(fileName)
+        side_tmp = f"{path}.sha256.tmp.{os.getpid()}"
+        with open(side_tmp, "w") as f:
+            f.write(digest)
+        os.replace(side_tmp, path + ".sha256")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
     def put(self, fileName: str, params: Any) -> str:
         """Serialize a params pytree into the cache; returns its sha256."""
         blob = serialization.to_bytes(params)
-        os.makedirs(self.cache_dir, exist_ok=True)
-        with open(self._path(fileName), "wb") as f:
-            f.write(blob)
         digest = _sha256(blob)
-        with open(self._path(fileName) + ".sha256", "w") as f:
-            f.write(digest)
+        self._commit(fileName, blob, digest)
         return digest
 
     def get(self, fileName: str, template: Any,
@@ -75,7 +87,6 @@ class ModelFetcher:
         ``file://`` URLs work offline."""
         if not self.has(fileName):
             import urllib.request
-            os.makedirs(self.cache_dir, exist_ok=True)
             try:
                 with urllib.request.urlopen(url, timeout=30) as r:
                     blob = r.read()
@@ -85,9 +96,8 @@ class ModelFetcher:
                     "have no network egress; pre-seed the cache with "
                     "ModelFetcher.put() or use a file:// URL.") from e
             if _sha256(blob) != expected_sha256:
+                # nothing committed: a failed download must not poison
+                # the cache for the next attempt
                 raise IOError(f"downloaded {fileName} failed hash check")
-            with open(self._path(fileName), "wb") as f:
-                f.write(blob)
-            with open(self._path(fileName) + ".sha256", "w") as f:
-                f.write(expected_sha256)
+            self._commit(fileName, blob, expected_sha256)
         return self.get(fileName, template, expected_sha256)

@@ -133,6 +133,57 @@ class TestFetcher:
             f.getFromWeb("http://203.0.113.1/w.msgpack", "w.msgpack",
                          "0" * 64, {})
 
+    def test_getfromweb_mocked_transport_end_to_end(self, tmp_path,
+                                                    monkeypatch):
+        """VERDICT r3 weak #9: the download → hash-verify →
+        cache-commit path over a mocked transport. One fetch hits the
+        'network', commits blob+sidecar atomically, and loads; repeat
+        calls serve from cache without touching the transport; a
+        tampered payload fails the hash check and commits NOTHING."""
+        import contextlib
+        import hashlib
+        import io
+        import urllib.request
+
+        from flax import serialization
+
+        params = {"w": np.arange(4, dtype=np.float32)}
+        blob = serialization.to_bytes(params)
+        digest = hashlib.sha256(blob).hexdigest()
+        calls = []
+
+        def fake_urlopen(url, timeout=None):
+            calls.append(url)
+            payload = blob if "good" in url else blob[:-1] + b"\x00"
+            return contextlib.closing(io.BytesIO(payload))
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        f = ModelFetcher(cache_dir=str(tmp_path / "cache"))
+
+        back = f.getFromWeb("http://models.test/good.msgpack",
+                            "w.msgpack", digest,
+                            {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(back["w"], params["w"])
+        assert calls == ["http://models.test/good.msgpack"]
+        assert f.has("w.msgpack")
+        sidecar = tmp_path / "cache" / "w.msgpack.sha256"
+        assert sidecar.read_text().strip() == digest
+
+        # cache hit: the transport is not touched again
+        again = f.getFromWeb("http://models.test/good.msgpack",
+                             "w.msgpack", digest,
+                             {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(again["w"], params["w"])
+        assert len(calls) == 1
+
+        # tampered payload: named failure, no cache entry committed
+        with pytest.raises(IOError, match="hash check"):
+            f.getFromWeb("http://models.test/evil.msgpack",
+                         "evil.msgpack", digest,
+                         {"w": np.zeros(4, np.float32)})
+        assert not f.has("evil.msgpack")
+        assert not (tmp_path / "cache" / "evil.msgpack").exists()
+
     def test_getfromweb_file_url(self, tmp_path):
         import hashlib
         from flax import serialization
