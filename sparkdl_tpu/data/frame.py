@@ -89,6 +89,49 @@ class _DeferredSide:
             return self._batches[i]
 
 
+class _CoalescedGroup:
+    """One :meth:`DataFrame.coalesce` output partition: runs its input
+    partitions through the baked plan SEQUENTIALLY — via the owning
+    engine's retrying, device-locked ``_run_partition`` when it has one
+    (so device stages never run concurrently from multiple coalesced
+    loads) — and concatenates. Pickle-safe for Spark task shipping: the
+    engine is process-local and drops on the wire; a remote task
+    applies the plain stage contract."""
+
+    def __init__(self, engine, plan, sources, base_index, schema):
+        self._engine = engine
+        self._plan = list(plan)
+        self._sources = list(sources)
+        self._base = base_index
+        self._schema = schema
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_engine"] = None
+        return state
+
+    def _run_partition(self, s: "Source", j: int) -> pa.RecordBatch:
+        runner = getattr(self._engine, "_run_partition", None)
+        if runner is not None:
+            return runner(s, self._plan, j)
+        from sparkdl_tpu.data.spark_binding import apply_plan
+        idx = s.logical_index if s.logical_index is not None else j
+        return apply_plan(self._plan, s.load(), idx)
+
+    def load(self) -> pa.RecordBatch:
+        batches = []
+        for off, src in enumerate(self._sources):
+            b = self._run_partition(src, self._base + off)
+            if b.num_rows:
+                batches.append(b)
+        if not batches:
+            return pa.RecordBatch.from_pylist([], schema=self._schema)
+        if len(batches) == 1:
+            return batches[0]
+        return pa.Table.from_batches(batches).combine_chunks() \
+            .to_batches()[0]
+
+
 def column_index(data, name: str) -> int:
     """Resolve a column name to its index in a RecordBatch/Table/Schema,
     raising KeyError for unknown names (pyarrow's get_field_index
@@ -414,9 +457,51 @@ class DataFrame:
         return self.map_batches(_stage, name="filter", row_preserving=False)
 
     def repartition(self, num_partitions: int) -> "DataFrame":
-        """Materializes, then re-slices. Row order is preserved."""
+        """Materializes the WHOLE frame, then re-slices (Spark's
+        shuffle repartition; row order preserved). For reducing the
+        partition count of a larger-than-RAM frame use
+        :meth:`coalesce`, which never holds more than one output
+        partition."""
         return DataFrame.from_table(self.collect(), num_partitions,
                                     self._engine)
+
+    def coalesce(self, num_partitions: int) -> "DataFrame":
+        """Merge ADJACENT partitions down to ``num_partitions`` without
+        a global materialization (Spark ``coalesce(shuffle=False)``):
+        each output partition runs its group of input partitions
+        through the full plan ONE AT A TIME — through the engine's
+        retrying, device-locked partition runner, so device stages stay
+        serialized — and concatenates. Memory per in-flight output
+        partition is one group's rows (≈ total/num_partitions), and the
+        engine bounds in-flight partitions as usual; coalescing to very
+        FEW partitions therefore approaches full materialization — for
+        a larger-than-RAM re-layout use :meth:`write_parquet` or
+        :meth:`cache_to_disk` instead. Row order is preserved, and
+        ``with_index`` plan stages keep each input partition's own
+        logical identity, so deterministic stages like ``sample`` draw
+        exactly what they draw un-coalesced."""
+        n_out = max(1, min(int(num_partitions), len(self._sources)))
+        if n_out == len(self._sources):
+            return self
+        preserving = all(st.row_preserving for st in self._plan)
+        bounds = np.linspace(0, len(self._sources), n_out + 1).astype(int)
+        schema = self.schema  # capture the VALUE, not self (pickling)
+        sources = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            group = _CoalescedGroup(self._engine, self._plan,
+                                    self._sources[lo:hi], int(lo),
+                                    schema)
+            rows = (sum(s.num_rows for s in self._sources[lo:hi])
+                    if preserving and all(s.num_rows is not None
+                                          for s in self._sources[lo:hi])
+                    else None)
+            sources.append(Source(group.load, rows))
+        out = DataFrame(sources, engine=self._engine)
+        # pre-seeded: the coalesced frame's plan is empty and its load
+        # IS the baked plan, so the default zero-row probe would decode
+        # a whole GROUP just to answer .columns (cache_to_disk's trap)
+        out._schema = schema
+        return out
 
     def _materialize_prefix(self, n: int) -> "DataFrame":
         """First ``n`` FINAL rows as a 1-partition frame, streaming

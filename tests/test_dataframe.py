@@ -323,6 +323,87 @@ class TestJoin:
         assert out.column("tag").to_pylist() == ["one-y", "two-x"]
 
 
+class TestCoalesce:
+    def test_merges_preserving_order_and_plan(self):
+        calls = {"n": 0}
+
+        def counting(batch):
+            if batch.num_rows:
+                calls["n"] += 1
+            return batch
+
+        df = _df(40, 8).map_batches(counting, name="decode")
+        c = df.coalesce(3)
+        assert c.num_partitions == 3
+        assert c.count() == 40  # num_rows survives (row-preserving plan)
+        got = c.collect().column("x").to_pylist()
+        assert got == df.collect().column("x").to_pylist()
+        # the plan ran once per INPUT partition per materialization —
+        # coalescing composes, it doesn't re-run or collect globally
+        assert calls["n"] == 8 * 2  # c.collect() + df.collect()
+
+    def test_bounded_memory_no_global_collect(self, monkeypatch):
+        """Each output partition materializes only its own group —
+        streaming a coalesced frame never collects the whole table."""
+        df = _df(60, 6)
+        c = df.coalesce(2)
+        monkeypatch.setattr(DataFrame, "collect", lambda self: (_ for _ in ()).throw(
+            AssertionError("coalesce materialized the frame")))
+        try:
+            seen = [b.num_rows for b in c.stream()]
+        finally:
+            monkeypatch.undo()
+        assert sum(seen) == 60 and len(seen) == 2
+
+    def test_with_index_keeps_input_identity(self):
+        """sample() must draw identically coalesced or not — the plan
+        runs per INPUT partition with its logical index."""
+        df = _df(80, 8).sample(0.5, seed=9)
+        a = df.collect().column("x").to_pylist()
+        b = df.coalesce(3).collect().column("x").to_pylist()
+        assert a == b
+
+    def test_noop_and_clamp(self):
+        df = _df(10, 4)
+        assert df.coalesce(4) is df
+        assert df.coalesce(99) is df
+        assert df.coalesce(1).num_partitions == 1
+        assert df.coalesce(1).collect().column("x").to_pylist() == \
+            df.collect().column("x").to_pylist()
+
+    def test_schema_probe_decodes_nothing(self):
+        """.columns on a coalesced frame must come from the pre-seeded
+        schema — the load IS the baked plan over a whole group."""
+        loads = {"n": 0}
+
+        def counting(batch):
+            if batch.num_rows:
+                loads["n"] += 1
+            return batch
+
+        df = _df(20, 4).map_batches(counting, name="decode")
+        df.schema  # probe once on the UNcoalesced frame (zero-row)
+        loads["n"] = 0
+        c = df.coalesce(2)
+        assert c.columns == ["x", "s"]
+        assert loads["n"] == 0  # no group decoded to answer .columns
+
+    def test_ships_through_spark_engine(self):
+        """A coalesced frame's sources must survive Spark task
+        serialization (the group helper drops its engine on the wire)."""
+        from tests.test_spark_binding import _FakeSparkSession
+
+        from sparkdl_tpu.data.spark_binding import SparkEngine
+
+        df = _df(24, 6).filter_rows(np.arange(24.0) >= 4)
+        c = df.coalesce(2)
+        engine = SparkEngine(spark=_FakeSparkSession())
+        got = pa.Table.from_batches(
+            list(engine.execute(c._sources, c._plan)))
+        assert got.column("x").to_pylist() == \
+            df.collect().column("x").to_pylist()
+
+
 class TestParquetIO:
     def test_round_trip_with_tensor_columns(self, tmp_path):
         X = np.arange(40, dtype=np.float32).reshape(10, 4)

@@ -14,6 +14,7 @@ import pytest
     "examples/fast_infeed.py",
     "examples/export_deploy.py",
     "examples/save_load_pipeline.py",
+    "examples/out_of_core_tuning.py",
 ])
 def test_example_runs(script, capsys):
     runpy.run_path(script, run_name="__main__")
