@@ -85,7 +85,8 @@ class ClassificationEvaluator(Evaluator):
             raise ValueError(
                 f"metricName must be one of {_CLS_METRICS}, got "
                 f"{metric!r}")
-        conf = np.zeros((0, 0), np.int64)  # conf[pred, label]
+        conf: dict = {}  # (pred_id, label_id) -> count; SPARSE so
+        # large un-reindexed ids never allocate a dense id²-sized matrix
         scalar_preds, scalar_labels = [], []
         for preds, labels in _stream_pred_and_labels(
                 dataset, self.getOrDefault("predictionCol"),
@@ -103,8 +104,7 @@ class ClassificationEvaluator(Evaluator):
                 scalar_preds.append(preds)
                 scalar_labels.append(labels)
             else:
-                conf = _accumulate_confusion(conf, preds.argmax(-1),
-                                             labels)
+                _accumulate_confusion(conf, preds.argmax(-1), labels)
         if scalar_preds:
             preds = np.concatenate(scalar_preds)
             labels = np.concatenate(scalar_labels)
@@ -114,48 +114,44 @@ class ClassificationEvaluator(Evaluator):
                 pred_ids = preds.astype(np.int64)
             else:
                 pred_ids = (preds > 0.5).astype(np.int64)
-            conf = _accumulate_confusion(conf, pred_ids, labels)
+            _accumulate_confusion(conf, pred_ids, labels)
         return _metric_from_confusion(conf, metric)
 
 
-def _accumulate_confusion(conf: np.ndarray, pred_ids: np.ndarray,
-                          labels: np.ndarray) -> np.ndarray:
-    """Add one batch's (pred, label) pairs into ``conf[pred, label]``,
-    growing the matrix as new class ids appear."""
+def _accumulate_confusion(conf: dict, pred_ids: np.ndarray,
+                          labels: np.ndarray) -> None:
+    """Add one batch's (pred, label) pairs into the sparse
+    ``conf[(pred, label)]`` counts — vectorized per batch via a
+    pair-unique, with memory O(distinct pairs), never O(max_id²)."""
     if len(pred_ids) == 0:
-        return conf
-    lo = int(min(pred_ids.min(), labels.min()))
-    if lo < 0:
-        # negative ids would wrap around the matrix edge and silently
-        # corrupt counts — Spark ML class ids live in [0, C)
-        raise ValueError(
-            f"class ids must be >= 0, got {lo} (re-encode e.g. "
-            "{-1,1} labels to {0,1})")
-    hi = int(max(pred_ids.max(), labels.max())) + 1
-    if hi > conf.shape[0]:
-        grown = np.zeros((hi, hi), np.int64)
-        grown[:conf.shape[0], :conf.shape[1]] = conf
-        conf = grown
-    np.add.at(conf, (pred_ids, labels), 1)
-    return conf
+        return
+    pairs = np.stack([pred_ids, labels])
+    uniq, counts = np.unique(pairs, axis=1, return_counts=True)
+    for p, l, c in zip(uniq[0].tolist(), uniq[1].tolist(),
+                       counts.tolist()):
+        conf[(p, l)] = conf.get((p, l), 0) + c
 
 
-def _metric_from_confusion(conf: np.ndarray, metric: str) -> float:
-    """Support-weighted precision / recall / f1 (or accuracy) from a
-    ``conf[pred, label]`` matrix — pyspark semantics: each class present
-    in the labels contributes weighted by its true count; a class never
-    predicted contributes precision 0."""
-    total = int(conf.sum())
+def _metric_from_confusion(conf: dict, metric: str) -> float:
+    """Support-weighted precision / recall / f1 (or accuracy) from
+    sparse ``conf[(pred, label)]`` counts — pyspark semantics: each
+    class present in the labels contributes weighted by its true count;
+    a class never predicted contributes precision 0."""
+    total = sum(conf.values())
     if total == 0:
         return 0.0
     if metric == "accuracy":
-        return float(np.trace(conf) / total)
+        correct = sum(c for (p, l), c in conf.items() if p == l)
+        return float(correct / total)
+    pred_totals: dict = {}
+    label_totals: dict = {}
+    for (p, l), c in conf.items():
+        pred_totals[p] = pred_totals.get(p, 0) + c
+        label_totals[l] = label_totals.get(l, 0) + c
     out = 0.0
-    for c in np.flatnonzero(conf.sum(axis=0)):  # classes in the labels
-        tp = float(conf[c, c])
-        fp = float(conf[c, :].sum() - tp)
-        fn = float(conf[:, c].sum() - tp)
-        support = tp + fn
+    for c_id, support in label_totals.items():  # classes in the labels
+        tp = float(conf.get((c_id, c_id), 0))
+        fp = float(pred_totals.get(c_id, 0)) - tp
         precision = tp / (tp + fp) if tp + fp else 0.0
         recall = tp / support if support else 0.0
         if metric == "weightedPrecision":
@@ -287,8 +283,8 @@ class BinaryClassificationEvaluator(Evaluator):
                                          minlength=len(uniq)))
         if not uniq_parts:
             raise ValueError(
-                "AUC is undefined with a single class present "
-                "(0 positives / 0 negatives)")
+                "cannot evaluate an empty scored frame (0 rows — e.g. "
+                "a validation fold that filtered every row out)")
         merged, inv = np.unique(np.concatenate(uniq_parts),
                                 return_inverse=True)
         pos_g = np.bincount(inv, weights=np.concatenate(pos_parts),
@@ -384,8 +380,18 @@ class LossEvaluator(Evaluator):
                     "probability vector column (e.g. 'probability')")
             p = np.clip(preds, 1e-7, 1.0 - 1e-7)
             if labels.ndim == 1:
-                picked = p[np.arange(len(labels)),
-                           labels.astype(np.int64)]
+                ids = labels.astype(np.int64)
+                if len(ids) and (ids.min() < 0
+                                 or ids.max() >= p.shape[-1]):
+                    # negative ids would wrap to the LAST class and
+                    # return a plausible-looking loss (the scalar
+                    # branch's twin guard)
+                    raise ValueError(
+                        f"labels must be class ids in [0, "
+                        f"{p.shape[-1]}); got "
+                        f"[{ids.min()}, {ids.max()}] (re-encode e.g. "
+                        "{-1,1} labels to {0,1})")
+                picked = p[np.arange(len(ids)), ids]
             else:
                 picked = np.sum(p * labels, axis=-1)
             total += float(-np.log(picked).sum())

@@ -323,8 +323,8 @@ class LogisticRegression(Estimator, HasLabelCol):
         from sparkdl_tpu.data.tensors import arrow_to_tensor
 
         label_col = self.getLabelCol()
-        n_classes = int(self.getOrDefault("numClasses"))
-        if n_classes <= 0:
+        declared = int(self.getOrDefault("numClasses"))
+        if declared <= 0:
             # labels-only pass: one int per row in memory, never
             # features (documented: runs the upstream plan once)
             seen = -1
@@ -335,7 +335,11 @@ class LogisticRegression(Estimator, HasLabelCol):
                     seen = max(seen, int(y.max()))
             if seen < 0:
                 raise ValueError("cannot fit on an empty dataset")
-            n_classes = max(seen + 1, 2)
+            declared = seen + 1
+        # widen a 1-class declaration exactly like the collected path
+        # (softmax over one class is constant — zero gradient, silent
+        # no-op training); range checks below stay against `declared`
+        n_classes = max(declared, 2)
         eye = np.eye(n_classes, dtype=np.float32)
 
         reg = float(self.getOrDefault("regParam"))
@@ -407,10 +411,10 @@ class LogisticRegression(Estimator, HasLabelCol):
                 y = self._clean_labels(np.asarray(
                     batch.column(column_index(batch, label_col))
                     .to_pylist()))
-                if len(y) and int(y.max()) >= n_classes:
+                if len(y) and int(y.max()) >= declared:
                     raise ValueError(
                         f"label {int(y.max())} out of range for "
-                        f"numClasses={n_classes}")
+                        f"numClasses={declared}")
                 ys = eye[y]
                 perm = rng.permutation(len(xs))
                 parts.append((xs[perm], ys[perm], 0))

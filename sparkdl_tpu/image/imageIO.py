@@ -411,6 +411,13 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     paths = listImageFiles(imageDirectory)
     df = filesToDF(paths, numPartitions=numPartitions, engine=engine)
     actual_parts = df.num_partitions  # filesToDF clamps to len(paths)
+    # engine-side concurrency hint when the engine exposes one
+    # (LocalEngine runs in-process, so its worker cap IS the number of
+    # partitions decoding at once); engines without the attribute fall
+    # back to the executing host's core count — conservative on Spark
+    # (1 thread/task when partitions >= cores, the standard many-task
+    # layout; pass decodeThreads explicitly for few-big-task setups)
+    workers_hint = getattr(df._engine, "num_workers", None)
 
     def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
         import os as _os
@@ -424,9 +431,11 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
 
         if decodeThreads is None:
             # EXECUTING host's cores ÷ partitions that can run here
-            # concurrently (engine pools cap at the core count)
+            # concurrently
             cores = _os.cpu_count() or 1
-            nt = max(1, cores // max(1, min(actual_parts, cores)))
+            concurrent = min(actual_parts,
+                             workers_hint if workers_hint else cores)
+            nt = max(1, cores // max(1, concurrent))
         else:
             nt = decodeThreads
 

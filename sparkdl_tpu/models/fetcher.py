@@ -38,10 +38,14 @@ class ModelFetcher:
         return os.path.exists(self._path(fileName))
 
     def _commit(self, fileName: str, blob: bytes, digest: str) -> None:
-        """Atomic cache commit: sidecar first, then the blob renamed
-        into place — a crash can leave an orphan sidecar (harmless) but
-        never a blob without its hash (which get() would load
-        unverified when no explicit hash is passed)."""
+        """Cache commit, sidecar first then blob, each via tmp+rename.
+        The ordering's invariant: a blob can never exist without SOME
+        sidecar (which get() would load unverified when no explicit
+        hash is passed). A crash committing a FRESH entry leaves only
+        an orphan sidecar (harmless: has() is false). A crash
+        OVERWRITING an entry can leave old-blob + new-sidecar — get()
+        then fails LOUDLY with the hash-mismatch error naming the
+        remedy; failing closed beats loading unverified bytes."""
         os.makedirs(self.cache_dir, exist_ok=True)
         path = self._path(fileName)
         side_tmp = f"{path}.sha256.tmp.{os.getpid()}"

@@ -18,25 +18,35 @@ from sparkdl_tpu.params.base import Param, Params, TypeConverters, keyword_only
 from sparkdl_tpu.params.pipeline import Estimator, Evaluator, Model
 
 
-def _fold_split(dataset, k: int, fold: int, seed: int, keep_train: bool):
-    """Fold membership as a PLAN STAGE: each partition draws its rows'
-    fold ids from a generator seeded by (seed, partition index), so
-    membership is deterministic per row across re-materializations and
-    across the train/valid pair — without ever knowing the global row
-    count. This is what lets CV/TVS run over a disk spill instead of a
+def _seeded_split(dataset, seed: int, name: str, draw, keep_a: bool):
+    """Membership as a PLAN STAGE: each partition draws a boolean
+    "side A" mask from a generator seeded by (seed, partition logical
+    index) via ``draw(rng, n_rows)``, so membership is deterministic
+    per row across re-materializations, and the two sides (``keep_a``
+    True/False) recompute the identical draw — disjoint and covering
+    by construction, without ever knowing the global row count. This
+    single helper carries that invariant for BOTH CV folds and the TVS
+    split; it is what lets tuning run over a disk spill instead of a
     collected table (VERDICT r3 missing #4): no stage here holds more
     than one partition batch."""
     import pyarrow as pa
 
     def _stage(batch: "pa.RecordBatch", index: int) -> "pa.RecordBatch":
         rng = np.random.default_rng((seed, index))
-        assign = rng.integers(0, k, size=batch.num_rows)
-        keep = (assign != fold) if keep_train else (assign == fold)
-        return batch.filter(pa.array(keep))
+        side_a = draw(rng, batch.num_rows)
+        return batch.filter(pa.array(side_a if keep_a else ~side_a))
 
-    side = "train" if keep_train else "valid"
-    return dataset.map_batches(_stage, name=f"fold{fold}/{side}",
+    return dataset.map_batches(_stage, name=name,
                                row_preserving=False, with_index=True)
+
+
+def _fold_split(dataset, k: int, fold: int, seed: int, keep_train: bool):
+    """CV fold membership over :func:`_seeded_split`: rows drawing fold
+    id != ``fold`` are the train side."""
+    side = "train" if keep_train else "valid"
+    return _seeded_split(
+        dataset, seed, f"fold{fold}/{side}",
+        lambda rng, n: rng.integers(0, k, size=n) != fold, keep_train)
 
 
 def _cached_for_tuning(dataset, cache_dir):
@@ -222,27 +232,17 @@ class TrainValidationSplit(Estimator):
                   cacheDir=cacheDir)
 
     def _split(self, dataset):
-        """(train, valid) as lazy plan-stage filters: a per-partition
+        """(train, valid) via :func:`_seeded_split`: a per-partition
         seeded coin decides each row's side; both frames recompute the
         identical draw, so they are disjoint and covering."""
-        import pyarrow as pa
         ratio = self.getOrDefault("trainRatio")
         seed = self.getOrDefault("seed")
 
-        def make(keep_train: bool):
-            def _stage(batch: "pa.RecordBatch", index: int
-                       ) -> "pa.RecordBatch":
-                rng = np.random.default_rng((seed, index))
-                is_train = rng.random(batch.num_rows) < ratio
-                keep = is_train if keep_train else ~is_train
-                return batch.filter(pa.array(keep))
+        def draw(rng, n):
+            return rng.random(n) < ratio
 
-            side = "train" if keep_train else "valid"
-            return dataset.map_batches(_stage, name=f"split/{side}",
-                                       row_preserving=False,
-                                       with_index=True)
-
-        return make(True), make(False)
+        return (_seeded_split(dataset, seed, "split/train", draw, True),
+                _seeded_split(dataset, seed, "split/valid", draw, False))
 
     def _fit(self, dataset) -> TrainValidationSplitModel:
         est: Estimator = self.getOrDefault("estimator")

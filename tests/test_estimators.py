@@ -536,6 +536,41 @@ class TestEvaluators:
         assert loss.evaluate(multi) == pytest.approx(
             loss.evaluate(single))
 
+    def test_sparse_large_class_ids(self):
+        """Confusion statistics are SPARSE: metrics on un-reindexed ids
+        (e.g. raw entity ids in the millions) must compute in
+        O(distinct), not allocate a dense max_id² matrix."""
+        import pyarrow as pa
+
+        labels = [0, 1_000_000, 1_000_000, 0]
+        preds = [0.0, 1_000_000.0, 0.0, 0.0]
+        df = DataFrame.from_batches([pa.RecordBatch.from_pylist(
+            [{"label": l, "prediction": p}
+             for l, p in zip(labels, preds)])])
+        ev = ClassificationEvaluator(predictionCol="prediction",
+                                     labelCol="label")
+        assert ev.evaluate(df) == pytest.approx(3 / 4)
+        f1 = ClassificationEvaluator(predictionCol="prediction",
+                                     labelCol="label",
+                                     metricName="f1").evaluate(df)
+        # class 0: tp=2 fp=1 fn=0 → P=2/3 R=1 F1=0.8 (support 2)
+        # class 1e6: tp=1 fp=0 fn=1 → P=1 R=.5 F1=2/3 (support 2)
+        assert f1 == pytest.approx((0.8 * 2 + (2 / 3) * 2) / 4)
+
+    def test_loss_evaluator_rejects_negative_vector_labels(self):
+        """{-1,1}-convention labels against an (N,C) probability column
+        must raise, not wrap to the last class (the scalar branch's
+        twin guard)."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.tensors import append_tensor_column
+
+        probs = np.array([[0.7, 0.3], [0.2, 0.8]], np.float32)
+        b = pa.RecordBatch.from_pylist([{"label": -1}, {"label": 1}])
+        b = append_tensor_column(b, "probability", probs)
+        with pytest.raises(ValueError, match="re-encode"):
+            LossEvaluator().evaluate(DataFrame.from_batches([b]))
+
     def test_evaluators_never_collect(self, monkeypatch):
         """Scoring streams partition batches — a full-table collect of
         the scored frame (prediction vectors + every column) is the

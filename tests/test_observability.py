@@ -240,6 +240,22 @@ class TestLogisticRegression:
             LogisticRegression(maxIter=2, batchSize=16, streaming=True,
                                numClasses=1).fit(df)
 
+    def test_streaming_num_classes_one_widens_like_inmemory(self):
+        """numClasses=1 over single-class data: both paths widen to a
+        2-class head (1-class softmax is constant — zero gradient,
+        silent no-op training) instead of diverging."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.tensors import append_tensor_column
+        rng = np.random.default_rng(0)
+        X = rng.normal(0, 1, (24, 3)).astype(np.float32)
+        b = pa.RecordBatch.from_pylist([{"label": 0}] * 24)
+        b = append_tensor_column(b, "features", X)
+        df = DataFrame.from_batches([b])
+        for kw in ({"streaming": True, "batchSize": 8}, {}):
+            m = LogisticRegression(maxIter=2, numClasses=1, **kw).fit(df)
+            assert m.coefficients.shape == (3, 2), kw
+
     def test_batchsize_geq_n_falls_back_to_full_batch(self):
         df, X, y = self._df(n=30)
         m = LogisticRegression(maxIter=50, learningRate=0.2,
