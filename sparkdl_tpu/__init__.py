@@ -13,7 +13,7 @@ lazily so importing the package doesn't pull jax/keras until a symbol is
 touched.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 _EXPORTS = {
     "imageSchema": ("sparkdl_tpu.image.imageIO", "imageSchema"),
@@ -42,6 +42,8 @@ _EXPORTS = {
                            "LogisticRegression"),
     "registerKerasImageUDF": ("sparkdl_tpu.udf.keras_image_model",
                               "registerKerasImageUDF"),
+    # SQL-catalog seam (reference makeGraphUDF's registration half)
+    "register_udf": ("sparkdl_tpu.data.spark_binding", "register_udf"),
     "DataFrame": ("sparkdl_tpu.data.frame", "DataFrame"),
     "Pipeline": ("sparkdl_tpu.params.pipeline", "Pipeline"),
     "CrossValidator": ("sparkdl_tpu.params.tuning", "CrossValidator"),
