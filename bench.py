@@ -23,10 +23,15 @@ Reports, in ONE JSON line (driver contract):
   synthesized TEXTURED JPEGs (photo-like compressibility): proof the
   host decode stage outruns the device featurize rate budgeted in
   SURVEY §6.
+* ``value_packed420`` / ``host_fed_ceiling_ips_packed420`` — the
+  payload halved again (VERDICT r4 next #1): planar YCbCr 4:2:0 at
+  1.5 B/px shipped, chroma upsample + BT.601 reconstruction + resize
+  fused on-device (``packedFormat="yuv420"``).
 * ``value_pipeline`` — the FULL measured pipeline: JPEG files on disk
-  → fused native decode/resize/pack on engine host threads →
-  packed-uint8 ship → device-resized featurize, as one stream (the
-  north-star metric's true shape — it includes read+decode);
+  → fused native decode/resize/pack (4:2:0 planes, standard sources
+  stream out of libjpeg raw) on engine host threads → ship →
+  device-reconstructed featurize, as one stream (the north-star
+  metric's true shape — it includes read+decode);
   ``pipeline_bound_by`` names the stage (decode | link | compute)
   whose own measured ceiling binds it.
 
@@ -75,7 +80,8 @@ def _probe_accelerator(timeout_s: int = 180) -> bool:
         return False
 
 
-def measure_host_decode(size=(299, 299), n_images: int = 64) -> float:
+def measure_host_decode(size=(299, 299), n_images: int = 64,
+                        packedFormat: str = "rgb") -> float:
     """images/sec through the fused decode→resize→pack reader on a
     TEXTURED corpus (photo-like ~2 bits/pixel; round-3's noise JPEGs
     sat at ~7 bpp and understated throughput ~3× — VERDICT r3 weak #8),
@@ -89,7 +95,8 @@ def measure_host_decode(size=(299, 299), n_images: int = 64) -> float:
     d = tempfile.mkdtemp(prefix="sparkdl_bench_decode_")
     try:
         write_textured_jpegs(d, n_images)
-        df = imageIO.readImagesPacked(d, size, numPartitions=4)
+        df = imageIO.readImagesPacked(d, size, numPartitions=4,
+                                      packedFormat=packedFormat)
         rates = []
         for _ in range(2):
             t0 = time.perf_counter()
@@ -101,7 +108,7 @@ def measure_host_decode(size=(299, 299), n_images: int = 64) -> float:
 
 
 def measure_pipeline(mf, packed_src, batch_size: int,
-                     n_images: int) -> float:
+                     n_images: int, packedFormat: str = "rgb") -> float:
     """THE full-pipeline headline (VERDICT r3 next #1): JPEG files on
     disk → ``readImagesPacked(packed_src)`` (fused native
     decode→resize→pack on engine host threads) → device-resized
@@ -121,7 +128,8 @@ def measure_pipeline(mf, packed_src, batch_size: int,
     d = tempfile.mkdtemp(prefix="sparkdl_bench_pipe_")
     try:
         write_textured_jpegs(d, n_images)
-        mf_packed = deviceResizeModel(mf, packed_src)
+        mf_packed = deviceResizeModel(mf, packed_src,
+                                      packedFormat=packedFormat)
         in_name, out_name = single_io(mf_packed)
         t = TensorTransformer(modelFunction=mf_packed,
                               inputMapping={"image": in_name},
@@ -135,7 +143,8 @@ def measure_pipeline(mf, packed_src, batch_size: int,
         rates = []
         for _ in range(2):
             df = imageIO.readImagesPacked(d, packed_src,
-                                          numPartitions=parts)
+                                          numPartitions=parts,
+                                          packedFormat=packedFormat)
             out = t.transform(df)
             n = 0
             t0 = time.perf_counter()
@@ -188,43 +197,51 @@ def main() -> None:
     device = measure_device_resident(mf, batch_size,
                                      n_batches=16 if on_tpu else 2)
 
+    def time_runner(runner, images, batch_size):
+        """Warmup, then median of 3 full passes: the tunneled link's
+        throughput varies several-x between minutes; the median is
+        robust to one contended pass without overstating sustained
+        throughput."""
+        n = len(images)
+        runner.run({"image": images[:batch_size]})  # steady-state warmup
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = runner.run({"image": images})
+            elapsed = time.perf_counter() - t0
+            assert out["features"].shape == (n, 2048), \
+                out["features"].shape
+            rates.append(n / elapsed)
+        return float(np.median(rates))
+
     rng = np.random.default_rng(0)
     images = rng.integers(0, 255, size=(n_rows, 299, 299, 3),
                           dtype=np.uint8)
     runner = BatchRunner(mf, batch_size=batch_size)
-    runner.run({"image": images[:batch_size]})  # steady-state warmup
+    e2e_ips = time_runner(runner, images, batch_size)
 
-    # Median of 3 passes: the tunneled link's throughput varies
-    # several-x between minutes; the median is robust to one contended
-    # pass without overstating sustained throughput.
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = runner.run({"image": images})
-        elapsed = time.perf_counter() - t0
-        assert out["features"].shape == (n_rows, 2048), \
-            out["features"].shape
-        rates.append(n_rows / elapsed)
-    e2e_ips = float(np.median(rates))
-
-    # packed path: ship small uint8, resize on device (fused). The only
+    # packed path: ship small uint8, resize on device (fused). The big
     # in-env lever on the link-bound headline — bytes/image shrinks
     # (150²/299²≈¼) so the ceiling and the measured value lift together.
     from sparkdl_tpu.transformers.utils import deviceResizeModel
     packed_src = (150, 150)
-    runner_packed = BatchRunner(deviceResizeModel(mf, packed_src),
-                                batch_size=batch_size)
     images_small = rng.integers(
         0, 255, size=(n_rows,) + packed_src + (3,), dtype=np.uint8)
-    runner_packed.run({"image": images_small[:batch_size]})  # warmup
-    rates_packed = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = runner_packed.run({"image": images_small})
-        elapsed = time.perf_counter() - t0
-        assert out["features"].shape == (n_rows, 2048)
-        rates_packed.append(n_rows / elapsed)
-    packed_ips = float(np.median(rates_packed))
+    packed_ips = time_runner(
+        BatchRunner(deviceResizeModel(mf, packed_src),
+                    batch_size=batch_size),
+        images_small, batch_size)
+
+    # 4:2:0 packed path (VERDICT r4 next #1): planar YCbCr payload at
+    # 1.5 B/px — HALF the RGB packed bytes — reconstructed+resized on
+    # device fused into the model program.
+    from sparkdl_tpu.image.imageIO import rgbToYuv420
+    packed_420 = np.stack([rgbToYuv420(im) for im in images_small])
+    packed420_ips = time_runner(
+        BatchRunner(deviceResizeModel(mf, packed_src,
+                                      packedFormat="yuv420"),
+                    batch_size=batch_size),
+        packed_420, batch_size)
 
     host_decode_ips = measure_host_decode(
         n_images=64 if on_tpu else 24)
@@ -232,20 +249,27 @@ def main() -> None:
     # 299²) — its decode ceiling must be measured at the same size
     host_decode_ips_packed = measure_host_decode(
         size=packed_src, n_images=64 if on_tpu else 24)
+    host_decode_ips_420 = measure_host_decode(
+        size=packed_src, n_images=64 if on_tpu else 24,
+        packedFormat="yuv420")
 
-    # the full-pipeline headline: disk → decode → pack → ship → featurize
+    # the full-pipeline headline: disk → decode → pack(4:2:0) → ship →
+    # device reconstruct+resize+featurize, one stream
     pipeline_ips = measure_pipeline(mf, packed_src, batch_size,
-                                    n_images=256 if on_tpu else 24)
+                                    n_images=256 if on_tpu else 24,
+                                    packedFormat="yuv420")
 
     image_mb = 299 * 299 * 3 / (1024.0 * 1024.0)  # uint8 NHWC on the wire
     packed_mb = packed_src[0] * packed_src[1] * 3 / (1024.0 * 1024.0)
+    packed420_mb = packed_mb / 2.0  # 1.5 B/px vs 3
     ceiling = link["h2d_MBps"] / image_mb
     ceiling_packed = link["h2d_MBps"] / packed_mb
+    ceiling_420 = link["h2d_MBps"] / packed420_mb
     # which stage's own ceiling binds the measured pipeline: the
-    # smallest of (host decode rate at the pipeline's size, packed link
-    # ceiling, device compute rate) is the constraint it runs against
-    stage_ceilings = {"decode": host_decode_ips_packed,
-                      "link": ceiling_packed,
+    # smallest of (host decode rate at the pipeline's size+format, link
+    # ceiling for its payload, device compute rate) is the constraint
+    stage_ceilings = {"decode": host_decode_ips_420,
+                      "link": ceiling_420,
                       "compute": device["ips"]}
     pipeline_bound_by = min(stage_ceilings, key=stage_ceilings.get)
     print(json.dumps({
@@ -266,26 +290,36 @@ def main() -> None:
         "vs_baseline_packed": round(packed_ips / PER_CHIP_TARGET, 3),
         "packed_src_hw": list(packed_src),
         "host_fed_ceiling_ips_packed": round(ceiling_packed, 1),
+        "value_packed420": round(packed420_ips, 1),
+        "vs_baseline_packed420": round(
+            packed420_ips / PER_CHIP_TARGET, 3),
+        "host_fed_ceiling_ips_packed420": round(ceiling_420, 1),
         "host_decode_ips": round(host_decode_ips, 1),
         "host_decode_ips_packed": round(host_decode_ips_packed, 1),
+        "host_decode_ips_packed420": round(host_decode_ips_420, 1),
         "value_pipeline": round(pipeline_ips, 1),
         "vs_baseline_pipeline": round(pipeline_ips / PER_CHIP_TARGET, 3),
+        "pipeline_packed_format": "yuv420",
         "pipeline_bound_by": pipeline_bound_by,
         "pipeline_stage_ceilings_ips": {
             k: round(v, 1) for k, v in stage_ceilings.items()},
         "runner_strategy": runner.strategy,
         "note": ("value_pipeline is the full measured pipeline (JPEG "
-                 "files -> fused native decode/resize/pack on engine "
-                 "host threads -> ship packed uint8 -> device-resized "
-                 "featurize, ONE stream); pipeline_bound_by names the "
-                 "stage whose own ceiling binds it. On this 1-core "
-                 "host decode and ship-side host work serialize "
+                 "files -> fused native decode/resize/pack to planar "
+                 "YCbCr 4:2:0 (1.5 B/px, half the RGB payload; "
+                 "standard 4:2:0 sources stream out of libjpeg raw) "
+                 "-> ship -> fused on-device chroma-upsample+BT.601+"
+                 "resize+featurize, ONE stream); pipeline_bound_by "
+                 "names the stage whose own ceiling binds it. On this "
+                 "1-core host decode and ship-side host work serialize "
                  "(1/decode + 1/ship ~= 1/pipeline); on a many-core "
                  "host they overlap and the pipeline converges to the "
-                 "binding ceiling. value/value_packed feed pre-decoded "
-                 "arrays (transfer-only shapes); device_resident_ips "
-                 "is compute with transfers excluded; host_decode_ips "
-                 "uses a textured (photo-compressibility) corpus"),
+                 "binding ceiling. value/value_packed/value_packed420 "
+                 "feed pre-decoded arrays (transfer-only shapes); "
+                 "device_resident_ips is compute with transfers "
+                 "excluded; host_decode_ips uses a textured "
+                 "(photo-compressibility) corpus. RGB-vs-420 fidelity: "
+                 "~0.8 counts mean on JPEG sources (tests pin it)"),
     }))
 
 

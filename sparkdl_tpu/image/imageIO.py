@@ -303,6 +303,55 @@ def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
     return _resize
 
 
+def rgbToYuv420(arr: np.ndarray) -> np.ndarray:
+    """RGB HWC uint8 → packed planar YCbCr 4:2:0 flat uint8 (Y[H*W] ++
+    Cb ++ Cr, 2×2 box-averaged chroma, BT.601 full-range — the same
+    codec as the native shim's ``rgb_to_yuv420``, used as its fallback
+    and test oracle). Dims must be even."""
+    arr = np.asarray(arr)
+    if arr.ndim != 3 or arr.shape[2] != 3 or arr.dtype != np.uint8:
+        raise ValueError(f"expected HWC RGB uint8, got {arr.shape} "
+                         f"{arr.dtype}")
+    h, w, _ = arr.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"yuv420 packing needs even dims, got {h}x{w}")
+    f = arr.astype(np.float32)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    y = 0.299 * r + 0.587 * g + 0.114 * b
+    cb = 128.0 - 0.168736 * r - 0.331264 * g + 0.5 * b
+    cr = 128.0 + 0.5 * r - 0.418688 * g - 0.081312 * b
+    # chroma averages in float BEFORE the uint8 round (native parity)
+    cb2 = cb.reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+    cr2 = cr.reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+
+    def _q(p):
+        return np.clip(np.floor(p + 0.5), 0, 255).astype(np.uint8)
+
+    return np.concatenate([_q(y).reshape(-1), _q(cb2).reshape(-1),
+                           _q(cr2).reshape(-1)])
+
+
+def yuv420ToRgb(packed: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Packed planar 4:2:0 flat uint8 → RGB HWC uint8 via nearest
+    chroma replication — the HOST-side inverse for tests/debugging (the
+    production inverse is the fused device op, which interpolates)."""
+    q = (height // 2) * (width // 2)
+    y = packed[:height * width].astype(np.float32).reshape(height, width)
+    cb = packed[height * width:height * width + q].astype(np.float32)
+    cr = packed[height * width + q:].astype(np.float32)
+    # single-source the BT.601 inverse with the device op
+    from sparkdl_tpu.ops.infeed import _CB_B, _CB_G, _CR_G, _CR_R
+    cb = np.repeat(np.repeat(cb.reshape(height // 2, width // 2), 2, 0),
+                   2, 1) - 128.0
+    cr = np.repeat(np.repeat(cr.reshape(height // 2, width // 2), 2, 0),
+                   2, 1) - 128.0
+    r = y + _CR_R * cr
+    g = y + _CB_G * cb + _CR_G * cr
+    b = y + _CB_B * cb
+    rgb = np.stack([r, g, b], axis=-1)
+    return np.clip(np.floor(rgb + 0.5), 0, 255).astype(np.uint8)
+
+
 # ---------------------------------------------------------------------------
 # readImages  (reference readImages/_readImages/filesToDF)
 # ---------------------------------------------------------------------------
@@ -387,7 +436,8 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                      nChannels: int = 3, numPartitions: int = 8,
                      dropImageFailures: bool = True,
                      engine=None,
-                     decodeThreads: Optional[int] = None) -> DataFrame:
+                     decodeThreads: Optional[int] = None,
+                     packedFormat: str = "rgb") -> DataFrame:
     """Infeed fast path: read images directly into a fixed-size uint8
     tensor column ``image`` ([h, w, c] per row) — for pipelines that
     feed one model size, this fuses decode → resize → NHWC pack into a
@@ -406,8 +456,26 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     count, not the driver's. 0 = OpenMP default (use when partitions
     run one-at-a-time on the executing host, e.g. a dedicated decode
     box or the one-task-per-executor accelerator config).
+
+    ``packedFormat``: ``"rgb"`` (default) ships [h, w, c] uint8 rows;
+    ``"yuv420"`` ships packed planar YCbCr 4:2:0 rows of
+    ``h*w*3/2`` bytes — HALF the link bytes — with chroma left at the
+    JPEG's stored half resolution (standard 4:2:0 sources skip libjpeg's
+    own chroma upsample entirely). Consume with
+    ``deviceResizeModel(..., packedFormat="yuv420")``, whose fused
+    device op reconstructs RGB inside the model program. Requires even
+    dims and ``nChannels=3``.
     """
     height, width = int(size[0]), int(size[1])
+    if packedFormat not in ("rgb", "yuv420"):
+        raise ValueError(f"packedFormat must be 'rgb' or 'yuv420', "
+                         f"got {packedFormat!r}")
+    yuv = packedFormat == "yuv420"
+    if yuv:
+        if nChannels != 3:
+            raise ValueError("packedFormat='yuv420' requires nChannels=3")
+        from sparkdl_tpu.native import yuv420_packed_size
+        row_bytes = yuv420_packed_size(height, width)  # validates even
     paths = listImageFiles(imageDirectory)
     df = filesToDF(paths, numPartitions=numPartitions, engine=engine)
     actual_parts = df.num_partitions  # filesToDF clamps to len(paths)
@@ -426,7 +494,8 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
         fp = batch.column(0).to_pylist()
         blobs = batch.column(1).to_pylist()
         n = len(blobs)
-        out = np.zeros((n, height, width, nChannels), np.uint8)
+        out = np.zeros((n, row_bytes) if yuv
+                       else (n, height, width, nChannels), np.uint8)
         ok = np.zeros(n, bool)
 
         if decodeThreads is None:
@@ -446,9 +515,13 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
         if jpeg_idx:
             try:
                 from sparkdl_tpu import native
-                fused = native.decode_resize_pack(
-                    [blobs[i] for i in jpeg_idx], height, width,
-                    nChannels, num_threads=nt)
+                sel = [blobs[i] for i in jpeg_idx]
+                fused = (native.decode_resize_pack_420(
+                            sel, height, width, num_threads=nt)
+                         if yuv else
+                         native.decode_resize_pack(
+                            sel, height, width, nChannels,
+                            num_threads=nt))
             except Exception:
                 fused = None
         if fused is not None:
@@ -463,8 +536,9 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
             s = _decodeImage(blobs[i], origin=fp[i])
             if s is None:
                 continue
-            arr = imageStructToArray(s)
-            out[i] = resizeImageArray(arr, height, width, nChannels)
+            arr = resizeImageArray(imageStructToArray(s), height, width,
+                                   nChannels)
+            out[i] = rgbToYuv420(arr) if yuv else arr
             ok[i] = True
 
         res = pa.RecordBatch.from_pydict(

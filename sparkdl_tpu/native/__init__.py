@@ -99,6 +99,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib._sdl_jpeg_bound = True
     except AttributeError:
         lib._sdl_jpeg_bound = False
+    # 4:2:0 packer arrived in shim v2; older cached binaries lack it.
+    try:
+        lib.sdl_decode_resize_pack_420.restype = ctypes.c_int
+        lib.sdl_decode_resize_pack_420.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        lib._sdl_420_bound = bool(lib._sdl_jpeg_bound)
+    except AttributeError:
+        lib._sdl_420_bound = False
     return lib
 
 
@@ -232,6 +243,45 @@ def decode_resize_pack(blobs: Sequence[bytes], height: int, width: int,
         ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         out.ctypes.data, height, width, nChannels,
         ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads)
+    return out, ok.astype(bool)
+
+
+def yuv420_packed_size(height: int, width: int) -> int:
+    """Bytes per image of the planar 4:2:0 payload: Y[H*W] ++
+    Cb[H/2*W/2] ++ Cr[H/2*W/2]. H and W must be even."""
+    if height % 2 or width % 2:
+        raise ValueError(
+            f"yuv420 packing needs even dims, got {height}x{width}")
+    return height * width + 2 * (height // 2) * (width // 2)
+
+
+def decode_resize_pack_420(blobs: Sequence[bytes], height: int,
+                           width: int, num_threads: int = 0
+                           ) -> Optional[tuple]:
+    """Fused 4:2:0 infeed (VERDICT r4 next #1): JPEG decode → per-plane
+    bilinear resize → packed planar YCbCr 4:2:0 ``[N, H*W*3/2]`` uint8,
+    one native call. Standard 4:2:0 sources come out of libjpeg raw
+    (chroma never upsampled on host); the device op
+    ``ops.fused_yuv420_resize_normalize`` reconstructs RGB fused into
+    the model program. Returns ``(packed, ok_mask)`` or None when the
+    native path, libjpeg, or the v2 shim symbol is unavailable."""
+    lib = get_lib()
+    if not (lib is not None and getattr(lib, "_sdl_420_bound", False)
+            and lib.sdl_has_jpeg()):
+        return None
+    row = yuv420_packed_size(height, width)
+    n = len(blobs)
+    out = np.zeros((n, row), np.uint8)
+    ok = np.zeros(n, np.uint8)
+    if n == 0:
+        return out, ok.astype(bool)
+    ptrs, lens, refs = _blob_ptrs(blobs)
+    rc = lib.sdl_decode_resize_pack_420(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data, height, width,
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads)
+    if rc != 0:
+        raise ValueError(f"native 4:2:0 decode/pack failed (rc={rc})")
     return out, ok.astype(bool)
 
 

@@ -78,18 +78,29 @@ inline void lerp_site(const uint8_t* r0, const uint8_t* r1, int c_in,
     }
 }
 
-// Resize one h*w*c_in image into H*W*C at dst. Returns 0 on success,
-// nonzero for unsupported channel combinations.
-int resize_one(const uint8_t* src, int h, int w, int c_in,
-               uint8_t* dst, int H, int W, int C) {
+// Resize one h*w*c_in image into H*W*C at dst. ``src_stride`` is the
+// source row pitch in SAMPLES (>= w*c_in; raw libjpeg planes are padded
+// to iMCU multiples). Returns 0 on success, nonzero for unsupported
+// channel combinations.
+int resize_one_strided(const uint8_t* src, int h, int w, int c_in,
+                       size_t src_stride, uint8_t* dst, int H, int W,
+                       int C) {
     const bool same_size = (h == H && w == W);
+    const bool packed = (src_stride == static_cast<size_t>(w) * c_in);
 
     // fast paths for same-size inputs (pure pack / channel convert)
     if (same_size && c_in == C) {
-        std::memcpy(dst, src, static_cast<size_t>(H) * W * C);
+        if (packed) {
+            std::memcpy(dst, src, static_cast<size_t>(H) * W * C);
+        } else {
+            for (int y = 0; y < H; ++y)
+                std::memcpy(dst + static_cast<size_t>(y) * W * C,
+                            src + static_cast<size_t>(y) * src_stride,
+                            static_cast<size_t>(W) * C);
+        }
         return 0;
     }
-    if (same_size) {
+    if (same_size && packed) {
         const size_t n = static_cast<size_t>(H) * W;
         if (c_in == 1 && C == 3) {
             for (size_t i = 0; i < n; ++i) {
@@ -123,8 +134,8 @@ int resize_one(const uint8_t* src, int h, int w, int c_in,
     const Axis ax(w, W), ay(h, H);
     float v[4];
     for (int y = 0; y < H; ++y) {
-        const uint8_t* r0 = src + static_cast<size_t>(ay.lo[y]) * w * c_in;
-        const uint8_t* r1 = src + static_cast<size_t>(ay.hi[y]) * w * c_in;
+        const uint8_t* r0 = src + static_cast<size_t>(ay.lo[y]) * src_stride;
+        const uint8_t* r1 = src + static_cast<size_t>(ay.hi[y]) * src_stride;
         const float fy = ay.frac[y];
         uint8_t* row = dst + static_cast<size_t>(y) * W * C;
         for (int x = 0; x < W; ++x) {
@@ -143,6 +154,56 @@ int resize_one(const uint8_t* src, int h, int w, int c_in,
         }
     }
     return 0;
+}
+
+int resize_one(const uint8_t* src, int h, int w, int c_in,
+               uint8_t* dst, int H, int W, int C) {
+    return resize_one_strided(src, h, w, c_in,
+                              static_cast<size_t>(w) * c_in, dst, H, W, C);
+}
+
+// --- YCbCr 4:2:0 packing (link-payload halving: 1.5 B/px vs RGB's 3) ---
+//
+// Packed layout per image: Y[H*W] then Cb[(H/2)*(W/2)] then
+// Cr[(H/2)*(W/2)], H and W even. BT.601 full-range (the JPEG/JFIF and
+// PIL "YCbCr" convention); the inverse conversion runs fused on-device
+// (ops/infeed.py::fused_yuv420_resize_normalize).
+
+inline size_t yuv420_size(int H, int W) {
+    return static_cast<size_t>(H) * W
+        + 2 * (static_cast<size_t>(H / 2) * (W / 2));
+}
+
+// RGB (H*W*3, packed) -> planar YCbCr with 2x2 box-averaged chroma, the
+// standard encoder subsampling. Chroma is averaged in float BEFORE the
+// uint8 round so the 4 sites contribute exactly.
+void rgb_to_yuv420(const uint8_t* rgb, int H, int W, uint8_t* dst) {
+    uint8_t* Y = dst;
+    uint8_t* Cb = dst + static_cast<size_t>(H) * W;
+    uint8_t* Cr = Cb + static_cast<size_t>(H / 2) * (W / 2);
+    const int CW = W / 2;
+    for (int y = 0; y < H; y += 2) {
+        for (int x = 0; x < W; x += 2) {
+            float scb = 0.0f, scr = 0.0f;
+            for (int dy = 0; dy < 2; ++dy) {
+                for (int dx = 0; dx < 2; ++dx) {
+                    const uint8_t* p =
+                        rgb + (static_cast<size_t>(y + dy) * W + x + dx) * 3;
+                    const float r = p[0], g = p[1], b = p[2];
+                    Y[static_cast<size_t>(y + dy) * W + x + dx] =
+                        to_u8(0.299f * r + 0.587f * g + 0.114f * b);
+                    scb += 128.0f - 0.168736f * r - 0.331264f * g
+                        + 0.5f * b;
+                    scr += 128.0f + 0.5f * r - 0.418688f * g
+                        - 0.081312f * b;
+                }
+            }
+            Cb[static_cast<size_t>(y / 2) * CW + x / 2] =
+                to_u8(scb * 0.25f);
+            Cr[static_cast<size_t>(y / 2) * CW + x / 2] =
+                to_u8(scr * 0.25f);
+        }
+    }
 }
 
 #ifdef SDL_HAVE_JPEG
@@ -188,6 +249,124 @@ int jpeg_decode_rgb(const uint8_t* data, size_t len, uint8_t* dst,
     }
     jpeg_finish_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+inline int pad_to(int v, int m) { return ((v + m - 1) / m) * m; }
+
+// Decode one JPEG straight to packed planar YCbCr 4:2:0 at (H, W).
+// Fast path: a YCbCr source with the standard 2x2/1x1/1x1 sampling is
+// read via jpeg_read_raw_data — libjpeg skips BOTH its chroma upsample
+// and the YCbCr->RGB conversion; Y resizes from full res and Cb/Cr
+// resize straight from their stored half-res planes (resize and the
+// affine color transform commute, so doing color on-device is exact up
+// to rounding). Grayscale decodes to Y with neutral chroma; anything
+// else decodes RGB and re-subsamples. Returns 0 on success.
+int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
+                    int H, int W) {
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    if (setjmp(jerr.jump)) {
+        jpeg_destroy_decompress(&cinfo);
+        return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, len);
+    jpeg_read_header(&cinfo, TRUE);
+    jpeg_calc_output_dimensions(&cinfo);
+    const int h = cinfo.output_height, w = cinfo.output_width;
+    if (h <= 0 || w <= 0 ||
+        static_cast<int64_t>(h) * w > (int64_t)100000000) {
+        jpeg_destroy_decompress(&cinfo);
+        return 2;
+    }
+    uint8_t* Y = dst;
+    uint8_t* Cb = dst + static_cast<size_t>(H) * W;
+    uint8_t* Cr = Cb + static_cast<size_t>(H / 2) * (W / 2);
+    const size_t chroma_bytes = static_cast<size_t>(H / 2) * (W / 2);
+
+    const bool raw420 = cinfo.jpeg_color_space == JCS_YCbCr
+        && cinfo.num_components == 3
+        && cinfo.comp_info[0].h_samp_factor == 2
+        && cinfo.comp_info[0].v_samp_factor == 2
+        && cinfo.comp_info[1].h_samp_factor == 1
+        && cinfo.comp_info[1].v_samp_factor == 1
+        && cinfo.comp_info[2].h_samp_factor == 1
+        && cinfo.comp_info[2].v_samp_factor == 1;
+
+    if (raw420) {
+        cinfo.raw_data_out = TRUE;
+        cinfo.out_color_space = JCS_YCbCr;
+        jpeg_start_decompress(&cinfo);
+        const int ch = (h + 1) / 2, cw = (w + 1) / 2;
+        // raw reads land in units of iMCU rows (16 Y / 8 chroma lines)
+        // and whole DCT blocks, so buffers pad to those multiples
+        const size_t ys = pad_to(w, 16), cs = pad_to(cw, 8);
+        std::vector<uint8_t> ybuf(ys * pad_to(h, 16));
+        std::vector<uint8_t> cbbuf(cs * pad_to(ch, 8));
+        std::vector<uint8_t> crbuf(cs * pad_to(ch, 8));
+        JSAMPROW yrows[16], cbrows[8], crrows[8];
+        JSAMPARRAY planes[3] = {yrows, cbrows, crrows};
+        while (cinfo.output_scanline < cinfo.output_height) {
+            const int sl = cinfo.output_scanline;
+            for (int i = 0; i < 16; ++i)
+                yrows[i] = ybuf.data() + (sl + i) * ys;
+            for (int i = 0; i < 8; ++i) {
+                cbrows[i] = cbbuf.data() + (sl / 2 + i) * cs;
+                crrows[i] = crbuf.data() + (sl / 2 + i) * cs;
+            }
+            jpeg_read_raw_data(&cinfo, planes, 16);
+        }
+        jpeg_finish_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        if (resize_one_strided(ybuf.data(), h, w, 1, ys, Y, H, W, 1) ||
+            resize_one_strided(cbbuf.data(), ch, cw, 1, cs,
+                               Cb, H / 2, W / 2, 1) ||
+            resize_one_strided(crbuf.data(), ch, cw, 1, cs,
+                               Cr, H / 2, W / 2, 1))
+            return 2;
+        return 0;
+    }
+
+    if (cinfo.num_components == 1) {
+        cinfo.out_color_space = JCS_GRAYSCALE;
+        jpeg_start_decompress(&cinfo);
+        std::vector<uint8_t> tmp(static_cast<size_t>(h) * w);
+        while (cinfo.output_scanline < cinfo.output_height) {
+            JSAMPROW row = tmp.data()
+                + static_cast<size_t>(cinfo.output_scanline) * w;
+            jpeg_read_scanlines(&cinfo, &row, 1);
+        }
+        jpeg_finish_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        if (resize_one(tmp.data(), h, w, 1, Y, H, W, 1)) return 2;
+        std::memset(Cb, 128, chroma_bytes);
+        std::memset(Cr, 128, chroma_bytes);
+        return 0;
+    }
+
+    // non-4:2:0 color (4:4:4 / 4:2:2 / RGB-coded): full decode, resize
+    // in RGB, subsample at the target size
+    cinfo.out_color_space = JCS_RGB;
+    jpeg_start_decompress(&cinfo);
+    if (cinfo.output_components != 3) {
+        jpeg_abort_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        return 2;
+    }
+    std::vector<uint8_t> tmp(static_cast<size_t>(h) * w * 3);
+    while (cinfo.output_scanline < cinfo.output_height) {
+        JSAMPROW row = tmp.data()
+            + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    std::vector<uint8_t> sized(static_cast<size_t>(H) * W * 3);
+    if (resize_one(tmp.data(), h, w, 3, sized.data(), H, W, 3)) return 2;
+    rgb_to_yuv420(sized.data(), H, W, dst);
     return 0;
 }
 
@@ -317,6 +496,43 @@ int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
 #endif
 }
 
+// Fused 4:2:0 infeed: decode n JPEGs into packed planar YCbCr at
+// (H, W) — Y[H*W] ++ Cb[H/2*W/2] ++ Cr[H/2*W/2] per image, 1.5 B/px on
+// the wire instead of RGB's 3 (the link-payload halving of VERDICT r4
+// next #1). Standard 4:2:0 sources stream out of libjpeg raw (no host
+// chroma upsample, no color conversion); the matching device op
+// (ops/infeed.py) fuses upsample + color conversion + resize into the
+// model program. H and W must be even (returns 4). Failed rows get
+// ok[i]=0 with a zeroed slot.
+int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
+                               int64_t n, uint8_t* dst, int32_t H,
+                               int32_t W, uint8_t* ok,
+                               int32_t num_threads) {
+#ifdef SDL_HAVE_JPEG
+    if (H <= 0 || W <= 0 || (H % 2) != 0 || (W % 2) != 0) return 4;
+    const size_t row_stride = yuv420_size(H, W);
+#ifdef _OPENMP
+    if (num_threads > 0) omp_set_num_threads(num_threads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t* out = dst + i * row_stride;
+        if (jpeg_decode_420(blobs[i], static_cast<size_t>(lens[i]),
+                            out, H, W) != 0) {
+            std::memset(out, 0, row_stride);
+            ok[i] = 0;
+            continue;
+        }
+        ok[i] = 1;
+    }
+    return 0;
+#else
+    (void)blobs; (void)lens; (void)n; (void)dst; (void)H; (void)W;
+    (void)ok; (void)num_threads;
+    return 3;
+#endif
+}
+
 // Resize + channel-convert + pack n images into a contiguous
 // [n, H, W, C] uint8 buffer. srcs[i] points at an src_h[i]*src_w[i]*
 // src_c[i] uint8 HWC image. Parallel over rows. Returns 0 on success;
@@ -343,6 +559,6 @@ int sdl_resize_pack_batch(const uint8_t** srcs,
     return status;
 }
 
-int sdl_version() { return 1; }
+int sdl_version() { return 2; }
 
 }  // extern "C"

@@ -10,4 +10,6 @@ fallback.
 from sparkdl_tpu.ops.infeed import (  # noqa: F401
     bilinear_weight_matrix,
     fused_resize_normalize,
+    fused_yuv420_resize_normalize,
+    yuv420_unpack,
 )

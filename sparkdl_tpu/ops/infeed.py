@@ -113,6 +113,80 @@ def _kernel(x_ref, wh_ref, wwe_ref, out_ref, *, scale, offset, out_dtype):
     out_ref[0] = (out * scale + offset).astype(out_dtype)
 
 
+# BT.601 full-range inverse (the JPEG/JFIF matrix libjpeg's fixed-point
+# tables implement): R = Y + 1.402·Cr′, G = Y − 0.344136·Cb′ −
+# 0.714136·Cr′, B = Y + 1.772·Cb′, with Cb′/Cr′ zero-centered at 128.
+_CR_R = 1.402
+_CB_G = -0.114 * 1.772 / 0.587
+_CR_G = -0.299 * 1.402 / 0.587
+_CB_B = 1.772
+
+
+def yuv420_unpack(x, src_hw: Tuple[int, int]):
+    """Split a packed planar 4:2:0 batch [N, H*W*3/2] into
+    (y [N,H,W,1], cb [N,H/2,W/2,1], cr [N,H/2,W/2,1]) views."""
+    H, W = int(src_hw[0]), int(src_hw[1])
+    if H % 2 or W % 2:
+        raise ValueError(f"yuv420 needs even source dims, got {H}x{W}")
+    n = x.shape[0]
+    q = (H // 2) * (W // 2)
+    expect = H * W + 2 * q
+    if x.shape[1] != expect:
+        raise ValueError(
+            f"packed 4:2:0 row is {x.shape[1]} bytes, expected "
+            f"{expect} for {H}x{W}")
+    y = x[:, :H * W].reshape(n, H, W, 1)
+    cb = x[:, H * W:H * W + q].reshape(n, H // 2, W // 2, 1)
+    cr = x[:, H * W + q:].reshape(n, H // 2, W // 2, 1)
+    return y, cb, cr
+
+
+def fused_yuv420_resize_normalize(x, src_hw: Tuple[int, int],
+                                  out_hw: Tuple[int, int],
+                                  scale: float = 1.0, offset: float = 0.0,
+                                  dtype=np.float32):
+    """Packed planar YCbCr 4:2:0 ``[N, H*W*3/2]`` uint8 → ``dtype``
+    ``[N, h, w, 3]`` RGB: per-plane anti-aliased bilinear resize, BT.601
+    color reconstruction, then ``y*scale + offset`` — ONE fused device
+    pass (the device half of VERDICT r4 next #1; host half is
+    ``native.decode_resize_pack_420``).
+
+    The 2× chroma upsample never happens as its own step: the chroma
+    resize matrices are built from the half-res plane straight to the
+    output size (``bilinear_weight_matrix(H/2, h)``), so upsample and
+    resize are ONE matmul pair per axis. Resize (linear, row-stochastic
+    weights) and the affine color transform commute exactly, so
+    color-after-resize matches the RGB path's color-before-resize up to
+    uint8 rounding; out-of-gamut clipping is applied after
+    reconstruction, as libjpeg clamps after conversion. XLA-path only
+    (einsum chain — the measured-best variant, see module docstring) so
+    it fuses into the consuming model program and shards under GSPMD."""
+    import jax
+    import jax.numpy as jnp
+
+    H, W = int(src_hw[0]), int(src_hw[1])
+    h, w = int(out_hw[0]), int(out_hw[1])
+    y, cb, cr = yuv420_unpack(x, (H, W))
+    wh_y = jnp.asarray(bilinear_weight_matrix(H, h))
+    ww_y = jnp.asarray(bilinear_weight_matrix(W, w))
+    wh_c = jnp.asarray(bilinear_weight_matrix(H // 2, h))
+    ww_c = jnp.asarray(bilinear_weight_matrix(W // 2, w))
+
+    def plane(p, wh, ww):
+        return jax.vmap(
+            lambda img: _resize_math(img, wh, ww, 1.0, 0.0,
+                                     jnp.float32))(p)[..., 0]
+
+    yf = plane(y, wh_y, ww_y)
+    cbf = plane(cb, wh_c, ww_c) - 128.0
+    crf = plane(cr, wh_c, ww_c) - 128.0
+    rgb = jnp.stack([yf + _CR_R * crf,
+                     yf + _CB_G * cbf + _CR_G * crf,
+                     yf + _CB_B * cbf], axis=-1)
+    rgb = jnp.clip(rgb, 0.0, 255.0)
+    return (rgb * scale + offset).astype(jnp.dtype(dtype))
+
+
 def fused_resize_normalize(x, out_hw: Tuple[int, int],
                            scale: float = 1.0, offset: float = 0.0,
                            dtype=np.float32,

@@ -125,7 +125,7 @@ def make_runner(model_fn, batch_size: int, use_mesh: bool = False,
 
 
 def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
-                      use_pallas=None):
+                      use_pallas=None, packedFormat: str = "rgb"):
     """Wrap a single-image-input ModelFunction so bilinear resize from
     ``src_hw`` to the model's native input size runs ON DEVICE, fused
     into the model's XLA program.
@@ -137,16 +137,54 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
     input dtype so the downstream preprocess sees exactly what a host
     resize would have produced.
 
-    ``use_pallas``: forwarded to the fused op. Pass False when the
-    wrapped model will be jitted with mesh shardings — a Pallas call
-    has no GSPMD partitioning rule, while the XLA einsum fallback
-    shards cleanly over the data axis.
+    ``use_pallas``: forwarded to the fused op (``"rgb"`` format only —
+    the 4:2:0 op is XLA-only so it fuses into the model program and
+    shards under GSPMD; requesting a kernel for it raises). Pass False
+    when the wrapped model will be jitted with mesh shardings — a
+    Pallas call has no GSPMD partitioning rule, while the XLA einsum
+    fallback shards cleanly over the data axis.
+
+    ``packedFormat``: ``"rgb"`` expects [N, sh, sw, c] uint8 rows;
+    ``"yuv420"`` expects the packed planar 4:2:0 rows
+    (``[N, sh*sw*3/2]`` uint8) that ``readImagesPacked(...,
+    packedFormat="yuv420")`` ships — half the link bytes — and fuses
+    chroma upsample + BT.601 reconstruction + resize into the model
+    program (``ops.fused_yuv420_resize_normalize``).
     """
     import jax.numpy as jnp
 
     in_name, _ = single_io(model_fn)
     (h, w, c), in_dtype = model_fn.input_signature[in_name]
     sh, sw = int(src_hw[0]), int(src_hw[1])
+    if packedFormat == "yuv420":
+        if use_pallas:
+            raise ValueError(
+                "use_pallas is not supported with packedFormat="
+                "'yuv420' (the 4:2:0 reconstruction op is XLA-only)")
+        if c != 3:
+            raise ValueError(
+                f"yuv420 input needs a 3-channel model, got {c}")
+        from sparkdl_tpu.native import yuv420_packed_size
+        row = yuv420_packed_size(sh, sw)
+
+        def reconstruct(inputs):
+            from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+            y = fused_yuv420_resize_normalize(
+                inputs[in_name], (sh, sw), (h, w))
+            if np.dtype(in_dtype) == np.uint8:
+                y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
+            else:
+                y = y.astype(in_dtype)
+            return {in_name: y}
+
+        from sparkdl_tpu.graph.utils import with_preprocessor
+        return with_preprocessor(
+            model_fn, reconstruct,
+            input_signature={in_name: ((row,), np.uint8)},
+            name=f"yuv420({sh}x{sw})+{model_fn.name}")
+    if packedFormat != "rgb":
+        raise ValueError(f"packedFormat must be 'rgb' or 'yuv420', "
+                         f"got {packedFormat!r}")
     if (sh, sw) == (h, w):
         return model_fn
 

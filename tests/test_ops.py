@@ -73,3 +73,94 @@ class TestFusedOp:
         f = jax.jit(lambda x: fused_resize_normalize(
             x, (16, 16), scale=1 / 255.0, use_pallas=False).sum())
         assert np.isfinite(float(f(batch)))
+
+
+class TestYuv420DeviceOp:
+    """Device half of the 4:2:0 payload path: fused chroma-upsample +
+    BT.601 reconstruction + resize (ops.fused_yuv420_resize_normalize)."""
+
+    def test_constant_chroma_matches_rgb_path(self):
+        """With spatially constant chroma the 2×2 subsample is lossless,
+        so the 420 route must equal the RGB route up to the codec's
+        uint8 rounding (≤2 counts after resize)."""
+        from sparkdl_tpu.image.imageIO import rgbToYuv420
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        # constant color per image -> constant chroma planes
+        colors = np.array([[200, 40, 90], [10, 250, 128]], np.uint8)
+        rgb = np.broadcast_to(colors[:, None, None, :],
+                              (2, 24, 32, 3)).copy()
+        packed = np.stack([rgbToYuv420(im) for im in rgb])
+        got = np.asarray(fused_yuv420_resize_normalize(
+            packed, (24, 32), (48, 64)))
+        exp = np.asarray(fused_resize_normalize(rgb, (48, 64)))
+        assert np.abs(got - exp).max() <= 2.0
+
+    def test_textured_within_chroma_tolerance(self, rng):
+        """On textured data the only divergence from the RGB route is
+        the 2×2 chroma subsample itself (synthetic textures carry
+        full-bandwidth chroma, unlike JPEG sources whose chroma the
+        encoder already band-limited — those measure ~0.8 mean, see
+        test_native.py): mean ≤2.5 counts, p99 ≤12."""
+        from sparkdl_tpu.image.imageIO import rgbToYuv420
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        from sparkdl_tpu.utils.synth import textured_image
+        rgb = np.stack([textured_image(rng, 40, 56) for _ in range(3)])
+        packed = np.stack([rgbToYuv420(im) for im in rgb])
+        got = np.asarray(fused_yuv420_resize_normalize(
+            packed, (40, 56), (30, 42)))
+        exp = np.asarray(fused_resize_normalize(rgb, (30, 42)))
+        d = np.abs(got - exp)
+        assert d.mean() <= 2.5, d.mean()
+        assert np.percentile(d, 99) <= 12.0, np.percentile(d, 99)
+
+    def test_scale_offset_dtype(self):
+        from sparkdl_tpu.image.imageIO import rgbToYuv420
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        rgb = np.full((1, 8, 8, 3), 255, np.uint8)
+        packed = np.stack([rgbToYuv420(im) for im in rgb])
+        out = np.asarray(fused_yuv420_resize_normalize(
+            packed, (8, 8), (8, 8), scale=1 / 127.5, offset=-1.0,
+            dtype=np.float32))
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, 1.0, atol=0.03)
+
+    def test_validation(self):
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        with pytest.raises(ValueError, match="even"):
+            fused_yuv420_resize_normalize(
+                np.zeros((1, 10), np.uint8), (3, 3), (4, 4))
+        with pytest.raises(ValueError, match="expected"):
+            fused_yuv420_resize_normalize(
+                np.zeros((1, 10), np.uint8), (4, 4), (4, 4))
+
+    def test_jittable_and_device_resize_model(self):
+        """deviceResizeModel(packedFormat='yuv420') embeds the op in one
+        jitted program and reproduces the RGB-input model's output on a
+        lossless (constant-chroma) batch."""
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.image.imageIO import rgbToYuv420
+        from sparkdl_tpu.transformers.utils import deviceResizeModel
+
+        def apply_fn(params, inputs):
+            x = inputs["image"].astype(jnp.float32)
+            return {"out": x.mean(axis=(1, 2))}
+
+        mf = ModelFunction(
+            apply_fn, params={},
+            input_signature={"image": ((16, 16, 3), np.uint8)},
+            output_names=["out"])
+        wrapped = deviceResizeModel(mf, (24, 24), packedFormat="yuv420")
+        assert wrapped.input_signature["image"] == \
+            ((24 * 24 * 3 // 2,), np.uint8)
+        colors = np.array([[130, 60, 200]], np.uint8)
+        rgb = np.broadcast_to(colors[:, None, None, :],
+                              (1, 24, 24, 3)).copy()
+        packed = np.stack([rgbToYuv420(im) for im in rgb])
+        out = jax.jit(wrapped.apply_fn)(wrapped.params,
+                                        {"image": packed})
+        np.testing.assert_allclose(np.asarray(out["out"])[0],
+                                   colors[0].astype(np.float32),
+                                   atol=2.5)
