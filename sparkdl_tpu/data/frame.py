@@ -148,12 +148,24 @@ def column_index(data, name: str) -> int:
 class Stage:
     """One plan step: RecordBatch → RecordBatch. With ``with_index``,
     ``fn(batch, partition_index)`` — for per-partition determinism
-    (sampling, sharded IO), the mapPartitionsWithIndex affordance."""
+    (sampling, sharded IO), the mapPartitionsWithIndex affordance.
+
+    ``batch_hint`` (device stages): the stage's preferred input row
+    count — its device batch (or global mesh batch). A row-preserving,
+    index-free device stage with a hint may be RE-CHUNKED by the engine:
+    fed row blocks cut at multiples of the hint from the ordered
+    partition stream instead of per-partition blocks, so partitions
+    smaller than the device batch stop padding up to the static shape
+    (the 2.4× small-partition tax measured in BASELINE.md). The
+    reference had no such constraint to absorb — TensorFrames blocks
+    were whatever size the partition was (SURVEY §3.2); static-shape
+    XLA makes batch alignment the engine's job, not the user's."""
     fn: Callable[..., pa.RecordBatch]
     kind: str = "host"            # "host" (thread-parallel) | "device" (serial)
     name: str = "stage"
     row_preserving: bool = True
     with_index: bool = False
+    batch_hint: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -234,11 +246,14 @@ class DataFrame:
         shape metadata survives the round-trip (Arrow schema is stored
         in the parquet file).
 
-        A directory holding part files but no ``_SUCCESS`` marker is an
-        interrupted :meth:`write_parquet` commit — refused by default
-        (Spark's committer semantics: uncommitted output is not
-        readable). For directories written by other tools, pass
-        ``allow_uncommitted=True``."""
+        A directory holding part files plus a ``_tmp.*`` staging
+        remnant is a DEFINITIVE interrupted :meth:`write_parquet`
+        commit — refused by default (Spark's committer semantics:
+        uncommitted output is not readable); ``allow_uncommitted=True``
+        overrides. A marker-less directory with no staging remnant was
+        written by another tool (pyarrow/pandas, or Spark with the
+        marker suppressed — neither requires ``_SUCCESS`` on read):
+        served with a warning."""
         import glob
 
         import pyarrow.parquet as pq
@@ -247,17 +262,22 @@ class DataFrame:
             files = sorted(glob.glob(os.path.join(path, "*.parquet")))
             if files and not os.path.exists(
                     os.path.join(path, "_SUCCESS")):
-                if not allow_uncommitted:
+                staging = glob.glob(os.path.join(path, "_tmp.*"))
+                if staging and not allow_uncommitted:
                     raise FileNotFoundError(
-                        f"{path!r} holds part files but no _SUCCESS "
-                        "marker: a write_parquet was interrupted "
-                        "mid-commit and the dataset may be PARTIAL. "
-                        "Pass allow_uncommitted=True to read a "
-                        "directory written by another tool.")
+                        f"{path!r} holds part files, no _SUCCESS "
+                        f"marker, and a staging remnant "
+                        f"({os.path.basename(staging[0])}): a "
+                        "write_parquet was interrupted mid-commit and "
+                        "the dataset may be PARTIAL. Pass "
+                        "allow_uncommitted=True to read it anyway.")
                 import logging
                 logging.getLogger(__name__).warning(
-                    "%r has no _SUCCESS marker (allow_uncommitted): "
-                    "serving possibly-partial dataset", path)
+                    "%r has no _SUCCESS marker%s: serving a dataset "
+                    "this library did not commit (foreign writers "
+                    "don't produce the marker; interrupted commits "
+                    "are detected via _tmp.* remnants)", path,
+                    " and a _tmp.* staging remnant" if staging else "")
         else:
             files = [path]
         if not files:
@@ -359,6 +379,7 @@ class DataFrame:
                 [{"part": fname, "rows": batch.num_rows}],
                 schema=summary_schema)
 
+        committed = 0
         try:
             entries = []
             for b in self.map_batches(_write_part, name="write_parquet",
@@ -377,13 +398,22 @@ class DataFrame:
             for seq, e in enumerate(entries):
                 os.replace(os.path.join(staging, e["part"]),
                            os.path.join(path, f"part-{seq:05d}.parquet"))
+                committed += 1
             # commit marker (Spark's _SUCCESS): the rename loop itself
             # is not atomic, so a kill mid-commit leaves part files but
             # no marker — read_parquet refuses to read without it
             with open(os.path.join(path, "_SUCCESS"), "w"):
                 pass
-        finally:
-            shutil.rmtree(staging, ignore_errors=True)
+        except BaseException:
+            # Once ANY part moved into `path`, the staging dir IS the
+            # interrupted-commit evidence read_parquet keys on —
+            # sweeping it would downgrade a PARTIAL dataset to
+            # "foreign writer, warn-and-serve". Before the first
+            # rename, `path` holds no parts, so sweeping is safe.
+            if not committed:
+                shutil.rmtree(staging, ignore_errors=True)
+            raise
+        shutil.rmtree(staging, ignore_errors=True)
         return path
 
     # -- plan building ------------------------------------------------------
@@ -391,11 +421,12 @@ class DataFrame:
     def map_batches(self, fn: Callable[..., pa.RecordBatch],
                     kind: str = "host", name: str = "map_batches",
                     row_preserving: bool = True,
-                    with_index: bool = False) -> "DataFrame":
+                    with_index: bool = False,
+                    batch_hint: Optional[int] = None) -> "DataFrame":
         return DataFrame(
             self._sources,
             self._plan + [Stage(fn, kind, name, row_preserving,
-                                with_index)],
+                                with_index, batch_hint)],
             self._engine)
 
     def with_column(self, name: str,
@@ -456,14 +487,86 @@ class DataFrame:
 
         return self.map_batches(_stage, name="filter", row_preserving=False)
 
-    def repartition(self, num_partitions: int) -> "DataFrame":
-        """Materializes the WHOLE frame, then re-slices (Spark's
-        shuffle repartition; row order preserved). For reducing the
-        partition count of a larger-than-RAM frame use
-        :meth:`coalesce`, which never holds more than one output
-        partition."""
-        return DataFrame.from_table(self.collect(), num_partitions,
-                                    self._engine)
+    def repartition(self, num_partitions: int,
+                    cacheDir: Optional[str] = None) -> "DataFrame":
+        """Change the partition count, preserving row order (Spark's
+        shuffle repartition — SURVEY §1 L0).
+
+        Without ``cacheDir``: materializes the whole frame on the
+        driver, then re-slices — fine for frames that fit in RAM.
+
+        With ``cacheDir``: OUT-OF-CORE (VERDICT r4 #6). The frame
+        streams through :meth:`write_parquet` into a spill under
+        ``cacheDir`` (parts written partition-at-a-time, bounded
+        memory), then the result is ``num_partitions`` lazy sources
+        each reading only its own contiguous row range from the spill
+        (row counts come from parquet footers, so planning reads no
+        data). Peak memory is one input partition while spilling and
+        ~2 spill files per output partition while reading — never the
+        whole frame. The spill persists for the returned frame's
+        lifetime; it lives under a unique subdirectory of ``cacheDir``
+        and can be reclaimed by deleting it once the frame is done."""
+        if cacheDir is None:
+            return DataFrame.from_table(self.collect(), num_partitions,
+                                        self._engine)
+        import uuid
+
+        import pyarrow.parquet as pq
+
+        spill = os.path.join(cacheDir,
+                             f"repartition_spill_{uuid.uuid4().hex[:12]}")
+        self.write_parquet(spill)
+        spilled = DataFrame.read_parquet(spill, engine=self._engine)
+        return spilled._reslice(int(num_partitions))
+
+    def _reslice(self, num_partitions: int) -> "DataFrame":
+        """Re-cut a frame whose sources all have known row counts (and
+        an empty plan — e.g. fresh from read_parquet) into
+        ``num_partitions`` contiguous row ranges. Each output source
+        lazily loads only the input sources its range overlaps."""
+        if self._plan or any(s.num_rows is None for s in self._sources):
+            raise ValueError(
+                "_reslice needs plan-free sources with known row "
+                "counts")
+        counts = [s.num_rows for s in self._sources]
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        total = int(offsets[-1])
+        n_out = max(1, min(int(num_partitions), total) if total
+                    else 1)
+        bounds = np.linspace(0, total, n_out + 1).astype(int)
+        ins = self._sources
+        schema = self.schema
+
+        def _make_load(lo: int, hi: int):
+            def _load() -> pa.RecordBatch:
+                frags = []
+                for i, src in enumerate(ins):
+                    s_lo, s_hi = int(offsets[i]), int(offsets[i + 1])
+                    if s_hi <= lo or s_lo >= hi:
+                        continue
+                    b = src.load()
+                    a = max(lo, s_lo) - s_lo
+                    z = min(hi, s_hi) - s_lo
+                    frags.append(b.slice(a, z - a))
+                if not frags:
+                    return pa.RecordBatch.from_pydict(
+                        {f.name: pa.array([], f.type)
+                         for f in schema}).cast(schema) \
+                        if schema is not None else \
+                        pa.RecordBatch.from_pydict({})
+                # _concat_batches raises loudly on >2GiB columns that
+                # refuse to combine — returning a subset would silently
+                # drop rows on exactly the larger-than-RAM path this
+                # exists for
+                from sparkdl_tpu.data.engine import _concat_batches
+                return _concat_batches(frags)
+            return _load
+
+        sources = [Source(_make_load(int(lo), int(hi)), int(hi - lo))
+                   for lo, hi in zip(bounds[:-1], bounds[1:])]
+        out = DataFrame(sources, engine=self._engine)
+        out._schema = self._schema
+        return out
 
     def coalesce(self, num_partitions: int) -> "DataFrame":
         """Merge ADJACENT partitions down to ``num_partitions`` without
@@ -957,10 +1060,21 @@ class DataFrame:
         return self.collect().to_pandas()
 
     def count(self) -> int:
+        known = self.known_count()
+        if known is not None:
+            return known
+        return sum(b.num_rows for b in self.stream())
+
+    def known_count(self) -> Optional[int]:
+        """Row count WITHOUT executing the plan, or None when it would
+        require execution (a non-row-preserving stage, or sources
+        without counts). Lets sizing decisions — e.g.
+        ``LogisticRegression``'s memory-budget auto-switch — stay free
+        instead of silently running an expensive upstream plan twice."""
         if all(st.row_preserving for st in self._plan) and \
                 all(s.num_rows is not None for s in self._sources):
             return sum(s.num_rows for s in self._sources)
-        return sum(b.num_rows for b in self.stream())
+        return None
 
     def take(self, n: int) -> List[Row]:
         out: List[Row] = []

@@ -132,10 +132,21 @@ def udf_to_column_fn(model_udf, outputMode: str = "vector"
             # per struct field
             pandas_in = True
             tbl = pa.Table.from_pandas(col, preserve_index=False)
+            children = [tbl.column(i).combine_chunks()
+                        for i in range(tbl.num_columns)]
+            # pyspark flattens a NULL struct row to all-null fields;
+            # rebuild the row-level validity so downstream sees a null
+            # image (imageColumnViews' clear error), not a struct of
+            # NaNs that dies in a cast
+            nulls = None
+            if children and any(c.null_count for c in children):
+                import numpy as np
+                all_null = np.logical_and.reduce(
+                    [np.asarray(pa.compute.is_null(c)) for c in children])
+                if all_null.any():
+                    nulls = pa.array(all_null)  # mask: True = null row
             arr = pa.StructArray.from_arrays(
-                [tbl.column(i).combine_chunks()
-                 for i in range(tbl.num_columns)],
-                names=list(tbl.column_names))
+                children, names=list(tbl.column_names), mask=nulls)
         elif hasattr(col, "index") and hasattr(col, "dtype"):
             # pandas Series: scalar / list (tensor) columns
             pandas_in = True

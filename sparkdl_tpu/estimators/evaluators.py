@@ -139,7 +139,13 @@ def _metric_from_confusion(conf: dict, metric: str) -> float:
     a class never predicted contributes precision 0."""
     total = sum(conf.values())
     if total == 0:
-        return 0.0
+        # one convention across all three evaluators (advisor r4 #4):
+        # an empty scored frame RAISES, matching
+        # BinaryClassificationEvaluator — a CV fold whose validation
+        # side filtered every row out must not silently score 0.0
+        raise ValueError(
+            "cannot evaluate an empty scored frame (0 rows with "
+            "predictions and labels); check upstream filters/folds")
     if metric == "accuracy":
         correct = sum(c for (p, l), c in conf.items() if p == l)
         return float(correct / total)
@@ -437,5 +443,8 @@ class LossEvaluator(Evaluator):
             total += float(-np.log(picked).sum())
             n += len(picked)
         if n == 0:
-            return float("nan")  # mean of an empty scored frame
+            # same convention as the other evaluators (advisor r4 #4)
+            raise ValueError(
+                "cannot evaluate an empty scored frame (0 rows with "
+                "predictions and labels); check upstream filters/folds")
         return total / n

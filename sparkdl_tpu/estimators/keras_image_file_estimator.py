@@ -199,7 +199,8 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
                                                out[out_name], mode)
 
         return loaded.map_batches(apply, kind="device",
-                                  name=f"apply({mf.name})")
+                                  name=f"apply({mf.name})",
+                                  batch_hint=runner.preferred_chunk)
 
     def copy(self, extra: Optional[dict] = None) -> "KerasImageFileModel":
         that = super().copy(extra)

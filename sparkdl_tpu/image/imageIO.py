@@ -451,11 +451,16 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     ``None`` divides the EXECUTING host's cores by the partitions that
     can run concurrently there — engine host threads (and Spark task
     slots) already parallelize partitions, so the naive OpenMP default
-    (all cores) would run cores² decode threads and thrash. Computed
-    inside the stage, so on a cluster each executor uses its own core
-    count, not the driver's. 0 = OpenMP default (use when partitions
-    run one-at-a-time on the executing host, e.g. a dedicated decode
-    box or the one-task-per-executor accelerator config).
+    (all cores) would run cores² decode threads and thrash. The core
+    count is read inside the stage (each executor's own), but the
+    concurrency term comes from the DRIVER-side engine's worker count
+    captured at plan-build time — on an engine whose executors run a
+    different number of concurrent partitions than the driver's
+    ``num_workers`` says (e.g. Spark with uneven task slots), pass
+    ``decodeThreads`` explicitly. 0 = OpenMP default (use when
+    partitions run one-at-a-time on the executing host, e.g. a
+    dedicated decode box or the one-task-per-executor accelerator
+    config).
 
     ``packedFormat``: ``"rgb"`` (default) ships [h, w, c] uint8 rows;
     ``"yuv420"`` ships packed planar YCbCr 4:2:0 rows of

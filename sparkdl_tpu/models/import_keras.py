@@ -21,6 +21,7 @@ fails loudly (and the conversion tests compare outputs numerically).
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -310,4 +311,61 @@ def import_named_model(name: str, keras_model=None,
 
     fetcher = fetcher or ModelFetcher()
     fetcher.put(f"{name}.msgpack", variables)
+    materialize_imagenet_class_index(fetcher)
     return variables
+
+
+def materialize_imagenet_class_index(fetcher=None) -> Optional[str]:
+    """Put the canonical ``imagenet_class_index.json`` (35 KB of label
+    metadata, not weights) into the fetcher cache so
+    ``DeepImagePredictor(decodePredictions=True)`` emits real class
+    names (VERDICT r4 #8). Sources: keras's own cache if already
+    downloaded, else keras's canonical URL (works wherever weights
+    downloads work — this runs as part of ``import_named_model``, which
+    is network-bound anyway). Returns the cache path, or None when
+    unobtainable (zero-egress envs keep the synthetic fallback — a
+    from-memory reconstruction is deliberately NOT bundled, since
+    silently wrong labels are worse than visibly synthetic ones)."""
+    import json
+    import logging
+
+    from sparkdl_tpu.models.fetcher import ModelFetcher
+
+    fetcher = fetcher or ModelFetcher()
+    dst = os.path.join(fetcher.cache_dir, "imagenet_class_index.json")
+    if os.path.exists(dst):
+        return dst
+    src = os.path.join(os.path.expanduser("~"), ".keras", "models",
+                       "imagenet_class_index.json")
+    if not os.path.exists(src):
+        try:
+            from keras.utils import get_file
+            src = get_file(
+                "imagenet_class_index.json",
+                "https://storage.googleapis.com/download.tensorflow.org"
+                "/data/imagenet_class_index.json",
+                cache_subdir="models",
+                file_hash="c2c37ea517e94d9795004a39431a14cb")
+        except Exception as e:
+            logging.getLogger(__name__).info(
+                "imagenet class index unobtainable (%s); "
+                "decode_predictions keeps synthetic class_i names", e)
+            return None
+    with open(src) as f:
+        raw = json.load(f)  # validate before committing to the cache
+    if not isinstance(raw, dict) or len(raw) != 1000:
+        logging.getLogger(__name__).warning(
+            "unexpected imagenet_class_index.json shape (%s entries); "
+            "not installing", len(raw) if isinstance(raw, dict) else "?")
+        return None
+    os.makedirs(fetcher.cache_dir, exist_ok=True)
+    tmp = f"{dst}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(raw, f)
+    os.replace(tmp, dst)
+    try:
+        from sparkdl_tpu.models import zoo
+        zoo._imagenet_class_names.cache_clear()
+    except Exception:
+        pass
+    return dst

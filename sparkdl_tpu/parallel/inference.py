@@ -66,6 +66,13 @@ class ShardedBatchRunner:
             strategy, max_inflight)
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
 
+    @property
+    def preferred_chunk(self) -> int:
+        """Row count at which run() pads nothing: the GLOBAL mesh batch
+        (per-chip batch × data-axis size) — published as the device
+        stage's plan batch_hint."""
+        return self._global_batch
+
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]};
         N is cut into global batches, the tail padded then truncated."""

@@ -244,6 +244,13 @@ class BatchRunner:
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
 
+    @property
+    def preferred_chunk(self) -> int:
+        """Row count at which run() pads nothing: the device batch.
+        Device stages publish this as their plan batch_hint so the
+        engine can feed batch-aligned blocks across partitions."""
+        return self.batch_size
+
     def _chunks(self, n: int):
         for lo in range(0, n, self.batch_size):
             yield lo, min(lo + self.batch_size, n)

@@ -112,4 +112,5 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol,
             return tfr_utils.appendModelOutput(batch, out_col, out, mode)
 
         return dataset.map_batches(pack, name="packImageBatch") \
-            .map_batches(apply, kind="device", name=f"apply({mf.name})")
+            .map_batches(apply, kind="device", name=f"apply({mf.name})",
+                         batch_hint=runner.preferred_chunk)

@@ -75,4 +75,5 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
             return tfr_utils.appendModelOutput(batch, out_col, out, mode)
 
         return loaded.map_batches(apply, kind="device",
-                                  name=f"apply({mf.name})")
+                                  name=f"apply({mf.name})",
+                                  batch_hint=runner.preferred_chunk)

@@ -118,5 +118,7 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
             return batch
 
         kind = "device" if mf.backend == "jax" else "host"
-        return dataset.map_batches(apply, kind=kind,
-                                   name=f"apply({mf.name})")
+        return dataset.map_batches(
+            apply, kind=kind, name=f"apply({mf.name})",
+            batch_hint=(runner.preferred_chunk if kind == "device"
+                        else None))

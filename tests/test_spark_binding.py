@@ -567,3 +567,35 @@ def test_spark_engine_with_index_uses_logical_identity():
     expected = tagged.collect()
     assert got.column("pid").to_pylist() == \
         expected.column("pid").to_pylist()
+
+
+def test_null_struct_rows_from_pandas_surface_as_null_images():
+    """pyspark hands a struct column to a pandas_udf as a DataFrame with
+    NULL rows flattened to all-null fields; the rebuilt StructArray must
+    carry row-level validity so the failure is imageColumnViews' clear
+    'null image' message, not a NaN cast error (advisor r4 #3)."""
+    import pandas as pd
+
+    from sparkdl_tpu.image import imageIO
+
+    good = imageIO.imageArrayToStruct(
+        np.zeros((4, 5, 3), np.uint8), origin="g")
+    frame = pd.DataFrame([good,
+                          {k: None for k in good}])  # null image row
+    tbl = pa.Table.from_pandas(frame, preserve_index=False)
+    children = [tbl.column(i).combine_chunks()
+                for i in range(tbl.num_columns)]
+    all_null = np.logical_and.reduce(
+        [np.asarray(pa.compute.is_null(c)) for c in children])
+    arr = pa.StructArray.from_arrays(
+        children, names=list(tbl.column_names),
+        mask=pa.array(all_null))
+    # the binding's own path builds the same mask — drive it end to end
+    from sparkdl_tpu.data.spark_binding import udf_to_column_fn
+    from sparkdl_tpu.udf.registry import makeModelUDF
+    from sparkdl_tpu.models.zoo import getModelFunction
+    udf = makeModelUDF(getModelFunction("TestNet", featurize=True),
+                       "nulltest_udf", kind="image", register=False)
+    fn = udf_to_column_fn(udf, outputMode="vector")
+    with pytest.raises(ValueError, match="null image"):
+        fn(frame)

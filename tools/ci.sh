@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# One-command verification gate (SURVEY §4 item 6 — the reference's
+# Travis matrix ran `sbt test` + the python suite; this is the TPU
+# build's equivalent, green from a fresh clone with no network):
+#
+#   1. build the native host shim (g++ + libjpeg; falls back to the
+#      PIL path when unavailable, which the suite also covers)
+#   2. run the full pytest suite on an 8-virtual-device CPU mesh
+#      (the local-mode-Spark analogue: every multi-chip code path
+#      executes without TPU hardware)
+#   3. compile-check + execute the multi-chip training/inference
+#      dryrun (__graft_entry__.dryrun_multichip)
+#   4. bench smoke: one tiny end-to-end featurize pass producing the
+#      driver-contract JSON line (CPU; the real bench runs on TPU)
+#
+# Usage: tools/ci.sh [pytest args...]
+#   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
+# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export KERAS_BACKEND=jax
+export TF_CPP_MIN_LOG_LEVEL=3
+export CUDA_VISIBLE_DEVICES=-1
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "== [1/4] native shim build =="
+python - <<'EOF'
+from sparkdl_tpu import native
+ok = native.available()
+print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
+      f", libjpeg: {native.has_jpeg() if ok else False}")
+EOF
+
+if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
+  echo "== [2/4] test suite (8-virtual-device CPU mesh) =="
+  python -m pytest tests/ -q "$@"
+else
+  echo "== [2/4] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+fi
+
+echo "== [3/4] multi-chip dryrun (8 virtual devices) =="
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+print("dryrun_multichip(8): ok")
+EOF
+
+echo "== [4/4] bench smoke (CPU, tiny) =="
+python - <<'EOF'
+import json
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from sparkdl_tpu.models.zoo import getModelFunction
+from sparkdl_tpu.runtime.runner import BatchRunner
+
+mf = getModelFunction("TestNet", featurize=True)
+runner = BatchRunner(mf, batch_size=8)
+images = np.random.default_rng(0).integers(
+    0, 255, (16, 32, 32, 3), dtype=np.uint8)
+runner.run({"image": images[:8]})  # warmup
+t0 = time.perf_counter()
+out = runner.run({"image": images})
+ips = len(images) / (time.perf_counter() - t0)
+assert out["features"].shape == (16, 16), out["features"].shape
+print(json.dumps({"metric": "ci_smoke_testnet_featurize[cpu]",
+                  "value": round(ips, 1), "unit": "images/sec",
+                  "vs_baseline": None}))
+EOF
+
+echo "== ci.sh: ALL GREEN =="
