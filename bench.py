@@ -307,6 +307,30 @@ def main() -> None:
     fidelity = measure_fidelity(mf, packed_src,
                                 n_images=32 if on_tpu else 8)
 
+    # Race the two fused-resize implementations device-resident
+    # (VERDICT r4 #7, the transfer-strategy precedent: measured, not
+    # asserted): the XLA einsum chain is the library default
+    # (ops/infeed.py — it fuses into the model program and shards under
+    # GSPMD); the Pallas kernel is TPU-only, so the race runs on real
+    # hardware only. The faster one must be the default — a mismatch
+    # is reported rather than silently accepted.
+    infeed_race = {"einsum_ips": None, "pallas_ips": None,
+                   "default": "einsum", "default_is_fastest": None}
+    if on_tpu:
+        try:
+            r_e = measure_device_resident(
+                deviceResizeModel(mf, packed_src, use_pallas=False),
+                batch_size, n_batches=16)
+            r_p = measure_device_resident(
+                deviceResizeModel(mf, packed_src, use_pallas=True),
+                batch_size, n_batches=16)
+            infeed_race["einsum_ips"] = r_e["ips"]
+            infeed_race["pallas_ips"] = r_p["ips"]
+            infeed_race["default_is_fastest"] = \
+                r_e["ips"] >= r_p["ips"]
+        except Exception as e:  # kernel lowering can shift across jax
+            infeed_race["error"] = f"{type(e).__name__}: {e}"[:200]
+
     image_mb = 299 * 299 * 3 / (1024.0 * 1024.0)  # uint8 NHWC on the wire
     packed_mb = packed_src[0] * packed_src[1] * 3 / (1024.0 * 1024.0)
     packed420_mb = packed_mb / 2.0  # 1.5 B/px vs 3
@@ -349,6 +373,7 @@ def main() -> None:
         "vs_baseline_pipeline": round(pipeline_ips / PER_CHIP_TARGET, 3),
         "pipeline_packed_format": "yuv420",
         "fidelity": fidelity,
+        "infeed_race": infeed_race,
         "pipeline_bound_by": pipeline_bound_by,
         "pipeline_stage_ceilings_ips": {
             k: round(v, 1) for k, v in stage_ceilings.items()},

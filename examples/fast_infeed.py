@@ -11,6 +11,12 @@ model, trading host CPU work for device work:
                views) -> deviceResizeFrom: bilinear resize fused INTO
                the model's XLA program (Pallas kernel on real TPU) —
                host CPUs only decode
+4. yuv420      readImagesPacked(packedFormat="yuv420"): ship planar
+               YCbCr 4:2:0 at 1.5 B/px — HALF the link bytes — with
+               chroma upsample + BT.601 reconstruction + resize fused
+               on-device (the bench headline's shape; standard 4:2:0
+               JPEGs stream out of libjpeg raw, skipping host chroma
+               work entirely)
 
 Run on CPU:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -66,12 +72,27 @@ def main():
         deviceResizeFrom=(48, 64)
     ).transform(df).tensor("f")
 
-    assert classic.shape == fused.shape == device.shape
+    # 4. half-the-bytes 4:2:0 ship, reconstruction fused on-device
+    from sparkdl_tpu.transformers.utils import deviceResizeModel, single_io
+    mf420 = deviceResizeModel(getModelFunction("TestNet", featurize=True),
+                              (24, 24), packedFormat="yuv420")
+    in420, out420 = single_io(mf420)
+    packed420 = imageIO.readImagesPacked(d, (24, 24), numPartitions=3,
+                                         packedFormat="yuv420",
+                                         engine=engine)
+    yuv = sparkdl_tpu.TensorTransformer(
+        modelFunction=mf420, inputMapping={"image": in420},
+        outputMapping={out420: "f"},
+    ).transform(packed420).tensor("f")
+
+    assert classic.shape == fused.shape == device.shape == yuv.shape
     # different resamplers (host bilinear / native fused / device AA
     # bilinear) agree closely on features
     c = np.corrcoef(classic.ravel(), device.ravel())[0, 1]
+    c420 = np.corrcoef(classic.ravel(), yuv.ravel())[0, 1]
     print(f"feature shape {classic.shape}; "
-          f"classic-vs-device correlation {c:.4f}")
+          f"classic-vs-device correlation {c:.4f}; "
+          f"classic-vs-yuv420 {c420:.4f}")
     print("per-stage metrics (rows/sec):")
     print(metrics.report())
 

@@ -296,6 +296,17 @@ class LocalEngine:
             finally:
                 for fut in pending.values():
                     fut.cancel()
+                # QUIESCE before returning control: a task that was
+                # already running when a sibling failed can't be
+                # cancelled and would otherwise keep producing side
+                # effects (e.g. re-creating write_parquet's staging
+                # dir) AFTER the caller's cleanup ran
+                for fut in pending.values():
+                    if not fut.cancelled():
+                        try:
+                            fut.result()
+                        except Exception:
+                            pass  # the primary error already propagated
 
         return _gen()
 

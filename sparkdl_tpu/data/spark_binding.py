@@ -232,6 +232,12 @@ class SparkEngine:
 
     def execute(self, sources: Sequence, plan: Sequence
                 ) -> Iterator[pa.RecordBatch]:
+        # Stage.batch_hint is advisory and unused here: Spark maps one
+        # partition per task, so cross-partition device re-chunking
+        # (LocalEngine.execute) has no cross-task seam to work in —
+        # each task's device stage pads its own tail. On Spark, size
+        # partitions near the device batch (or a multiple) to avoid
+        # padding; LocalEngine makes sizing irrelevant.
         stages = list(plan)
         # Ship (load, logical_index) in the task closure — Spark
         # serializes tasks with cloudpickle, which handles the local

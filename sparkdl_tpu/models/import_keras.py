@@ -351,8 +351,16 @@ def materialize_imagenet_class_index(fetcher=None) -> Optional[str]:
                 "imagenet class index unobtainable (%s); "
                 "decode_predictions keeps synthetic class_i names", e)
             return None
-    with open(src) as f:
-        raw = json.load(f)  # validate before committing to the cache
+    try:
+        with open(src) as f:
+            raw = json.load(f)  # validate before committing to the cache
+    except Exception as e:
+        # label metadata is OPTIONAL: a corrupt cached index must not
+        # fail a weight import that already succeeded
+        logging.getLogger(__name__).warning(
+            "unreadable imagenet_class_index.json at %s (%s); "
+            "decode_predictions keeps synthetic class_i names", src, e)
+        return None
     if not isinstance(raw, dict) or len(raw) != 1000:
         logging.getLogger(__name__).warning(
             "unexpected imagenet_class_index.json shape (%s entries); "

@@ -1118,3 +1118,21 @@ def test_interrupted_commit_keeps_refusal_evidence(tmp_path,
     assert glob.glob(os.path.join(out, "_tmp.*"))
     with pytest.raises(FileNotFoundError, match="PARTIAL"):
         DataFrame.read_parquet(out)
+
+
+def test_write_parquet_row_group_cap(tmp_path):
+    """row_group_rows caps parquet row-group size so range readers
+    (repartition's spill) fetch only overlapping groups, not files."""
+    import pyarrow.parquet as pq
+
+    df = _df(40, 2)  # 20 rows per part
+    out = str(tmp_path / "pq")
+    df.write_parquet(out, row_group_rows=8)
+    import glob
+    files = sorted(glob.glob(out + "/*.parquet"))
+    assert files
+    for f in files:
+        md = pq.ParquetFile(f).metadata
+        assert md.num_row_groups == 3  # ceil(20/8)
+        assert max(md.row_group(g).num_rows
+                   for g in range(md.num_row_groups)) <= 8

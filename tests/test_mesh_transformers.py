@@ -175,3 +175,35 @@ class TestSparkBinding:
         batch = pa.RecordBatch.from_pydict({"x": pa.array([1])})
         list(fn(iter([batch])))
         assert seen == [0]
+
+
+def test_yuv420_model_shards_on_mesh(tmp_path):
+    """The 4:2:0 reconstruction op claims GSPMD-shardability (XLA-only
+    einsum chain) — prove it: the same yuv420-wrapped model through the
+    8-device ShardedBatchRunner must equal the single-device runner,
+    through the full packed-reader flow, with a tail that pads."""
+    from PIL import Image
+
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.transformers.utils import (
+        deviceResizeModel,
+        single_io,
+    )
+    from sparkdl_tpu.utils.synth import textured_image
+
+    rng = np.random.default_rng(9)
+    for i in range(11):  # deliberately ragged vs 8-device global batch
+        Image.fromarray(textured_image(rng, 40, 48), "RGB").save(
+            tmp_path / f"m{i}.jpg", quality=90)
+    mf = getModelFunction("TestNet", featurize=True)
+    mfp = deviceResizeModel(mf, (24, 24), packedFormat="yuv420")
+    in_name, out_name = single_io(mfp)
+    packed = imageIO.readImagesPacked(str(tmp_path), (24, 24),
+                                      numPartitions=3,
+                                      packedFormat="yuv420")
+    x = packed.tensor("image")
+
+    single = BatchRunner(mfp, batch_size=4).run({in_name: x})[out_name]
+    sharded = ShardedBatchRunner(mfp, batch_size=2).run(
+        {in_name: x})[out_name]
+    np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-5)

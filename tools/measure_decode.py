@@ -73,6 +73,23 @@ def main() -> None:
             print(f"  num_threads={nt}: {r:8.1f}  "
                   f"({r / shim[1]:.2f}x vs 1 thread)")
 
+        # 4:2:0 packer at the pipeline's ship size: raw libjpeg planes,
+        # no chroma upsample/color conversion on host (needs even dims)
+        size420 = (size[0] - size[0] % 2, size[1] - size[1] % 2)
+        if native.decode_resize_pack_420(blobs[:2], *size420) is None:
+            yuv = None  # stale pre-v2 shim: timing a no-op would
+            # fabricate a throughput number in a measurements file
+            print("\n4:2:0 packer unavailable (shim lacks the v2 "
+                  "symbol; rebuild by deleting _sparkdl_host.so)")
+        else:
+            yuv = best_rate(
+                lambda: native.decode_resize_pack_420(
+                    blobs, size420[0], size420[1], num_threads=1),
+                n_images)
+            print(f"\n4:2:0 packer at {size420} (1 thread): {yuv:8.1f} "
+                  f"img/s ({yuv / shim[1]:.2f}x vs RGB, at half the "
+                  "output bytes)")
+
         engine = {}
         for parts in (1, 2, 4, 8):
             for mode, threads in (("split", None), ("naive", 0)):
@@ -93,6 +110,8 @@ def main() -> None:
             "corpus_bits_per_pixel": round(bpp, 2),
             "shim_ips_by_threads": {str(k): round(v, 1)
                                     for k, v in shim.items()},
+            "shim_420_ips_1thread": (round(yuv, 1)
+                                     if yuv is not None else None),
             "engine_ips": {f"p{p}_{m}": round(v, 1)
                            for (p, m), v in engine.items()},
             "note": ("shim scaling beyond host_cores threads is flat by "
