@@ -1136,3 +1136,70 @@ def test_write_parquet_row_group_cap(tmp_path):
         assert md.num_row_groups == 3  # ceil(20/8)
         assert max(md.row_group(g).num_rows
                    for g in range(md.num_row_groups)) <= 8
+
+
+class TestRechunkComposition:
+    """Plans with several device stages / interleaved host stages all
+    flow through the stream phase correctly."""
+
+    def _mf(self, width, k):
+        from sparkdl_tpu.graph.function import ModelFunction
+
+        def apply_fn(params, inputs):
+            return {"y": inputs["x"] * k}
+
+        return ModelFunction(apply_fn, params={},
+                             input_signature={"x": ((width,),
+                                                    np.float32)},
+                             output_names=["y"])
+
+    def test_two_chained_device_stages_different_batches(self):
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        rng = np.random.default_rng(11)
+        n = 60
+        feats = rng.normal(size=(n, 3)).astype(np.float32)
+        b = pa.RecordBatch.from_pydict({"rid": pa.array(np.arange(n))})
+        b = append_tensor_column(b, "x", feats)
+        df = DataFrame.from_table(pa.Table.from_batches([b]), 12)
+
+        t1 = TensorTransformer(modelFunction=self._mf(3, 2.0),
+                               inputMapping={"x": "x"},
+                               outputMapping={"y": "x2"}, batchSize=16)
+        t2 = TensorTransformer(modelFunction=self._mf(3, -1.0),
+                               inputMapping={"x2": "x"},
+                               outputMapping={"y": "x3"}, batchSize=7)
+        out = t2.transform(t1.transform(df)).collect()
+        np.testing.assert_array_equal(out.column("rid").to_numpy(),
+                                      np.arange(n))
+        np.testing.assert_allclose(arrow_to_tensor(out.column("x3")),
+                                   feats * -2.0, atol=1e-6)
+        assert t1.metrics.batches == 4   # ceil(60/16)
+        assert t2.metrics.batches == 9   # ceil(60/7)
+
+    def test_device_stage_after_filter_after_device_stage(self):
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        n = 40
+        feats = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        b = pa.RecordBatch.from_pydict({"rid": pa.array(np.arange(n))})
+        b = append_tensor_column(b, "x", feats)
+        df = DataFrame.from_table(pa.Table.from_batches([b]), 8)
+
+        t1 = TensorTransformer(modelFunction=self._mf(2, 3.0),
+                               inputMapping={"x": "x"},
+                               outputMapping={"y": "x3"}, batchSize=16)
+        stage1 = t1.transform(df)
+        kept = stage1.filter(lambda bb: pa.array(
+            bb.column(bb.schema.get_field_index("rid")).to_numpy() % 4
+            == 0))
+        t2 = TensorTransformer(modelFunction=self._mf(2, 10.0),
+                               inputMapping={"x3": "x"},
+                               outputMapping={"y": "x30"}, batchSize=4)
+        out = t2.transform(kept).collect()
+        assert out.num_rows == 10
+        np.testing.assert_allclose(
+            arrow_to_tensor(out.column("x30")),
+            feats[::4] * 30.0, atol=1e-5)
