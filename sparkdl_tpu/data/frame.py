@@ -14,7 +14,7 @@ import dataclasses
 import functools
 import os
 import threading
-from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -558,7 +558,12 @@ class DataFrame:
                 frags = []
                 pf = None
                 open_name = None
-                for i, (f, g, _rows) in enumerate(groups):
+                # overlapping row-group window straight from offsets
+                i0 = max(0, int(np.searchsorted(offsets, lo,
+                                                "right")) - 1)
+                i1 = int(np.searchsorted(offsets, hi, "left"))
+                for i in range(i0, min(i1, len(groups))):
+                    f, g, _rows = groups[i]
                     s_lo, s_hi = int(offsets[i]), int(offsets[i + 1])
                     if s_hi <= lo or s_lo >= hi:
                         continue

@@ -6,7 +6,7 @@ expects)."""
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -156,6 +156,14 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
     in_name, _ = single_io(model_fn)
     (h, w, c), in_dtype = model_fn.input_signature[in_name]
     sh, sw = int(src_hw[0]), int(src_hw[1])
+
+    def cast(y):
+        # round back to the model's declared input dtype so the
+        # downstream preprocess sees exactly what a host path produces
+        if np.dtype(in_dtype) == np.uint8:
+            return jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
+        return y.astype(in_dtype)
+
     if packedFormat == "yuv420":
         if use_pallas:
             raise ValueError(
@@ -165,48 +173,36 @@ def deviceResizeModel(model_fn, src_hw: Tuple[int, int],
             raise ValueError(
                 f"yuv420 input needs a 3-channel model, got {c}")
         from sparkdl_tpu.native import yuv420_packed_size
-        row = yuv420_packed_size(sh, sw)
+        in_sig = ((yuv420_packed_size(sh, sw),), np.uint8)
+        label = "yuv420"
 
-        def reconstruct(inputs):
+        def pre(inputs):
             from sparkdl_tpu.ops import fused_yuv420_resize_normalize
-            y = fused_yuv420_resize_normalize(
-                inputs[in_name], (sh, sw), (h, w))
-            if np.dtype(in_dtype) == np.uint8:
-                y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
-            else:
-                y = y.astype(in_dtype)
-            return {in_name: y}
+            return cast(fused_yuv420_resize_normalize(
+                inputs[in_name], (sh, sw), (h, w)))
+    elif packedFormat == "rgb":
+        if (sh, sw) == (h, w):
+            return model_fn
+        in_sig = ((sh, sw, c), in_dtype)
+        label = "resize"
 
-        from sparkdl_tpu.graph.utils import with_preprocessor
-        return with_preprocessor(
-            model_fn, reconstruct,
-            input_signature={in_name: ((row,), np.uint8)},
-            name=f"yuv420({sh}x{sw})+{model_fn.name}")
-    if packedFormat != "rgb":
+        def pre(inputs):
+            from sparkdl_tpu.ops import fused_resize_normalize
+            # XLA einsum chain by default (measured faster than the
+            # Pallas kernel on v5e AND fusable into the model program —
+            # ops/infeed.py docstring; parity with jax.image.resize is
+            # kernel-tested)
+            return cast(fused_resize_normalize(
+                inputs[in_name], (h, w), use_pallas=use_pallas))
+    else:
         raise ValueError(f"packedFormat must be 'rgb' or 'yuv420', "
                          f"got {packedFormat!r}")
-    if (sh, sw) == (h, w):
-        return model_fn
-
-    def resize(inputs):
-        from sparkdl_tpu.ops import fused_resize_normalize
-        x = inputs[in_name]
-        # XLA einsum chain by default (measured faster than the Pallas
-        # kernel on v5e AND fusable into the model program —
-        # ops/infeed.py docstring; parity with jax.image.resize is
-        # kernel-tested)
-        y = fused_resize_normalize(x, (h, w), use_pallas=use_pallas)
-        if np.dtype(in_dtype) == np.uint8:
-            y = jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8)
-        else:
-            y = y.astype(in_dtype)
-        return {in_name: y}
 
     from sparkdl_tpu.graph.utils import with_preprocessor
     return with_preprocessor(
-        model_fn, resize,
-        input_signature={in_name: ((sh, sw, c), in_dtype)},
-        name=f"resize({sh}x{sw})+{model_fn.name}")
+        model_fn, lambda inputs: {in_name: pre(inputs)},
+        input_signature={in_name: in_sig},
+        name=f"{label}({sh}x{sw})+{model_fn.name}")
 
 
 def single_io(model_fn) -> Tuple[str, str]:

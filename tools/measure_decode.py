@@ -29,7 +29,6 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np  # noqa: E402
 
 
 def best_rate(fn, n_rows: int, passes: int = 3) -> float:
