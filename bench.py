@@ -204,8 +204,10 @@ def measure_fidelity(mf, packed_src, n_images: int = 32) -> dict:
 
 
 def main() -> None:
+    tpu_down = False
     if not _probe_accelerator():
         import jax
+        tpu_down = True
         jax.config.update("jax_platforms", "cpu")
         print("accelerator backend unavailable; benching on CPU",
               file=sys.stderr)
@@ -374,6 +376,13 @@ def main() -> None:
         "pipeline_packed_format": "yuv420",
         "fidelity": fidelity,
         "infeed_race": infeed_race,
+        **({"tpu_fallback": ("tunneled TPU backend did not initialize; "
+                             "CPU numbers are compute-bound on this "
+                             "1-core host. BASELINE.md records this "
+                             "round's live v5e measurements: "
+                             "value_packed420 973.7, pipeline 463-563, "
+                             "device-resident 6,440 img/s")}
+           if tpu_down else {}),
         "pipeline_bound_by": pipeline_bound_by,
         "pipeline_stage_ceilings_ips": {
             k: round(v, 1) for k, v in stage_ceilings.items()},

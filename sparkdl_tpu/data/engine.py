@@ -7,8 +7,20 @@ while device stages (jitted TPU applies) are serialized behind a lock so
 a single accelerator sees one batch stream and HBM isn't oversubscribed
 by concurrent partitions. Results stream back in partition order.
 
+Plans containing a re-chunkable device stage (row-preserving,
+index-free, with a ``Stage.batch_hint``) execute in two phases: the
+host prefix runs per-partition in the pool as always, then the ordered
+partition stream flows through the remaining stages on the consumer
+thread — the device stage is fed batch-hint-aligned row blocks that
+SPAN partition boundaries (outputs re-sliced back to the original
+partitions), so partitions smaller than the static device batch stop
+padding it. TensorFrames never had this problem (its blocks were
+whatever size the partition was); static-shape XLA makes batch
+alignment the engine's job rather than the user's.
+
 A Spark/mapInArrow binding can replace this class behind the same
-``execute(sources, plan)`` contract when pyspark is available.
+``execute(sources, plan)`` contract when pyspark is available (there,
+one partition per task — the hint is advisory; see spark_binding).
 """
 
 from __future__ import annotations

@@ -1203,3 +1203,89 @@ class TestRechunkComposition:
         np.testing.assert_allclose(
             arrow_to_tensor(out.column("x30")),
             feats[::4] * 30.0, atol=1e-5)
+
+
+class TestRechunkFuzz:
+    """Randomized layouts through the re-chunker: any partition-size
+    mix × any batch hint must preserve row identity and order and
+    dispatch ceil(N/hint) chunks."""
+
+    def test_random_layouts(self):
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        rng = np.random.default_rng(123)
+        for trial in range(6):
+            sizes = rng.integers(0, 9, size=rng.integers(2, 9)).tolist()
+            n = int(sum(sizes))
+            if n == 0:
+                sizes.append(3)
+                n = 3
+            hint = int(rng.integers(2, 12))
+            feats = rng.normal(size=(n, 2)).astype(np.float32)
+            batches, off = [], 0
+            for s in sizes:
+                b = pa.RecordBatch.from_pydict(
+                    {"rid": pa.array(np.arange(off, off + s))})
+                b = append_tensor_column(b, "x", feats[off:off + s])
+                batches.append(b)
+                off += s
+            df = DataFrame([Source((lambda bb=bb: bb), bb.num_rows)
+                            for bb in batches])
+
+            def apply_fn(params, inputs):
+                return {"y": inputs["x"] * 0.5}
+
+            mf = ModelFunction(apply_fn, params={},
+                               input_signature={"x": ((2,), np.float32)},
+                               output_names=["y"])
+            t = TensorTransformer(modelFunction=mf,
+                                  inputMapping={"x": "x"},
+                                  outputMapping={"y": "y"},
+                                  batchSize=hint)
+            table = t.transform(df).collect()
+            ctx = (trial, sizes, hint)
+            assert table.num_rows == n, ctx
+            np.testing.assert_array_equal(
+                table.column("rid").to_numpy(), np.arange(n), err_msg=str(ctx))
+            np.testing.assert_allclose(
+                arrow_to_tensor(table.column("y")), feats * 0.5,
+                atol=1e-6, err_msg=str(ctx))
+            assert t.metrics.batches == -(-n // hint), ctx
+
+    def test_pooled_downstream_stage_preserves_order_under_jitter(self):
+        """Host stages after the device stage run pooled; ordered
+        emission must hold even when later partitions finish first."""
+        import time
+
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        n = 24
+        b = pa.RecordBatch.from_pydict({"rid": pa.array(np.arange(n))})
+        b = append_tensor_column(b, "x",
+                                 np.ones((n, 2), np.float32))
+        df = DataFrame.from_table(pa.Table.from_batches([b]), 8)
+
+        def apply_fn(params, inputs):
+            return {"y": inputs["x"]}
+
+        mf = ModelFunction(apply_fn, params={},
+                           input_signature={"x": ((2,), np.float32)},
+                           output_names=["y"])
+        t = TensorTransformer(modelFunction=mf, inputMapping={"x": "x"},
+                              outputMapping={"y": "y"}, batchSize=5)
+        rng = np.random.default_rng(0)
+
+        def jitter(batch):
+            time.sleep(float(rng.uniform(0, 0.01)))
+            return batch.append_column(
+                "tag", pa.array([1] * batch.num_rows))
+
+        out = t.transform(df).map_batches(jitter, name="jitter")
+        rids = []
+        for bb in out.stream():
+            rids.extend(bb.column(0).to_pylist())
+        assert rids == list(range(n))
