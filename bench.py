@@ -206,16 +206,22 @@ def measure_fidelity(mf, packed_src, n_images: int = 32) -> dict:
 _bench_done = None  # set by main(); threading.Event
 
 
-def _start_watchdog(seconds: int = 2400) -> None:
+def _start_watchdog(seconds: int = 2400, on_cpu: bool = False) -> None:
     """The tunneled TPU can STALL (not error) mid-run — without this,
     a stall at round end means no JSON line at all. After ``seconds``
     the watchdog prints a minimal contract line naming the failure and
-    exits; a finished main() disarms it."""
+    exits; a finished main() disarms it. The cause named in the line
+    depends on the active backend — blaming a tunnel stall on a run
+    that was already forced to CPU would misdirect whoever reads it."""
     import os
     import threading
 
     global _bench_done
     _bench_done = threading.Event()
+    cause = ("CPU fallback run overran the budget (slow host or cold "
+             "XLA cache; the persistent cache makes repeats faster)"
+             if on_cpu else
+             "tunneled TPU stall mid-run is the known cause")
 
     def run():
         if not _bench_done.wait(seconds):
@@ -225,8 +231,7 @@ def _start_watchdog(seconds: int = 2400) -> None:
                 "value": None, "unit": "images/sec/chip",
                 "vs_baseline": None,
                 "error": f"bench watchdog: run exceeded {seconds}s "
-                         "(tunneled TPU stall mid-run is the known "
-                         "cause; BASELINE.md records this round's "
+                         f"({cause}; BASELINE.md records this round's "
                          "live v5e measurements)"}), flush=True)
             os._exit(3)
 
@@ -244,7 +249,7 @@ def main() -> None:
     # CPU fallback legitimately takes ~30-40 min on a 1-core host
     # (InceptionV3 compiles + 6 img/s passes); the TPU run finishes in
     # minutes unless the tunnel stalls
-    _start_watchdog(3600 if tpu_down else 2400)
+    _start_watchdog(3600 if tpu_down else 2400, on_cpu=tpu_down)
     import jax
     try:
         # persistent XLA cache: repeat bench runs skip the multi-minute

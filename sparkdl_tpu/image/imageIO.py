@@ -437,7 +437,8 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                      dropImageFailures: bool = True,
                      engine=None,
                      decodeThreads: Optional[int] = None,
-                     packedFormat: str = "rgb") -> DataFrame:
+                     packedFormat: str = "rgb",
+                     scaledDecode: bool = True) -> DataFrame:
     """Infeed fast path: read images directly into a fixed-size uint8
     tensor column ``image`` ([h, w, c] per row) — for pipelines that
     feed one model size, this fuses decode → resize → NHWC pack into a
@@ -470,6 +471,18 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     ``deviceResizeModel(..., packedFormat="yuv420")``, whose fused
     device op reconstructs RGB inside the model program. Requires even
     dims and ``nChannels=3``.
+
+    ``scaledDecode`` (default True): shrink mostly in the DCT domain —
+    libjpeg decodes at the smallest M/8 of the source that still covers
+    ``size``, skipping IDCT work, and the bilinear step then shrinks by
+    <2x. Besides being cheaper it is the better-filtered downscale
+    (bilinear straight from ≥2x skips source rows; the DCT prescale is
+    a proper low-pass — the same trick as PIL's ``draft`` mode, with
+    bit-identical output where the scale factors coincide). Pixel
+    values differ from the full-res-decode path by a few counts on
+    shrink; pass False for the pure bilinear-from-full-res pixels (and
+    see the fused-vs-two-step exactness test in tests/test_native.py).
+    Non-JPEG sources and the PIL fallback are unaffected.
     """
     height, width = int(size[0]), int(size[1])
     if packedFormat not in ("rgb", "yuv420"):
@@ -522,11 +535,13 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                 from sparkdl_tpu import native
                 sel = [blobs[i] for i in jpeg_idx]
                 fused = (native.decode_resize_pack_420(
-                            sel, height, width, num_threads=nt)
+                            sel, height, width, num_threads=nt,
+                            scaled_decode=scaledDecode)
                          if yuv else
                          native.decode_resize_pack(
                             sel, height, width, nChannels,
-                            num_threads=nt))
+                            num_threads=nt,
+                            scaled_decode=scaledDecode))
             except Exception:
                 fused = None
         if fused is not None:

@@ -218,6 +218,27 @@ void jpeg_err_exit(j_common_ptr cinfo) {
     longjmp(err->jump, 1);
 }
 
+// DCT-domain prescale selection (libjpeg scaled decode): smallest
+// power-of-two M/8 whose scaled dims still cover the target on BOTH
+// axes. Power-of-two only, for two measured reasons: (a) the 1x1/2x2/
+// 4x4 scaled IDCTs are the SIMD-accelerated kernels — the intermediate
+// M/8 factors fall back to scalar IDCTs that measured SLOWER than the
+// full SIMD 8x8 (375x500→299²: 453 vs 532 img/s at 7/8 on this host);
+// (b) raw-data mode pairs a scaled Y IDCT with unscaled stored chroma
+// and the pow2 sizes are what every libjpeg ships there. The <2x
+// bilinear-after guarantee survives: if M/2 failed to cover then
+// src*M/8 < 2*target. Returns 8 (no scaling) when even 4/8 would
+// undershoot. (PIL's draft mode makes the same pow2-only choice, which
+// is why the two agree bit-for-bit where they both engage.)
+int choose_scale_num(int src_h, int src_w, int dst_h, int dst_w) {
+    for (int m = 1; m < 8; m *= 2) {
+        const long h = (static_cast<long>(src_h) * m + 7) / 8;
+        const long w = (static_cast<long>(src_w) * m + 7) / 8;
+        if (h >= dst_h && w >= dst_w) return m;
+    }
+    return 8;
+}
+
 // Decode one JPEG to RGB into dst (h*w*3, dims from a prior header
 // parse). Returns 0 on success.
 int jpeg_decode_rgb(const uint8_t* data, size_t len, uint8_t* dst,
@@ -254,16 +275,89 @@ int jpeg_decode_rgb(const uint8_t* data, size_t len, uint8_t* dst,
 
 inline int pad_to(int v, int m) { return ((v + m - 1) / m) * m; }
 
+// Scaled-IDCT geometry fields moved in the libjpeg v7 ABI: v6 has one
+// square DCT_scaled_size per component, v7+ splits it into h/v. The
+// shim compiles on first use against whatever jpeglib.h the host
+// ships, so both spellings must build (a failed -DSDL_HAVE_JPEG
+// attempt silently drops the whole native JPEG path).
+#if JPEG_LIB_VERSION >= 70
+#define SDL_COMP_DCT_H(ci) ((ci).DCT_h_scaled_size)
+#define SDL_COMP_DCT_V(ci) ((ci).DCT_v_scaled_size)
+#define SDL_MIN_DCT_H(cinfo) ((cinfo).min_DCT_h_scaled_size)
+#define SDL_MIN_DCT_V(cinfo) ((cinfo).min_DCT_v_scaled_size)
+#else
+#define SDL_COMP_DCT_H(ci) ((ci).DCT_scaled_size)
+#define SDL_COMP_DCT_V(ci) ((ci).DCT_scaled_size)
+#define SDL_MIN_DCT_H(cinfo) ((cinfo).min_DCT_scaled_size)
+#define SDL_MIN_DCT_V(cinfo) ((cinfo).min_DCT_scaled_size)
+#endif
+
+// Decode one JPEG to RGB into caller scratch ``tmp`` at the natural or
+// DCT-prescaled size: when ``scale_to_h/w`` > 0, decode at the smallest
+// M/8 still covering that target (choose_scale_num). On success tmp
+// holds (*dh) x (*dw) x 3 and the caller resizes. Returns 0 on success.
+int jpeg_decode_rgb_scaled(const uint8_t* data, size_t len,
+                           std::vector<uint8_t>& tmp, int scale_to_h,
+                           int scale_to_w, int* dh, int* dw) {
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    if (setjmp(jerr.jump)) {
+        jpeg_destroy_decompress(&cinfo);
+        return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, len);
+    jpeg_read_header(&cinfo, TRUE);
+    if (static_cast<int64_t>(cinfo.image_height) * cinfo.image_width
+        > (int64_t)100000000) {
+        jpeg_destroy_decompress(&cinfo);
+        return 2;
+    }
+    if (scale_to_h > 0 && scale_to_w > 0) {
+        cinfo.scale_num = choose_scale_num(
+            cinfo.image_height, cinfo.image_width,
+            scale_to_h, scale_to_w);
+        cinfo.scale_denom = 8;
+    }
+    cinfo.out_color_space = JCS_RGB;
+    jpeg_start_decompress(&cinfo);
+    const int h = cinfo.output_height, w = cinfo.output_width;
+    if (h <= 0 || w <= 0 || cinfo.output_components != 3) {
+        jpeg_abort_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        return 2;
+    }
+    tmp.resize(static_cast<size_t>(h) * w * 3);
+    while (cinfo.output_scanline < cinfo.output_height) {
+        JSAMPROW row = tmp.data()
+            + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    *dh = h;
+    *dw = w;
+    return 0;
+}
+
 // Decode one JPEG straight to packed planar YCbCr 4:2:0 at (H, W).
 // Fast path: a YCbCr source with the standard 2x2/1x1/1x1 sampling is
 // read via jpeg_read_raw_data — libjpeg skips BOTH its chroma upsample
-// and the YCbCr->RGB conversion; Y resizes from full res and Cb/Cr
-// resize straight from their stored half-res planes (resize and the
-// affine color transform commute, so doing color on-device is exact up
-// to rounding). Grayscale decodes to Y with neutral chroma; anything
-// else decodes RGB and re-subsamples. Returns 0 on success.
+// and the YCbCr->RGB conversion; Y resizes from its decoded plane and
+// Cb/Cr straight from their stored planes (resize and the affine color
+// transform commute, so doing color on-device is exact up to rounding).
+// ``scaled`` additionally prescales in the DCT domain (power-of-two
+// M/8 covering the target — choose_scale_num): the Y IDCT emits a
+// low-passed plane a quarter the samples at 1/2 scale while chroma,
+// already stored at half res, stays unscaled; per-component geometry
+// (strides, rows per raw read) therefore comes from comp_info rather
+// than the full-scale constants. Grayscale decodes to Y with neutral
+// chroma; anything else decodes RGB (prescaled when ``scaled``) and
+// re-subsamples. Returns 0 on success.
 int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
-                    int H, int W) {
+                    int H, int W, int scaled) {
     jpeg_decompress_struct cinfo;
     JpegErr jerr;
     cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -276,9 +370,9 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
     jpeg_mem_src(&cinfo, data, len);
     jpeg_read_header(&cinfo, TRUE);
     jpeg_calc_output_dimensions(&cinfo);
-    const int h = cinfo.output_height, w = cinfo.output_width;
-    if (h <= 0 || w <= 0 ||
-        static_cast<int64_t>(h) * w > (int64_t)100000000) {
+    const int full_h = cinfo.output_height, full_w = cinfo.output_width;
+    if (full_h <= 0 || full_w <= 0 ||
+        static_cast<int64_t>(full_h) * full_w > (int64_t)100000000) {
         jpeg_destroy_decompress(&cinfo);
         return 2;
     }
@@ -297,42 +391,79 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         && cinfo.comp_info[2].v_samp_factor == 1;
 
     if (raw420) {
+        if (scaled) {
+            cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
+            cinfo.scale_denom = 8;
+        }
         cinfo.raw_data_out = TRUE;
         cinfo.out_color_space = JCS_YCbCr;
         jpeg_start_decompress(&cinfo);
-        const int ch = (h + 1) / 2, cw = (w + 1) / 2;
-        // raw reads land in units of iMCU rows (16 Y / 8 chroma lines)
-        // and whole DCT blocks, so buffers pad to those multiples
-        const size_t ys = pad_to(w, 16), cs = pad_to(cw, 8);
-        std::vector<uint8_t> ybuf(ys * pad_to(h, 16));
-        std::vector<uint8_t> cbbuf(cs * pad_to(ch, 8));
-        std::vector<uint8_t> crbuf(cs * pad_to(ch, 8));
-        JSAMPROW yrows[16], cbrows[8], crrows[8];
-        JSAMPARRAY planes[3] = {yrows, cbrows, crrows};
-        while (cinfo.output_scanline < cinfo.output_height) {
-            const int sl = cinfo.output_scanline;
-            for (int i = 0; i < 16; ++i)
-                yrows[i] = ybuf.data() + (sl + i) * ys;
-            for (int i = 0; i < 8; ++i) {
-                cbrows[i] = cbbuf.data() + (sl / 2 + i) * cs;
-                crrows[i] = crbuf.data() + (sl / 2 + i) * cs;
+        // One raw read delivers one iMCU row: mcu_h output scanlines,
+        // during which component i receives v_samp * DCT_scaled rows of
+        // mcus_per_row * h_samp * DCT_scaled samples. At full scale
+        // this reduces to the familiar 16 Y / 8 chroma lines; under
+        // prescale Y's DCT_scaled_size shrinks while stored-half-res
+        // chroma stays at 8, so the per-component numbers MUST come
+        // from comp_info.
+        const int mcu_w = cinfo.max_h_samp_factor * SDL_MIN_DCT_H(cinfo);
+        const int mcu_h = cinfo.max_v_samp_factor * SDL_MIN_DCT_V(cinfo);
+        const int mcus_per_row =
+            (static_cast<int>(cinfo.output_width) + mcu_w - 1) / mcu_w;
+        const int imcu_rows =
+            (static_cast<int>(cinfo.output_height) + mcu_h - 1) / mcu_h;
+        int rows_per[3], dh[3], dw[3];
+        size_t stride[3];
+        std::vector<uint8_t> buf[3];
+        for (int i = 0; i < 3; ++i) {
+            const jpeg_component_info& ci = cinfo.comp_info[i];
+            rows_per[i] = ci.v_samp_factor * SDL_COMP_DCT_V(ci);
+            stride[i] = static_cast<size_t>(mcus_per_row)
+                * ci.h_samp_factor * SDL_COMP_DCT_H(ci);
+            dh[i] = ci.downsampled_height;
+            dw[i] = ci.downsampled_width;
+            if (rows_per[i] <= 0 || rows_per[i] > 16 || dh[i] <= 0
+                || dw[i] <= 0
+                || stride[i] < static_cast<size_t>(dw[i])) {
+                jpeg_abort_decompress(&cinfo);
+                jpeg_destroy_decompress(&cinfo);
+                return 2;
             }
-            jpeg_read_raw_data(&cinfo, planes, 16);
+            buf[i].resize(stride[i]
+                          * (static_cast<size_t>(imcu_rows)
+                             * rows_per[i]));
+        }
+        JSAMPROW rows0[16], rows1[16], rows2[16];
+        JSAMPROW* rowsets[3] = {rows0, rows1, rows2};
+        JSAMPARRAY planes[3] = {rows0, rows1, rows2};
+        for (int r = 0; r < imcu_rows
+                 && cinfo.output_scanline < cinfo.output_height; ++r) {
+            for (int i = 0; i < 3; ++i)
+                for (int k = 0; k < rows_per[i]; ++k)
+                    rowsets[i][k] = buf[i].data()
+                        + (static_cast<size_t>(r) * rows_per[i] + k)
+                        * stride[i];
+            jpeg_read_raw_data(&cinfo, planes, mcu_h);
         }
         jpeg_finish_decompress(&cinfo);
         jpeg_destroy_decompress(&cinfo);
-        if (resize_one_strided(ybuf.data(), h, w, 1, ys, Y, H, W, 1) ||
-            resize_one_strided(cbbuf.data(), ch, cw, 1, cs,
+        if (resize_one_strided(buf[0].data(), dh[0], dw[0], 1, stride[0],
+                               Y, H, W, 1) ||
+            resize_one_strided(buf[1].data(), dh[1], dw[1], 1, stride[1],
                                Cb, H / 2, W / 2, 1) ||
-            resize_one_strided(crbuf.data(), ch, cw, 1, cs,
+            resize_one_strided(buf[2].data(), dh[2], dw[2], 1, stride[2],
                                Cr, H / 2, W / 2, 1))
             return 2;
         return 0;
     }
 
     if (cinfo.num_components == 1) {
+        if (scaled) {
+            cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
+            cinfo.scale_denom = 8;
+        }
         cinfo.out_color_space = JCS_GRAYSCALE;
         jpeg_start_decompress(&cinfo);
+        const int h = cinfo.output_height, w = cinfo.output_width;
         std::vector<uint8_t> tmp(static_cast<size_t>(h) * w);
         while (cinfo.output_scanline < cinfo.output_height) {
             JSAMPROW row = tmp.data()
@@ -347,8 +478,13 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         return 0;
     }
 
-    // non-4:2:0 color (4:4:4 / 4:2:2 / RGB-coded): full decode, resize
-    // in RGB, subsample at the target size
+    // non-4:2:0 color (4:4:4 / 4:2:2 / RGB-coded): decode inline from
+    // the already-parsed header (prescaled when ``scaled``), resize in
+    // RGB, subsample at the target size
+    if (scaled) {
+        cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
+        cinfo.scale_denom = 8;
+    }
     cinfo.out_color_space = JCS_RGB;
     jpeg_start_decompress(&cinfo);
     if (cinfo.output_components != 3) {
@@ -356,6 +492,7 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         jpeg_destroy_decompress(&cinfo);
         return 2;
     }
+    const int h = cinfo.output_height, w = cinfo.output_width;
     std::vector<uint8_t> tmp(static_cast<size_t>(h) * w * 3);
     while (cinfo.output_scanline < cinfo.output_height) {
         JSAMPROW row = tmp.data()
@@ -457,12 +594,15 @@ int sdl_jpeg_batch_decode(const uint8_t** blobs, const int64_t* lens,
 }
 
 // Fused infeed path: decode n JPEGs, bilinear-resize, channel-convert,
-// and pack into one contiguous [n, H, W, C] uint8 buffer. Failed rows
+// and pack into one contiguous [n, H, W, C] uint8 buffer. ``scaled``
+// != 0 enables DCT-domain prescale (decode at the smallest M/8 still
+// covering (H, W), then resize — see choose_scale_num). Failed rows
 // get ok[i]=0 (their dst slot is zeroed). This is the C++ host shim of
 // SURVEY §2.3: the whole decode→resize→layout chain in one native call.
 int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
                            int64_t n, uint8_t* dst, int32_t H, int32_t W,
-                           int32_t C, uint8_t* ok, int32_t num_threads) {
+                           int32_t C, uint8_t* ok, int32_t num_threads,
+                           int32_t scaled) {
 #ifdef SDL_HAVE_JPEG
     const size_t row_stride = static_cast<size_t>(H) * W * C;
 #ifdef _OPENMP
@@ -471,17 +611,12 @@ int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
 #endif
     for (int64_t i = 0; i < n; ++i) {
         ok[i] = 0;
-        int32_t h = 0, w = 0;
+        int h = 0, w = 0;
         uint8_t* out = dst + i * row_stride;
-        if (jpeg_dims(blobs[i], static_cast<size_t>(lens[i]),
-                      &h, &w, nullptr) != 0 || h <= 0 || w <= 0 ||
-            static_cast<int64_t>(h) * w > (int64_t)100000000) {
-            std::memset(out, 0, row_stride);
-            continue;
-        }
-        std::vector<uint8_t> tmp(static_cast<size_t>(h) * w * 3);
-        if (jpeg_decode_rgb(blobs[i], static_cast<size_t>(lens[i]),
-                            tmp.data(), h, w) != 0 ||
+        std::vector<uint8_t> tmp;
+        if (jpeg_decode_rgb_scaled(blobs[i], static_cast<size_t>(lens[i]),
+                                   tmp, scaled ? H : 0, scaled ? W : 0,
+                                   &h, &w) != 0 ||
             resize_one(tmp.data(), h, w, 3, out, H, W, C) != 0) {
             std::memset(out, 0, row_stride);
             continue;
@@ -491,7 +626,7 @@ int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
     return 0;
 #else
     (void)blobs; (void)lens; (void)n; (void)dst; (void)H; (void)W;
-    (void)C; (void)ok; (void)num_threads;
+    (void)C; (void)ok; (void)num_threads; (void)scaled;
     return 3;
 #endif
 }
@@ -507,7 +642,7 @@ int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
 int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
                                int64_t n, uint8_t* dst, int32_t H,
                                int32_t W, uint8_t* ok,
-                               int32_t num_threads) {
+                               int32_t num_threads, int32_t scaled) {
 #ifdef SDL_HAVE_JPEG
     if (H <= 0 || W <= 0 || (H % 2) != 0 || (W % 2) != 0) return 4;
     const size_t row_stride = yuv420_size(H, W);
@@ -518,7 +653,7 @@ int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
     for (int64_t i = 0; i < n; ++i) {
         uint8_t* out = dst + i * row_stride;
         if (jpeg_decode_420(blobs[i], static_cast<size_t>(lens[i]),
-                            out, H, W) != 0) {
+                            out, H, W, scaled) != 0) {
             std::memset(out, 0, row_stride);
             ok[i] = 0;
             continue;
@@ -528,7 +663,7 @@ int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
     return 0;
 #else
     (void)blobs; (void)lens; (void)n; (void)dst; (void)H; (void)W;
-    (void)ok; (void)num_threads;
+    (void)ok; (void)num_threads; (void)scaled;
     return 3;
 #endif
 }
@@ -559,6 +694,8 @@ int sdl_resize_pack_batch(const uint8_t** srcs,
     return status;
 }
 
-int sdl_version() { return 2; }
+// v3: DCT-prescaled decode (trailing ``scaled`` flag on the two fused
+// entry points); the Python binding checks this before passing it.
+int sdl_version() { return 3; }
 
 }  // extern "C"

@@ -301,8 +301,12 @@ class TestReadImagesPacked:
             3, axis=2).astype(np.uint8)
         Image.fromarray(smooth, "RGB").save(tmp_path / "x.png")
 
+        # scaledDecode=False: this is the exact-pixel oracle comparison
+        # (the scaled path's deliberate few-count difference is covered
+        # by TestScaledDecode)
         df = imageIO.readImagesPacked(str(tmp_path), (16, 16),
-                                      numPartitions=2)
+                                      numPartitions=2,
+                                      scaledDecode=False)
         packed = df.tensor("image")
         assert packed.shape == (5, 16, 16, 3)
 
@@ -480,3 +484,154 @@ class TestYuv420:
         with pytest.raises(ValueError, match="even"):
             imageIO.readImagesPacked(str(tmp_path), (15, 16),
                                      packedFormat="yuv420")
+
+
+class TestScaledDecode:
+    """DCT-domain prescaled decode (shim v3): libjpeg decodes at the
+    smallest M/8 covering the target, the bilinear step shrinks <2x.
+    Pins (a) bit-parity with PIL's draft mode where the scale factors
+    coincide, (b) closeness to the unscaled path on photo-like content,
+    (c) exactness when no shrink is possible, and (d) geometry safety
+    across scale factors and odd dims on the raw 4:2:0 path."""
+
+    def _jpeg(self, arr, quality=90, subsampling=2):
+        import io
+
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(arr, "RGB").save(buf, format="JPEG",
+                                         quality=quality,
+                                         subsampling=subsampling)
+        return buf.getvalue()
+
+    def test_matches_pil_draft_exactly_at_power_of_two(self, built):
+        """600² → 150² picks scale 1/4 — the same factor PIL's draft
+        mode picks — and the remaining resize is the identity, so the
+        two DCT prescales must agree bit-for-bit."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        import io
+
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(11)
+        blob = self._jpeg(textured_image(rng, 600, 600))
+        got, ok = native.decode_resize_pack([blob], 150, 150, 3,
+                                            scaled_decode=True)
+        assert ok.all()
+        im = Image.open(io.BytesIO(blob))
+        im.draft("RGB", (150, 150))
+        pil = np.asarray(im.convert("RGB"))
+        assert pil.shape == (150, 150, 3)
+        np.testing.assert_array_equal(got[0], pil)
+
+    def test_scaled_close_to_unscaled_on_photos(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(12)
+        blobs = [self._jpeg(textured_image(rng, 375, 500))
+                 for _ in range(4)]
+        for fn in (lambda s: native.decode_resize_pack(
+                       blobs, 150, 150, 3, scaled_decode=s)[0],
+                   lambda s: native.decode_resize_pack_420(
+                       blobs, 150, 150, scaled_decode=s)[0]):
+            a = fn(False).astype(int)
+            b = fn(True).astype(int)
+            d = np.abs(a - b)
+            assert d.mean() <= 4.0, d.mean()
+            assert d.max() <= 48, d.max()
+
+    def test_no_shrink_means_identical_output(self, built):
+        """Upscale targets leave M=8 (no prescale): scaled and unscaled
+        paths must agree exactly."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(13)
+        blob = self._jpeg(textured_image(rng, 40, 48))
+        a, _ = native.decode_resize_pack([blob], 64, 64, 3,
+                                         scaled_decode=False)
+        b, ok = native.decode_resize_pack([blob], 64, 64, 3,
+                                          scaled_decode=True)
+        assert ok.all()
+        np.testing.assert_array_equal(a, b)
+        a4, _ = native.decode_resize_pack_420([blob], 64, 64,
+                                              scaled_decode=False)
+        b4, ok4 = native.decode_resize_pack_420([blob], 64, 64,
+                                                scaled_decode=True)
+        assert ok4.all()
+        np.testing.assert_array_equal(a4, b4)
+
+    @pytest.mark.parametrize("src_hw,dst", [
+        ((375, 500), 150),   # 1/2 on the raw path
+        ((375, 501), 150),   # odd width: iMCU edge handling
+        ((1200, 1600), 150),  # 1/8: smallest scaled IDCT
+        ((301, 400), 150),   # barely covers: no power-of-two shrink
+    ])
+    def test_raw420_scaled_geometry(self, built, src_hw, dst):
+        """The raw-420 prescale derives per-component strides/rows from
+        comp_info (Y scales, stored chroma doesn't); every factor and
+        odd-dim edge must produce valid planes close to the unscaled
+        route's."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(14)
+        blob = self._jpeg(textured_image(rng, *src_hw))
+        a, oka = native.decode_resize_pack_420([blob], dst, dst,
+                                               scaled_decode=False)
+        b, okb = native.decode_resize_pack_420([blob], dst, dst,
+                                               scaled_decode=True)
+        assert oka.all() and okb.all()
+        d = np.abs(a.astype(int) - b.astype(int))
+        assert d.mean() <= 4.0, (src_hw, d.mean())
+
+    def test_gray_and_444_fallback_scaled(self, built):
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        import io
+
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(15)
+        # 4:4:4 source takes the RGB-decode fallback
+        blob444 = self._jpeg(textured_image(rng, 200, 200),
+                             subsampling=0)
+        a, oka = native.decode_resize_pack_420([blob444], 64, 64,
+                                               scaled_decode=True)
+        assert oka.all()
+        # grayscale source: scaled luma decode, neutral chroma
+        g = np.clip(rng.normal(128, 40, (200, 200)), 0,
+                    255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(g, "L").save(buf, format="JPEG", quality=90)
+        b, okb = native.decode_resize_pack_420([buf.getvalue()], 64, 64,
+                                               scaled_decode=True)
+        assert okb.all()
+        chroma = b[0][64 * 64:]
+        assert chroma.min() == chroma.max() == 128
+
+    def test_scaled_reader_close_to_unscaled_reader(self, built,
+                                                    tmp_path):
+        """readImagesPacked's default (scaledDecode=True) stays within
+        a few counts of the scaledDecode=False rows on photo content —
+        the documented fidelity statement for the default."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(16)
+        for i in range(3):
+            Image.fromarray(textured_image(rng, 120, 160), "RGB").save(
+                tmp_path / f"s{i}.jpg", quality=90)
+        scaled = imageIO.readImagesPacked(
+            str(tmp_path), (48, 64), numPartitions=2).tensor("image")
+        unscaled = imageIO.readImagesPacked(
+            str(tmp_path), (48, 64), numPartitions=2,
+            scaledDecode=False).tensor("image")
+        d = np.abs(scaled.astype(int) - unscaled.astype(int))
+        assert d.mean() <= 4.0, d.mean()
