@@ -359,16 +359,21 @@ def main() -> None:
                    "default": "einsum", "default_is_fastest": None}
     if on_tpu:
         try:
-            r_e = measure_device_resident(
-                deviceResizeModel(mf, packed_src, use_pallas=False),
-                batch_size, n_batches=16)
-            r_p = measure_device_resident(
-                deviceResizeModel(mf, packed_src, use_pallas=True),
-                batch_size, n_batches=16)
-            infeed_race["einsum_ips"] = r_e["ips"]
-            infeed_race["pallas_ips"] = r_p["ips"]
-            infeed_race["default_is_fastest"] = \
-                r_e["ips"] >= r_p["ips"]
+            m_e = deviceResizeModel(mf, packed_src, use_pallas=False)
+            m_p = deviceResizeModel(mf, packed_src, use_pallas=True)
+            # INTERLEAVED repeats, per-variant max: a single-shot race
+            # on the tunneled device confuses drift for a winner (one
+            # run measured pallas +4% where three interleaved repeats
+            # showed einsum +6% every time, 2026-07-31)
+            e_best = p_best = 0.0
+            for _ in range(2):
+                e_best = max(e_best, measure_device_resident(
+                    m_e, batch_size, n_batches=16)["ips"])
+                p_best = max(p_best, measure_device_resident(
+                    m_p, batch_size, n_batches=16)["ips"])
+            infeed_race["einsum_ips"] = e_best
+            infeed_race["pallas_ips"] = p_best
+            infeed_race["default_is_fastest"] = e_best >= p_best
         except Exception as e:  # kernel lowering can shift across jax
             infeed_race["error"] = f"{type(e).__name__}: {e}"[:200]
 

@@ -273,6 +273,29 @@ def resizeImageArray(arr: np.ndarray, height: int, width: int,
     return out
 
 
+def _draftDecodeResize(blob: bytes, height: int, width: int,
+                       nChannels: int) -> Optional[np.ndarray]:
+    """PIL-fallback twin of the shim's DCT-prescaled decode: ``draft``
+    picks a power-of-two prescale by the SAME rule the native
+    ``choose_scale_num`` uses (floor semantics — engage 1/2^k only when
+    src >= 2^k * dst on both axes; the native rule was deliberately
+    matched to PIL's, see sparkdl_host.cpp), so the no-toolchain host
+    keeps both the speedup and the semantics of ``scaledDecode=True``
+    on identical inputs. Returns None when the blob can't be handled
+    (caller falls back to the general ``_decodeImage`` route)."""
+    import io
+    try:
+        im = Image.open(io.BytesIO(blob))
+        im.draft("L" if nChannels == 1 else "RGB", (width, height))
+        im = im.convert("L" if nChannels == 1 else "RGB")
+        arr = np.asarray(im)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return resizeImageArray(arr, height, width, nChannels)
+    except Exception:
+        return None
+
+
 def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
                          ) -> Callable[[pa.RecordBatch], pa.Array]:
     """Batch function resizing the ``image`` column to (height, width) —
@@ -473,16 +496,18 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     dims and ``nChannels=3``.
 
     ``scaledDecode`` (default True): shrink mostly in the DCT domain —
-    libjpeg decodes at the smallest M/8 of the source that still covers
-    ``size``, skipping IDCT work, and the bilinear step then shrinks by
-    <2x. Besides being cheaper it is the better-filtered downscale
-    (bilinear straight from ≥2x skips source rows; the DCT prescale is
-    a proper low-pass — the same trick as PIL's ``draft`` mode, with
-    bit-identical output where the scale factors coincide). Pixel
-    values differ from the full-res-decode path by a few counts on
-    shrink; pass False for the pure bilinear-from-full-res pixels (and
-    see the fused-vs-two-step exactness test in tests/test_native.py).
-    Non-JPEG sources and the PIL fallback are unaffected.
+    libjpeg decodes at the smallest power-of-two M/8 of the source
+    that still covers ``size``, skipping IDCT work, and the bilinear
+    step then shrinks by <2x. Besides being cheaper it is the
+    better-filtered downscale (bilinear straight from ≥2x skips source
+    rows; the DCT prescale is a proper low-pass — the same rule AND
+    factor choice as PIL's ``draft`` mode, bit-identical where the
+    remaining resize is the identity). Pixel values differ from the
+    full-res-decode path by a few counts on shrink; pass False for the
+    pure bilinear-from-full-res pixels (and see the fused-vs-two-step
+    exactness test in tests/test_native.py). Non-JPEG sources are
+    unaffected; the no-shim PIL fallback applies the same prescale via
+    ``draft``.
     """
     height, width = int(size[0]), int(size[1])
     if packedFormat not in ("rgb", "yuv420"):
@@ -553,11 +578,17 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
         for i in range(n):
             if ok[i]:
                 continue
-            s = _decodeImage(blobs[i], origin=fp[i])
-            if s is None:
-                continue
-            arr = resizeImageArray(imageStructToArray(s), height, width,
-                                   nChannels)
+            arr = None
+            if scaledDecode and isinstance(blobs[i], (bytes, bytearray)) \
+                    and blobs[i][:3] == _JPEG_MAGIC:
+                arr = _draftDecodeResize(blobs[i], height, width,
+                                         nChannels)
+            if arr is None:
+                s = _decodeImage(blobs[i], origin=fp[i])
+                if s is None:
+                    continue
+                arr = resizeImageArray(imageStructToArray(s), height,
+                                       width, nChannels)
             out[i] = rgbToYuv420(arr) if yuv else arr
             ok[i] = True
 

@@ -75,15 +75,6 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32,                                   # num_threads
     ]
     lib.sdl_version.restype = ctypes.c_int
-    # Shim v3 appended a trailing ``scaled`` flag to the two fused
-    # decode entry points (DCT-prescaled decode); a binary-only deploy
-    # of an older .so keeps the old signature, so the version gates
-    # both the argtypes and whether callers may pass the flag.
-    v3 = False
-    try:
-        v3 = lib.sdl_version() >= 3
-    except AttributeError:
-        pass
     # JPEG symbols are OPTIONAL: a binary-only .so from an older build
     # may lack them — the resize path must keep working regardless.
     try:
@@ -104,7 +95,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.sdl_decode_resize_pack.argtypes = [
             _pp, _pi64, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, _pu8,
-            ctypes.c_int32] + ([ctypes.c_int32] if v3 else [])
+            ctypes.c_int32]
         lib._sdl_jpeg_bound = True
     except AttributeError:
         lib._sdl_jpeg_bound = False
@@ -115,12 +106,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32] \
-            + ([ctypes.c_int32] if v3 else [])
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
         lib._sdl_420_bound = bool(lib._sdl_jpeg_bound)
     except AttributeError:
         lib._sdl_420_bound = False
-    lib._sdl_scaled_bound = v3
+    # DCT-prescaled decode arrived in shim v3 as NEW ``*_v3`` symbols
+    # with a trailing ``scaled`` flag — the v2-named symbols keep their
+    # signatures, so neither direction of wrapper/binary version skew
+    # can miscall a changed signature (args 7+ travel on the stack).
+    try:
+        lib.sdl_decode_resize_pack_v3.restype = ctypes.c_int
+        lib.sdl_decode_resize_pack_v3.argtypes = \
+            list(lib.sdl_decode_resize_pack.argtypes) + [ctypes.c_int32]
+        lib.sdl_decode_resize_pack_420_v3.restype = ctypes.c_int
+        lib.sdl_decode_resize_pack_420_v3.argtypes = \
+            list(lib.sdl_decode_resize_pack_420.argtypes) \
+            + [ctypes.c_int32]
+        lib._sdl_scaled_bound = bool(lib._sdl_jpeg_bound)
+    except AttributeError:
+        lib._sdl_scaled_bound = False
     return lib
 
 
@@ -256,13 +260,18 @@ def decode_resize_pack(blobs: Sequence[bytes], height: int, width: int,
     if n == 0:
         return out, ok.astype(bool)
     ptrs, lens, refs = _blob_ptrs(blobs)
-    scaled = ([int(bool(scaled_decode))]
-              if getattr(lib, "_sdl_scaled_bound", False) else [])
-    lib.sdl_decode_resize_pack(
-        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
-        out.ctypes.data, height, width, nChannels,
-        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads,
-        *scaled)
+    if scaled_decode and getattr(lib, "_sdl_scaled_bound", False):
+        lib.sdl_decode_resize_pack_v3(
+            ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data, height, width, nChannels,
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            num_threads, 1)
+    else:
+        lib.sdl_decode_resize_pack(
+            ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data, height, width, nChannels,
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            num_threads)
     return out, ok.astype(bool)
 
 
@@ -302,13 +311,18 @@ def decode_resize_pack_420(blobs: Sequence[bytes], height: int,
     if n == 0:
         return out, ok.astype(bool)
     ptrs, lens, refs = _blob_ptrs(blobs)
-    scaled = ([int(bool(scaled_decode))]
-              if getattr(lib, "_sdl_scaled_bound", False) else [])
-    rc = lib.sdl_decode_resize_pack_420(
-        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
-        out.ctypes.data, height, width,
-        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads,
-        *scaled)
+    if scaled_decode and getattr(lib, "_sdl_scaled_bound", False):
+        rc = lib.sdl_decode_resize_pack_420_v3(
+            ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data, height, width,
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            num_threads, 1)
+    else:
+        rc = lib.sdl_decode_resize_pack_420(
+            ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data, height, width,
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            num_threads)
     if rc != 0:
         raise ValueError(f"native 4:2:0 decode/pack failed (rc={rc})")
     return out, ok.astype(bool)

@@ -219,22 +219,24 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 // DCT-domain prescale selection (libjpeg scaled decode): smallest
-// power-of-two M/8 whose scaled dims still cover the target on BOTH
-// axes. Power-of-two only, for two measured reasons: (a) the 1x1/2x2/
-// 4x4 scaled IDCTs are the SIMD-accelerated kernels — the intermediate
-// M/8 factors fall back to scalar IDCTs that measured SLOWER than the
-// full SIMD 8x8 (375x500→299²: 453 vs 532 img/s at 7/8 on this host);
-// (b) raw-data mode pairs a scaled Y IDCT with unscaled stored chroma
-// and the pow2 sizes are what every libjpeg ships there. The <2x
-// bilinear-after guarantee survives: if M/2 failed to cover then
-// src*M/8 < 2*target. Returns 8 (no scaling) when even 4/8 would
-// undershoot. (PIL's draft mode makes the same pow2-only choice, which
-// is why the two agree bit-for-bit where they both engage.)
+// power-of-two M/8 with src*M >= 8*dst on BOTH axes. Power-of-two
+// only, for two measured reasons: (a) the 1x1/2x2/4x4 scaled IDCTs
+// are the SIMD-accelerated kernels — the intermediate M/8 factors fall
+// back to scalar IDCTs that measured SLOWER than the full SIMD 8x8
+// (375x500→299²: 453 vs 532 img/s at 7/8 on this host); (b) raw-data
+// mode pairs a scaled Y IDCT with unscaled stored chroma and the pow2
+// sizes are what every libjpeg ships there. The acceptance rule is
+// deliberately floor semantics (src >= (8/M)*dst, NOT ceil of the
+// scaled dims >= dst): it is exactly PIL draft's rule, so the two
+// prescales engage on identical inputs and agree bit-for-bit — ceil
+// would additionally engage only in the one-pixel band
+// src == 2*dst - 1 (e.g. 299→150), where PIL stays at full res. The
+// <2x bilinear-after guarantee survives: if M/2 failed to cover then
+// src*M/8 < 2*dst. Returns 8 (no scaling) when even 4/8 undershoots.
 int choose_scale_num(int src_h, int src_w, int dst_h, int dst_w) {
     for (int m = 1; m < 8; m *= 2) {
-        const long h = (static_cast<long>(src_h) * m + 7) / 8;
-        const long w = (static_cast<long>(src_w) * m + 7) / 8;
-        if (h >= dst_h && w >= dst_w) return m;
+        if (static_cast<long>(src_h) * m >= 8L * dst_h &&
+            static_cast<long>(src_w) * m >= 8L * dst_w) return m;
     }
     return 8;
 }
@@ -381,6 +383,13 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
     uint8_t* Cr = Cb + static_cast<size_t>(H / 2) * (W / 2);
     const size_t chroma_bytes = static_cast<size_t>(H / 2) * (W / 2);
 
+    // one prescale policy for every branch below (raw420 detection
+    // reads only sampling factors, which scale_num doesn't affect)
+    if (scaled) {
+        cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
+        cinfo.scale_denom = 8;
+    }
+
     const bool raw420 = cinfo.jpeg_color_space == JCS_YCbCr
         && cinfo.num_components == 3
         && cinfo.comp_info[0].h_samp_factor == 2
@@ -391,10 +400,6 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         && cinfo.comp_info[2].v_samp_factor == 1;
 
     if (raw420) {
-        if (scaled) {
-            cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
-            cinfo.scale_denom = 8;
-        }
         cinfo.raw_data_out = TRUE;
         cinfo.out_color_space = JCS_YCbCr;
         jpeg_start_decompress(&cinfo);
@@ -433,13 +438,12 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
                              * rows_per[i]));
         }
         JSAMPROW rows0[16], rows1[16], rows2[16];
-        JSAMPROW* rowsets[3] = {rows0, rows1, rows2};
         JSAMPARRAY planes[3] = {rows0, rows1, rows2};
         for (int r = 0; r < imcu_rows
                  && cinfo.output_scanline < cinfo.output_height; ++r) {
             for (int i = 0; i < 3; ++i)
                 for (int k = 0; k < rows_per[i]; ++k)
-                    rowsets[i][k] = buf[i].data()
+                    planes[i][k] = buf[i].data()
                         + (static_cast<size_t>(r) * rows_per[i] + k)
                         * stride[i];
             jpeg_read_raw_data(&cinfo, planes, mcu_h);
@@ -457,10 +461,6 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
     }
 
     if (cinfo.num_components == 1) {
-        if (scaled) {
-            cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
-            cinfo.scale_denom = 8;
-        }
         cinfo.out_color_space = JCS_GRAYSCALE;
         jpeg_start_decompress(&cinfo);
         const int h = cinfo.output_height, w = cinfo.output_width;
@@ -481,10 +481,6 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
     // non-4:2:0 color (4:4:4 / 4:2:2 / RGB-coded): decode inline from
     // the already-parsed header (prescaled when ``scaled``), resize in
     // RGB, subsample at the target size
-    if (scaled) {
-        cinfo.scale_num = choose_scale_num(full_h, full_w, H, W);
-        cinfo.scale_denom = 8;
-    }
     cinfo.out_color_space = JCS_RGB;
     jpeg_start_decompress(&cinfo);
     if (cinfo.output_components != 3) {
@@ -599,10 +595,11 @@ int sdl_jpeg_batch_decode(const uint8_t** blobs, const int64_t* lens,
 // covering (H, W), then resize — see choose_scale_num). Failed rows
 // get ok[i]=0 (their dst slot is zeroed). This is the C++ host shim of
 // SURVEY §2.3: the whole decode→resize→layout chain in one native call.
-int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
-                           int64_t n, uint8_t* dst, int32_t H, int32_t W,
-                           int32_t C, uint8_t* ok, int32_t num_threads,
-                           int32_t scaled) {
+int sdl_decode_resize_pack_v3(const uint8_t** blobs,
+                              const int64_t* lens, int64_t n,
+                              uint8_t* dst, int32_t H, int32_t W,
+                              int32_t C, uint8_t* ok,
+                              int32_t num_threads, int32_t scaled) {
 #ifdef SDL_HAVE_JPEG
     const size_t row_stride = static_cast<size_t>(H) * W * C;
 #ifdef _OPENMP
@@ -639,10 +636,11 @@ int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
 // (ops/infeed.py) fuses upsample + color conversion + resize into the
 // model program. H and W must be even (returns 4). Failed rows get
 // ok[i]=0 with a zeroed slot.
-int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
-                               int64_t n, uint8_t* dst, int32_t H,
-                               int32_t W, uint8_t* ok,
-                               int32_t num_threads, int32_t scaled) {
+int sdl_decode_resize_pack_420_v3(const uint8_t** blobs,
+                                  const int64_t* lens, int64_t n,
+                                  uint8_t* dst, int32_t H, int32_t W,
+                                  uint8_t* ok, int32_t num_threads,
+                                  int32_t scaled) {
 #ifdef SDL_HAVE_JPEG
     if (H <= 0 || W <= 0 || (H % 2) != 0 || (W % 2) != 0) return 4;
     const size_t row_stride = yuv420_size(H, W);
@@ -694,8 +692,28 @@ int sdl_resize_pack_batch(const uint8_t** srcs,
     return status;
 }
 
-// v3: DCT-prescaled decode (trailing ``scaled`` flag on the two fused
-// entry points); the Python binding checks this before passing it.
+// v2-signature entry points, kept byte-compatible so an older Python
+// wrapper paired with this binary cannot feed the v3 functions an
+// extra-argument call (args 7+ travel on the stack in SysV — the v3
+// impl would read garbage for ``scaled``). New capability = NEW symbol,
+// the same convention the v2 4:2:0 packer used.
+int sdl_decode_resize_pack(const uint8_t** blobs, const int64_t* lens,
+                           int64_t n, uint8_t* dst, int32_t H, int32_t W,
+                           int32_t C, uint8_t* ok, int32_t num_threads) {
+    return sdl_decode_resize_pack_v3(blobs, lens, n, dst, H, W, C, ok,
+                                     num_threads, 0);
+}
+
+int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
+                               int64_t n, uint8_t* dst, int32_t H,
+                               int32_t W, uint8_t* ok,
+                               int32_t num_threads) {
+    return sdl_decode_resize_pack_420_v3(blobs, lens, n, dst, H, W, ok,
+                                         num_threads, 0);
+}
+
+// v3: DCT-prescaled decode via the NEW ``*_v3`` symbols (trailing
+// ``scaled`` flag); the v2-named symbols keep their old signatures.
 int sdl_version() { return 3; }
 
 }  // extern "C"

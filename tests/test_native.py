@@ -568,7 +568,10 @@ class TestScaledDecode:
         ((375, 500), 150),   # 1/2 on the raw path
         ((375, 501), 150),   # odd width: iMCU edge handling
         ((1200, 1600), 150),  # 1/8: smallest scaled IDCT
-        ((301, 400), 150),   # barely covers: no power-of-two shrink
+        ((301, 400), 150),   # 1/2 engages just above the floor
+                             # boundary (301 >= 2*150; Y lands at 151)
+        ((299, 400), 150),   # just BELOW it (299 < 2*150): no
+                             # prescale on the raw420 path, M=8
     ])
     def test_raw420_scaled_geometry(self, built, src_hw, dst):
         """The raw-420 prescale derives per-component strides/rows from
@@ -635,3 +638,43 @@ class TestScaledDecode:
             scaledDecode=False).tensor("image")
         d = np.abs(scaled.astype(int) - unscaled.astype(int))
         assert d.mean() <= 4.0, d.mean()
+
+    def test_pil_fallback_draft_matches_native_scaled(self, built,
+                                                      tmp_path,
+                                                      monkeypatch):
+        """With the native packer unavailable, scaledDecode=True routes
+        JPEG fallbacks through PIL's draft mode — the same pow2 DCT
+        prescale — so no-toolchain hosts keep the semantics (and most
+        of the speed) of the native scaled path."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(17)
+        for i in range(3):
+            Image.fromarray(textured_image(rng, 128, 128), "RGB").save(
+                tmp_path / f"d{i}.jpg", quality=90)
+        nat = imageIO.readImagesPacked(
+            str(tmp_path), (32, 32), numPartitions=2).tensor("image")
+        monkeypatch.setattr(native, "decode_resize_pack",
+                            lambda *a, **k: None)
+        pil = imageIO.readImagesPacked(
+            str(tmp_path), (32, 32), numPartitions=2).tensor("image")
+        # both took the same 1/4 DCT prescale; only the final <2x
+        # bilinear differs (shim vs PIL filter)
+        d = np.abs(nat.astype(int) - pil.astype(int))
+        assert d.mean() <= 4.0, d.mean()
+        # scaledDecode=False falls back through the general full-res
+        # route: decode + resizeImageArray per row — pin against that
+        # exact oracle (packImageBatch would resize with the SHIM here,
+        # a different resampler)
+        unscaled_pil = imageIO.readImagesPacked(
+            str(tmp_path), (32, 32), numPartitions=2,
+            scaledDecode=False).tensor("image")
+        gen = imageIO.readImages(str(tmp_path), numPartitions=2)
+        oracle = np.stack([
+            imageIO.resizeImageArray(
+                imageIO.imageStructToArray(s), 32, 32, 3)
+            for s in gen.collect().column("image").to_pylist()])
+        np.testing.assert_array_equal(unscaled_pil, oracle)

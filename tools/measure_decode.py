@@ -89,6 +89,29 @@ def main() -> None:
                   f"img/s ({yuv / shim[1]:.2f}x vs RGB, at half the "
                   "output bytes)")
 
+        # DCT-prescale on/off at the packed ship size (shim v3): only
+        # engages when a power-of-two M/8 still covers the target —
+        # 150² from 375×500 scales 1/2; the 299² sweep above does not
+        scaled = {}
+        if getattr(native.get_lib(), "_sdl_scaled_bound", False):
+            ship = (150, 150)
+            for fmt, call in (
+                    ("rgb", lambda s: native.decode_resize_pack(
+                        blobs, ship[0], ship[1], 3, num_threads=1,
+                        scaled_decode=s)),
+                    ("yuv420", lambda s: native.decode_resize_pack_420(
+                        blobs, ship[0], ship[1], num_threads=1,
+                        scaled_decode=s))):
+                for s in (False, True):
+                    scaled[f"{fmt}_{'scaled' if s else 'full'}"] = \
+                        best_rate(lambda s=s, call=call: call(s),
+                                  n_images)
+            print(f"\nDCT-prescale at {ship} (1 thread, img/s):")
+            for fmt in ("rgb", "yuv420"):
+                f, sc = scaled[f"{fmt}_full"], scaled[f"{fmt}_scaled"]
+                print(f"  {fmt}: full-decode={f:8.1f}  "
+                      f"prescaled={sc:8.1f}  ({sc / f:.2f}x)")
+
         engine = {}
         for parts in (1, 2, 4, 8):
             for mode, threads in (("split", None), ("naive", 0)):
@@ -111,6 +134,8 @@ def main() -> None:
                                     for k, v in shim.items()},
             "shim_420_ips_1thread": (round(yuv, 1)
                                      if yuv is not None else None),
+            "prescale_ips_150": {k: round(v, 1)
+                                 for k, v in scaled.items()},
             "engine_ips": {f"p{p}_{m}": round(v, 1)
                            for (p, m), v in engine.items()},
             "note": ("shim scaling beyond host_cores threads is flat by "
