@@ -18,6 +18,12 @@ model, trading host CPU work for device work:
                JPEGs stream out of libjpeg raw, skipping host chroma
                work entirely)
 
+The packed readers (2 and 4) additionally prescale in the DCT domain
+by default (scaledDecode=True: libjpeg decodes at the largest
+power-of-two shrink still covering the target — PIL-draft semantics,
+most IDCT work skipped; scaledDecode=False restores full-res-decode
+pixels).
+
 Run on CPU:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/fast_infeed.py

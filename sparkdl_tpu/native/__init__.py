@@ -110,8 +110,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib._sdl_420_bound = bool(lib._sdl_jpeg_bound)
     except AttributeError:
         lib._sdl_420_bound = False
-    # DCT-prescaled decode arrived in shim v3 as NEW ``*_v3`` symbols
-    # with a trailing ``scaled`` flag — the v2-named symbols keep their
+    # DCT-prescaled decode arrived as NEW ``*_v3`` symbols with a
+    # trailing ``scaled`` flag — the v2-named symbols keep their
     # signatures, so neither direction of wrapper/binary version skew
     # can miscall a changed signature (args 7+ travel on the stack).
     try:
@@ -125,6 +125,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib._sdl_scaled_bound = bool(lib._sdl_jpeg_bound)
     except AttributeError:
         lib._sdl_scaled_bound = False
+        # An interim build exported version 3 with the flag appended to
+        # the v2-NAMED symbols (no *_v3). Calling those with the 9-arg
+        # signature would read ``scaled`` from a garbage stack slot and
+        # nondeterministically change pixels — refuse that binary's
+        # JPEG symbols (PIL fallback takes over) instead of guessing.
+        try:
+            if lib.sdl_version() == 3:
+                lib._sdl_jpeg_bound = False
+                lib._sdl_420_bound = False
+        except AttributeError:
+            pass
     return lib
 
 

@@ -712,8 +712,11 @@ int sdl_decode_resize_pack_420(const uint8_t** blobs, const int64_t* lens,
                                          num_threads, 0);
 }
 
-// v3: DCT-prescaled decode via the NEW ``*_v3`` symbols (trailing
+// v4: DCT-prescaled decode via the NEW ``*_v3`` symbols (trailing
 // ``scaled`` flag); the v2-named symbols keep their old signatures.
-int sdl_version() { return 3; }
+// (An interim build briefly shipped version 3 with the flag appended
+// to the v2-named symbols instead — the binding refuses that ABI's
+// JPEG symbols rather than guess a signature, hence the skip to 4.)
+int sdl_version() { return 4; }
 
 }  // extern "C"

@@ -678,3 +678,43 @@ class TestScaledDecode:
                 imageIO.imageStructToArray(s), 32, 32, 3)
             for s in gen.collect().column("image").to_pylist()])
         np.testing.assert_array_equal(unscaled_pil, oracle)
+
+    def test_engage_rule_matches_pil_draft_across_geometries(self,
+                                                             built):
+        """Property: the native prescale and PIL's draft engage on
+        IDENTICAL (source, target) pairs — the floor rule src >= 2^k *
+        dst on both axes (sparkdl_host.cpp::choose_scale_num was
+        deliberately matched to PIL). Random geometries either side of
+        the boundary, plus the exact 2*dst-1 band where a ceil rule
+        would diverge."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        import io
+
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(20)
+        cases = [(int(h), int(w), int(t)) for h, w, t in zip(
+            rng.integers(40, 700, 8), rng.integers(40, 700, 8),
+            rng.integers(20, 200, 8))]
+        cases += [(2 * 64 - 1, 400, 64),   # ceil-vs-floor band
+                  (2 * 64, 400, 64),       # exactly at the boundary
+                  (8 * 30, 8 * 30, 30)]    # deepest scale, exact
+        for h, w, t in cases:
+            blob_buf = io.BytesIO()
+            Image.fromarray(textured_image(rng, h, w), "RGB").save(
+                blob_buf, format="JPEG", quality=90, subsampling=2)
+            blob = blob_buf.getvalue()
+            te = t - t % 2 or 2  # even target for the 420 packer
+            im = Image.open(io.BytesIO(blob))
+            im.draft("RGB", (te, te))
+            pil_engaged = im.size != (w, h)
+            a, _ = native.decode_resize_pack([blob], te, te, 3,
+                                             scaled_decode=False)
+            b, ok = native.decode_resize_pack([blob], te, te, 3,
+                                              scaled_decode=True)
+            assert ok.all(), (h, w, te)
+            native_engaged = not np.array_equal(a, b)
+            assert native_engaged == pil_engaged, \
+                (h, w, te, native_engaged, pil_engaged)
