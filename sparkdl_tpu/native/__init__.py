@@ -129,11 +129,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         # the v2-NAMED symbols (no *_v3). Calling those with the 9-arg
         # signature would read ``scaled`` from a garbage stack slot and
         # nondeterministically change pixels — refuse that binary's
-        # JPEG symbols (PIL fallback takes over) instead of guessing.
+        # JPEG symbols (PIL fallback takes over) instead of guessing,
+        # and say so: the silent alternative is a multi-x decode
+        # regression with nothing in the logs to attribute it to.
         try:
             if lib.sdl_version() == 3:
                 lib._sdl_jpeg_bound = False
                 lib._sdl_420_bound = False
+                logger.warning(
+                    "native shim binary has the interim v3 ABI "
+                    "(scaled flag on the v2-named symbols, no *_v3); "
+                    "refusing its JPEG entry points — decode falls "
+                    "back to the per-row PIL path. Rebuild the shim "
+                    "(delete _sparkdl_host.so next to the source) to "
+                    "restore the native fast path.")
         except AttributeError:
             pass
     return lib

@@ -718,3 +718,48 @@ class TestScaledDecode:
             native_engaged = not np.array_equal(a, b)
             assert native_engaged == pil_engaged, \
                 (h, w, te, native_engaged, pil_engaged)
+
+    def test_mixed_source_zoo_routes_every_row(self, built, tmp_path):
+        """Robustness fuzz for the fused+fallback routing: a directory
+        mixing baseline/progressive/4:4:4/grayscale JPEGs, a PNG, and
+        a corrupt file must come back with every decodable row present
+        (in both packed formats, scaled and not) and the corrupt row
+        dropped — no silent zero-tensors, no misrouted rows."""
+        if not native.has_jpeg():
+            pytest.skip("libjpeg not available at build time")
+        from PIL import Image
+
+        from sparkdl_tpu.utils.synth import textured_image
+        rng = np.random.default_rng(21)
+        mk = lambda: textured_image(rng, 40, 48)
+        Image.fromarray(mk(), "RGB").save(tmp_path / "a_base.jpg",
+                                          quality=90, subsampling=2)
+        Image.fromarray(mk(), "RGB").save(tmp_path / "b_prog.jpg",
+                                          quality=90, subsampling=2,
+                                          progressive=True)
+        Image.fromarray(mk(), "RGB").save(tmp_path / "c_444.jpg",
+                                          quality=92, subsampling=0)
+        Image.fromarray(mk()[:, :, 0], "L").save(tmp_path / "d_gray.jpg",
+                                                 quality=90)
+        Image.fromarray(mk(), "RGB").save(tmp_path / "e_png.png")
+        (tmp_path / "f_corrupt.jpg").write_bytes(b"\xff\xd8\xff\x00junk")
+
+        for fmt in ("rgb", "yuv420"):
+            for scaled in (True, False):
+                df = imageIO.readImagesPacked(
+                    str(tmp_path), (16, 16), numPartitions=2,
+                    packedFormat=fmt, scaledDecode=scaled,
+                    dropImageFailures=False)
+                rows = df.collect_rows()
+                ok = {r["filePath"].rsplit("/", 1)[-1]: r["imageOk"]
+                      for r in rows}
+                assert len(rows) == 6, (fmt, scaled, len(rows))
+                expect = {"a_base.jpg": True, "b_prog.jpg": True,
+                          "c_444.jpg": True, "d_gray.jpg": True,
+                          "e_png.png": True, "f_corrupt.jpg": False}
+                assert ok == expect, (fmt, scaled, ok)
+                # decoded rows carry real data, not zeroed slots
+                for r in rows:
+                    if r["imageOk"]:
+                        assert np.asarray(r["image"]).max() > 0, \
+                            (fmt, scaled, r["filePath"])

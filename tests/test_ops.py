@@ -113,6 +113,30 @@ class TestYuv420DeviceOp:
         assert d.mean() <= 2.5, d.mean()
         assert np.percentile(d, 99) <= 12.0, np.percentile(d, 99)
 
+    def test_one_pixel_upscale_matches_rgb_path(self, rng):
+        """The no-resolution-loss packed shape ships even dims one
+        pixel under an odd model size (bench: 298² planes → 299²
+        program). At near-identity sizes the RGB route passes pixels
+        through almost sharp, so the comparison exposes the BARE 2×2
+        chroma-subsample cost (a downscale low-passes both routes and
+        shrinks it — measured mean 6.5 at identity vs 2.8 at half
+        size on full-bandwidth synthetic chroma). Luma must stay
+        essentially exact — that's the op's own accuracy; chroma gets
+        the format's inherent tolerance."""
+        from sparkdl_tpu.image.imageIO import rgbToYuv420
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        from sparkdl_tpu.utils.synth import textured_image
+        rgb = np.stack([textured_image(rng, 28, 28) for _ in range(2)])
+        packed = np.stack([rgbToYuv420(im) for im in rgb])
+        got = np.asarray(fused_yuv420_resize_normalize(
+            packed, (28, 28), (29, 29)))
+        exp = np.asarray(fused_resize_normalize(rgb, (29, 29)))
+        wy = np.array([0.299, 0.587, 0.114])
+        luma_d = np.abs((got * wy).sum(-1) - (exp * wy).sum(-1))
+        assert luma_d.mean() <= 0.5, luma_d.mean()
+        d = np.abs(got - exp)
+        assert d.mean() <= 6.0, d.mean()
+
     def test_scale_offset_dtype(self):
         from sparkdl_tpu.image.imageIO import rgbToYuv420
         from sparkdl_tpu.ops import fused_yuv420_resize_normalize
