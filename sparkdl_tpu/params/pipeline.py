@@ -56,6 +56,65 @@ class Model(Transformer):
     """A Transformer produced by an Estimator."""
 
 
+def _split_extra(owner: Params, extra):
+    """Partition a param-map override into entries the pipeline-like
+    ``owner`` itself owns vs entries destined for its stages — the
+    pyspark semantic that makes
+    ``CrossValidator(Pipeline([featurizer, lr]), grid_on_lr_params)``
+    work: a grid entry keyed by a STAGE's Param must reach that stage's
+    copy, not be resolved against the Pipeline (which owns only
+    ``stages``). String keys resolve against the owner only — without
+    a parent they cannot name a stage param unambiguously."""
+    own, foreign = {}, {}
+    for p, v in (extra or {}).items():
+        if isinstance(p, str) or owner.hasParam(p.name):
+            own[p] = v
+        else:
+            foreign[p] = v
+    return own, foreign
+
+
+def _child_stage_list(stage):
+    """The nested stage list of a pipeline-like stage, else None.
+    (``Pipeline.stages`` is a Param descriptor at class level, so the
+    instance attribute probe applies only to PipelineModel.)"""
+    if isinstance(stage, Pipeline):
+        return stage.getStages()
+    kids = getattr(stage, "stages", None)
+    return kids if isinstance(kids, list) else None
+
+
+def _carries_param(stage, p) -> bool:
+    """Whether ``stage`` (or, recursively, a nested pipeline's stage)
+    owns Param ``p`` — nested pipelines forward their sub-map through
+    their own ``copy``, matching pyspark's recursive semantics."""
+    if any(q == p for q in stage.params):
+        return True
+    kids = _child_stage_list(stage)
+    return bool(kids) and any(_carries_param(k, p) for k in kids)
+
+
+def _stage_subs(owner: Params, stages, foreign):
+    """Per-stage sub-maps of ``foreign`` (entries owned by that stage,
+    directly or through nesting); an entry no stage claims raises so
+    typos stay loud. A Param carried by several stages (shared mixins
+    like inputCol — Param identity here is (owner class, name), not
+    pyspark's per-instance uid) is applied to every stage carrying
+    it."""
+    subs = []
+    claimed = set()
+    for s in stages:
+        sub = {p: v for p, v in foreign.items() if _carries_param(s, p)}
+        claimed.update(sub)
+        subs.append(sub)
+    unclaimed = [p for p in foreign if p not in claimed]
+    if unclaimed:
+        raise AttributeError(
+            f"param map entries {unclaimed} belong to neither the "
+            f"{type(owner).__name__} nor any of its stages")
+    return subs
+
+
 def _stages_as_children(stages):
     """Stage list → persistence child map (shared by Pipeline and
     PipelineModel; sorted keys are the reload order)."""
@@ -85,7 +144,15 @@ class PipelineModel(Model):
         return dataset
 
     def copy(self, extra: Optional[dict] = None) -> "PipelineModel":
-        that = PipelineModel([s.copy(extra) for s in self.stages])
+        own, foreign = _split_extra(self, extra)
+        subs = _stage_subs(self, self.stages, foreign)
+        that = PipelineModel([s.copy(sub)
+                              for s, sub in zip(self.stages, subs)])
+        that._paramMap = dict(self._paramMap)
+        that._defaultParamMap = dict(self._defaultParamMap)
+        for p, v in own.items():
+            rp = that._resolveParam(p)
+            that._paramMap[rp] = rp.typeConverter(v)
         return that
 
     def _child_stages(self):
@@ -112,6 +179,21 @@ class Pipeline(Estimator):
 
     def getStages(self) -> List[Params]:
         return self.getOrDefault("stages")
+
+    def copy(self, extra: Optional[dict] = None) -> "Pipeline":
+        """Param-map entries owned by a STAGE are applied to that
+        stage's copy, recursively through nested pipelines (pyspark
+        semantics — what CrossValidator grids over child-stage params
+        rely on); entries owned by the Pipeline itself (``stages``)
+        apply to it FIRST, so stage sub-maps distribute over the
+        overridden stage list; anything unclaimed raises."""
+        own, foreign = _split_extra(self, extra)
+        that = super().copy(own)
+        stages = that.getStages()
+        subs = _stage_subs(self, stages, foreign)
+        that._set(stages=[s.copy(sub)
+                          for s, sub in zip(stages, subs)])
+        return that
 
     def _unsaved_param_names(self):
         return {"stages"}  # persisted as child stages, not a pickle

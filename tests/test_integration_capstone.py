@@ -145,3 +145,34 @@ def test_packed_ship_fidelity(tmp_path, labeled_images):
     acc_packed = head_acc(packed)
     assert acc_full >= 0.9 and acc_packed >= 0.9
     assert abs(acc_full - acc_packed) <= 0.05, (acc_full, acc_packed)
+
+
+def test_cv_grid_over_pipeline_stage_params(labeled_images):
+    """CrossValidator over a Pipeline with the grid keyed by the CHILD
+    LR stage's params — the standard Spark ML tuning pattern (grid
+    entries must reach the stage copy through Pipeline.copy, fixed
+    round 5), composed with the streaming LR head and an evaluator."""
+    from sparkdl_tpu.estimators.evaluators import ClassificationEvaluator
+    from sparkdl_tpu.params.tuning import CrossValidator, ParamGridBuilder
+
+    data_dir, rows = labeled_images
+    images = sparkdl_tpu.readImages(data_dir, numPartitions=3)
+    labels_df = DataFrame.from_pylist(rows, num_partitions=1)
+    labeled = images.join(labels_df, on="filePath")
+
+    feat = sparkdl_tpu.DeepImageFeaturizer(
+        modelName="TestNet", inputCol="image", outputCol="features")
+    lr = sparkdl_tpu.LogisticRegression(
+        maxIter=30, streaming=True, batchSize=16, numClasses=0)
+    pipe = sparkdl_tpu.Pipeline(stages=[feat, lr])
+    grid = (ParamGridBuilder()
+            .addGrid(lr.learningRate, [0.05, 0.2]).build())
+    ev = ClassificationEvaluator(predictionCol="prediction",
+                                 labelCol="label")
+    cv = CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                        evaluator=ev, numFolds=2)
+    model = cv.fit(labeled)
+    assert len(model.avgMetrics) == 2
+    out = model.transform(labeled).collect_rows()
+    acc = np.mean([r["prediction"] == r["label"] for r in out])
+    assert len(out) == 60 and acc >= 0.9, acc

@@ -160,6 +160,91 @@ class TestPipeline:
         models = dict(e.fitMultiple(_df(10), maps))
         assert models[1].mean == models[0].mean + 10.0
 
+    def test_copy_distributes_stage_params(self):
+        """pyspark semantics: a param-map entry keyed by a CHILD
+        stage's Param reaches that stage's copy — what
+        CrossValidator(Pipeline([...]), grid_on_stage_params) relies
+        on (fixed round 5: Pipeline.copy used to resolve the entry
+        against the Pipeline itself and raise)."""
+        add = AddConst(inputCol="x", outputCol="x2", value=1.0)
+        est = MeanEstimator(inputCol="x2", outputCol="m")
+        p = Pipeline(stages=[add, est])
+        p2 = p.copy({est.shift: 7.0, add.value: 2.0})
+        s_add, s_est = p2.getStages()
+        assert s_add.getOrDefault("value") == 2.0
+        assert s_est.getOrDefault("shift") == 7.0
+        # originals untouched (copy-on-write)
+        assert add.getOrDefault("value") == 1.0
+        assert est.getOrDefault("shift") == 0.0
+
+    def test_fit_with_stage_param_map(self):
+        add = AddConst(inputCol="x", outputCol="x2", value=1.0)
+        est = MeanEstimator(inputCol="x2", outputCol="m")
+        p = Pipeline(stages=[add, est])
+        base = p.fit(_df(10)).transform(_df(10)).collect()
+        shifted = p.fit(_df(10), {est.shift: 10.0}) \
+            .transform(_df(10)).collect()
+        np.testing.assert_allclose(
+            shifted.column("m").to_numpy(),
+            base.column("m").to_numpy() + 10.0)
+
+    def test_pipeline_grid_on_stage_params(self):
+        """CrossValidator-shaped: fitMultiple over grids keyed by a
+        stage's params."""
+        add = AddConst(inputCol="x", outputCol="x2", value=1.0)
+        est = MeanEstimator(inputCol="x2", outputCol="m")
+        p = Pipeline(stages=[add, est])
+        grid = ParamGridBuilder().addGrid(est.shift, [0.0, 5.0]).build()
+        models = dict(p.fitMultiple(_df(10), grid))
+        m0 = models[0].transform(_df(4)).collect().column("m").to_numpy()
+        m1 = models[1].transform(_df(4)).collect().column("m").to_numpy()
+        np.testing.assert_allclose(m1, m0 + 5.0)
+
+    def test_foreign_param_still_raises(self):
+        stray = MeanEstimator(inputCol="q", outputCol="r")
+        p = Pipeline(stages=[AddConst(inputCol="x", outputCol="y")])
+        with pytest.raises(AttributeError, match="neither"):
+            p.copy({stray.shift: 1.0})
+
+    def test_copy_honors_stages_override(self):
+        """Overriding the Pipeline's OWN ``stages`` param must replace
+        the stage list — and stage-param entries then distribute over
+        the REPLACED stages (fixed round 5: the override was applied
+        and immediately overwritten by copies of the old list)."""
+        a = AddConst(inputCol="x", outputCol="ya", value=1.0)
+        b = AddConst(inputCol="x", outputCol="yb", value=2.0)
+        p = Pipeline(stages=[a])
+        p2 = p.copy({p.getParam("stages"): [b],
+                     b.value: 9.0})
+        (s,) = p2.getStages()
+        assert s.getOrDefault("outputCol") == "yb"
+        assert s.getOrDefault("value") == 9.0
+        assert b.getOrDefault("value") == 2.0  # original untouched
+
+    def test_nested_pipeline_param_distribution(self):
+        """pyspark forwards extra recursively through nested pipeline
+        stages; a grid entry on an inner stage must reach it."""
+        add = AddConst(inputCol="x", outputCol="x2", value=1.0)
+        est = MeanEstimator(inputCol="x2", outputCol="m")
+        outer = Pipeline(stages=[Pipeline(stages=[add, est])])
+        o2 = outer.copy({est.shift: 4.0})
+        (inner,) = o2.getStages()
+        _, s_est = inner.getStages()
+        assert s_est.getOrDefault("shift") == 4.0
+        base = outer.fit(_df(10)).transform(_df(4)).collect()
+        shifted = o2.fit(_df(10)).transform(_df(4)).collect()
+        np.testing.assert_allclose(
+            shifted.column("m").to_numpy(),
+            base.column("m").to_numpy() + 4.0)
+
+    def test_model_transform_with_stage_param(self):
+        add = AddConst(inputCol="x", outputCol="x2", value=1.0)
+        est = MeanEstimator(inputCol="x2", outputCol="m")
+        model = Pipeline(stages=[add, est]).fit(_df(10))
+        out = model.transform(_df(4), {add.value: 3.0}).collect()
+        np.testing.assert_allclose(out.column("x2").to_numpy(),
+                                   out.column("x").to_numpy() + 3.0)
+
 
 class MAE(Evaluator):
     """Mean |m - x| — lower is better."""
