@@ -98,20 +98,33 @@ def _stage_subs(owner: Params, stages, foreign):
     """Per-stage sub-maps of ``foreign`` (entries owned by that stage,
     directly or through nesting); an entry no stage claims raises so
     typos stay loud. A Param carried by several stages (shared mixins
-    like inputCol — Param identity here is (owner class, name), not
-    pyspark's per-instance uid) is applied to every stage carrying
-    it."""
+    like batchSize/inputCol — Param identity here is (owner class,
+    name), not pyspark's per-instance uid) is applied to every stage
+    carrying it, WITH a warning: pyspark would scope the entry to one
+    stage, so a multi-stage hit is a real semantic divergence the user
+    must be able to see (e.g. a CV grid on lr.batchSize silently also
+    re-batching the featurizer)."""
+    import logging
     subs = []
-    claimed = set()
+    claims: dict = {}
     for s in stages:
         sub = {p: v for p, v in foreign.items() if _carries_param(s, p)}
-        claimed.update(sub)
+        for p in sub:
+            claims.setdefault(p, []).append(type(s).__name__)
         subs.append(sub)
-    unclaimed = [p for p in foreign if p not in claimed]
+    unclaimed = [p for p in foreign if p not in claims]
     if unclaimed:
         raise AttributeError(
             f"param map entries {unclaimed} belong to neither the "
             f"{type(owner).__name__} nor any of its stages")
+    for p, owners in claims.items():
+        if len(owners) > 1:
+            logging.getLogger(__name__).warning(
+                "param map entry %s is carried by %d stages (%s) and "
+                "applies to ALL of them — Param identity here is "
+                "(owner class, name), not a per-instance uid; set the "
+                "param on the intended stage directly to scope it",
+                p, len(owners), ", ".join(owners))
     return subs
 
 
@@ -146,13 +159,9 @@ class PipelineModel(Model):
     def copy(self, extra: Optional[dict] = None) -> "PipelineModel":
         own, foreign = _split_extra(self, extra)
         subs = _stage_subs(self, self.stages, foreign)
-        that = PipelineModel([s.copy(sub)
-                              for s, sub in zip(self.stages, subs)])
-        that._paramMap = dict(self._paramMap)
-        that._defaultParamMap = dict(self._defaultParamMap)
-        for p, v in own.items():
-            rp = that._resolveParam(p)
-            that._paramMap[rp] = rp.typeConverter(v)
+        that = super().copy(own)  # preserves uid and subclass type
+        that.stages = [s.copy(sub)
+                       for s, sub in zip(self.stages, subs)]
         return that
 
     def _child_stages(self):

@@ -101,6 +101,29 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
                 arr = np.asarray(arr)
                 static = shape and all(d is not None for d in shape)
                 if static and arr.shape[1:] != tuple(shape):
+                    expect = int(np.prod(shape))
+                    got = int(np.prod(arr.shape[1:], dtype=np.int64))
+                    # zero-ROW chunks arrive as flat (0,) arrays whose
+                    # reshape to (0, *shape) is legal — exempt those,
+                    # but not N>0 rows of empty payloads (shape (N, 0)
+                    # from a list column of empty lists), which must
+                    # get the diagnostic too
+                    if got != expect and arr.shape[0] > 0:
+                        # a bare reshape error here reads as numpy
+                        # noise; the actual mistake is a frame whose
+                        # payload doesn't match the model — most often
+                        # a reader size/packedFormat that disagrees
+                        # with deviceResizeModel's
+                        raise ValueError(
+                            f"column {col!r} rows carry {got} "
+                            f"elements (row shape {arr.shape[1:]}) "
+                            f"but model input {input_name!r} expects "
+                            f"shape {tuple(shape)} ({expect} "
+                            "elements). The frame's payload does not "
+                            "match this ModelFunction — check the "
+                            "reader's size/packedFormat against the "
+                            "model's (deviceResizeModel and "
+                            "readImagesPacked must agree on both)")
                     arr = arr.reshape((arr.shape[0],) + tuple(shape))
                 inputs[input_name] = arr.astype(dtype, copy=False)
             for input_name, value in hparams.items():

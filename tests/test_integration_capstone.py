@@ -176,3 +176,40 @@ def test_cv_grid_over_pipeline_stage_params(labeled_images):
     out = model.transform(labeled).collect_rows()
     acc = np.mean([r["prediction"] == r["label"] for r in out])
     assert len(out) == 60 and acc >= 0.9, acc
+
+
+def test_frame_ops_compose_with_mesh_device_stage(tmp_path,
+                                                  labeled_images):
+    """Round-5 composition probe, kept as a regression test: an
+    out-of-core upward repartition, a union with a differently
+    partitioned frame, and a limit all feed the SAME mesh device stage
+    (yuv420 packed payload, batch-misaligned partitions re-chunked by
+    the engine) with row identity and duplicate-half feature equality
+    preserved."""
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.transformers.tensor_transform import TensorTransformer
+    from sparkdl_tpu.transformers.utils import deviceResizeModel, single_io
+
+    data_dir, rows = labeled_images
+    packed = imageIO.readImagesPacked(data_dir, (16, 16),
+                                      numPartitions=2,
+                                      packedFormat="yuv420")
+    rep = packed.repartition(9, cacheDir=str(tmp_path / "spill"))
+    uni = rep.union(packed)  # 120 rows, two different layouts
+    mfp = deviceResizeModel(getModelFunction("TestNet", featurize=True),
+                            (16, 16), packedFormat="yuv420")
+    i_n, o_n = single_io(mfp)
+    t = TensorTransformer(modelFunction=mfp, inputMapping={"image": i_n},
+                          outputMapping={o_n: "f"}, batchSize=16,
+                          useMesh=True)
+    out = t.transform(uni).collect_rows()
+    assert len(out) == 120
+    fps = [r["filePath"] for r in out]
+    assert fps[:60] == sorted(fps[:60])      # rep half, in order
+    assert fps[60:] == sorted(fps[60:])      # original half, in order
+    f = np.stack([np.asarray(r["f"]) for r in out])
+    np.testing.assert_allclose(f[:60], f[60:], rtol=1e-5, atol=1e-6)
+
+    lim = t.transform(uni.limit(70)).collect_rows()
+    assert len(lim) == 70

@@ -439,3 +439,31 @@ class TestTrainValidationSplit:
                                  evaluator=MAE(), trainRatio=0.75,
                                  seed=7).fit(_df(200))
         assert a.validationMetrics == b.validationMetrics  # same split
+
+
+class TestSharedParamDistribution:
+    def test_multi_stage_claim_warns(self, caplog):
+        """A param-map entry carried by several stages applies to all
+        of them (documented divergence from pyspark's uid-scoped
+        params) — and WARNS so the ambiguity is visible."""
+        import logging
+        a1 = AddConst(inputCol="x", outputCol="y1", value=1.0)
+        a2 = AddConst(inputCol="x", outputCol="y2", value=2.0)
+        p = Pipeline(stages=[a1, a2])
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.params.pipeline"):
+            p2 = p.copy({a1.value: 9.0})
+        s1, s2 = p2.getStages()
+        assert s1.getOrDefault("value") == 9.0
+        assert s2.getOrDefault("value") == 9.0
+        assert any("carried by 2 stages" in r.message
+                   for r in caplog.records)
+
+    def test_single_stage_claim_is_silent(self, caplog):
+        import logging
+        add = AddConst(inputCol="x", outputCol="y", value=1.0)
+        est = MeanEstimator(inputCol="y", outputCol="m")
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.params.pipeline"):
+            Pipeline(stages=[add, est]).copy({est.shift: 1.0})
+        assert not caplog.records

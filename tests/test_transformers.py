@@ -379,3 +379,50 @@ class TestTensorTransformerMultiIO:
         np.testing.assert_allclose(d[:, 0], np.arange(7) - 1.0)
         # inputs stay in the frame alongside outputs
         assert set(out.columns) == {"left", "right", "s", "d"}
+
+
+class TestPayloadMismatchDiagnostics:
+    """A frame whose packed payload disagrees with the model (wrong
+    size or packedFormat) must fail with a message naming the column
+    and both shapes — not a bare numpy reshape error (round-5 probe:
+    'cannot reshape array of size 6144 into shape (8,384)')."""
+
+    def _packed_frame(self, tmp_path, fmt):
+        from PIL import Image
+
+        from sparkdl_tpu.image import imageIO
+        rng = np.random.default_rng(5)
+        for i in range(4):
+            arr = rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+            Image.fromarray(arr, "RGB").save(tmp_path / f"x{i}.jpg",
+                                             quality=92)
+        return imageIO.readImagesPacked(str(tmp_path), (16, 16),
+                                        numPartitions=2,
+                                        packedFormat=fmt)
+
+    @pytest.mark.parametrize("frame_fmt,model_kw", [
+        ("rgb", {"packedFormat": "yuv420"}),   # rgb rows, 420 model
+        ("yuv420", {}),                        # 420 rows, rgb model
+    ])
+    def test_format_mismatch_names_column_and_shapes(self, tmp_path,
+                                                     frame_fmt,
+                                                     model_kw):
+        from sparkdl_tpu.models.zoo import getModelFunction
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+        from sparkdl_tpu.transformers.utils import (
+            deviceResizeModel,
+            single_io,
+        )
+        mfp = deviceResizeModel(
+            getModelFunction("TestNet", featurize=True), (16, 16),
+            **model_kw)
+        i_n, o_n = single_io(mfp)
+        t = TensorTransformer(modelFunction=mfp,
+                              inputMapping={"image": i_n},
+                              outputMapping={o_n: "f"}, batchSize=4)
+        df = self._packed_frame(tmp_path, frame_fmt)
+        with pytest.raises(ValueError,
+                           match="'image'.*does not match"):
+            t.transform(df).collect()
