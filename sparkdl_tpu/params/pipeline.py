@@ -9,10 +9,18 @@ with the same composition semantics (``Pipeline(stages=[...]).fit(df)``,
 
 from __future__ import annotations
 
+import logging
 from abc import abstractmethod
 from typing import Iterable, List, Optional, Sequence
 
 from sparkdl_tpu.params.base import Param, Params, TypeConverters, keyword_only
+
+logger = logging.getLogger(__name__)
+
+# Multi-stage param claims already warned about this process run:
+# CrossValidator calls copy() per candidate per fold, and repeating the
+# identical line nFolds x nCandidates times would bury it.
+_warned_shared_claims: set = set()
 
 
 class Transformer(Params):
@@ -104,7 +112,6 @@ def _stage_subs(owner: Params, stages, foreign):
     stage, so a multi-stage hit is a real semantic divergence the user
     must be able to see (e.g. a CV grid on lr.batchSize silently also
     re-batching the featurizer)."""
-    import logging
     subs = []
     claims: dict = {}
     for s in stages:
@@ -118,8 +125,10 @@ def _stage_subs(owner: Params, stages, foreign):
             f"param map entries {unclaimed} belong to neither the "
             f"{type(owner).__name__} nor any of its stages")
     for p, owners in claims.items():
-        if len(owners) > 1:
-            logging.getLogger(__name__).warning(
+        key = (p, tuple(owners))
+        if len(owners) > 1 and key not in _warned_shared_claims:
+            _warned_shared_claims.add(key)
+            logger.warning(
                 "param map entry %s is carried by %d stages (%s) and "
                 "applies to ALL of them — Param identity here is "
                 "(owner class, name), not a per-instance uid; set the "

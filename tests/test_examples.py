@@ -26,3 +26,56 @@ def test_hpo_example_runs(capsys):
     runpy.run_path("examples/hyperparameter_search.py",
                    run_name="__main__")
     assert "accuracies" in capsys.readouterr().out
+
+
+def test_migration_guide_api_claims():
+    """Every API shape docs/MIGRATION.md shows must exist as written —
+    a stale migration guide misleads exactly the user it exists for."""
+    import inspect
+
+    import sparkdl_tpu
+    from sparkdl_tpu.estimators.keras_image_file_estimator import (
+        KerasImageFileEstimator,
+    )
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.graph.ingest import ModelIngest, TFInputGraph
+    from sparkdl_tpu.image.imageIO import readImagesPacked
+    from sparkdl_tpu.params.tuning import CrossValidator
+    from sparkdl_tpu.transformers.image_transform import ImageTransformer
+    from sparkdl_tpu.transformers.tensor_transform import TensorTransformer
+
+    assert TFInputGraph is ModelIngest
+    for src in ("fromGraph", "fromGraphDef", "fromSavedModel",
+                "fromSavedModelWithSignature", "fromCheckpoint",
+                "fromCheckpointWithSignature", "fromFunction",
+                "fromExport"):
+        assert hasattr(ModelIngest, src), src
+    assert hasattr(ModelFunction, "fromList")
+    assert sparkdl_tpu.TFImageTransformer is ImageTransformer
+    assert sparkdl_tpu.TFTransformer is TensorTransformer
+
+    def has_params(fn, *names):
+        sig = inspect.signature(fn)
+        for n in names:
+            assert n in sig.parameters, (fn, n)
+
+    has_params(ImageTransformer.__init__, "modelFunction", "outputMode",
+               "deviceResizeFrom", "useMesh")
+    has_params(TensorTransformer.__init__, "modelFunction",
+               "inputMapping", "outputMapping", "tfHParams")
+    has_params(sparkdl_tpu.LogisticRegression.__init__, "batchSize",
+               "streaming", "memoryBudgetBytes")
+    has_params(KerasImageFileEstimator.__init__, "parallelism",
+               "useMesh", "checkpointDir", "streaming")
+    has_params(CrossValidator.__init__, "cacheDir")
+    has_params(sparkdl_tpu.registerKerasImageUDF, "preprocessor",
+               "session")
+    has_params(readImagesPacked, "packedFormat", "scaledDecode",
+               "dropImageFailures")
+    # the eight reference names + readImages all resolve
+    for name in ("imageSchema", "readImages", "DeepImageFeaturizer",
+                 "DeepImagePredictor", "TFImageTransformer",
+                 "TFTransformer", "KerasImageFileTransformer",
+                 "KerasTransformer", "KerasImageFileEstimator",
+                 "registerKerasImageUDF"):
+        assert getattr(sparkdl_tpu, name) is not None

@@ -447,17 +447,22 @@ class TestSharedParamDistribution:
         of them (documented divergence from pyspark's uid-scoped
         params) — and WARNS so the ambiguity is visible."""
         import logging
+
+        from sparkdl_tpu.params import pipeline as pipeline_mod
+        pipeline_mod._warned_shared_claims.clear()  # once-per-process guard
         a1 = AddConst(inputCol="x", outputCol="y1", value=1.0)
         a2 = AddConst(inputCol="x", outputCol="y2", value=2.0)
         p = Pipeline(stages=[a1, a2])
         with caplog.at_level(logging.WARNING,
                              logger="sparkdl_tpu.params.pipeline"):
             p2 = p.copy({a1.value: 9.0})
+            p.copy({a1.value: 9.0})  # repeat: deduped
         s1, s2 = p2.getStages()
         assert s1.getOrDefault("value") == 9.0
         assert s2.getOrDefault("value") == 9.0
-        assert any("carried by 2 stages" in r.message
-                   for r in caplog.records)
+        hits = [r for r in caplog.records
+                if "carried by 2 stages" in r.message]
+        assert len(hits) == 1  # warned once, not per copy
 
     def test_single_stage_claim_is_silent(self, caplog):
         import logging
