@@ -9,6 +9,8 @@ against its label column.
 
 from __future__ import annotations
 
+from typing import Tuple
+
 import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
@@ -38,6 +40,50 @@ def _stream_pred_and_labels(dataset, predictionCol: str, labelCol: str):
 
 
 _CLS_METRICS = ("accuracy", "f1", "weightedPrecision", "weightedRecall")
+_PRED_SEMANTICS = ("auto", "labels", "probabilities")
+# 'labels' is invalid for LossEvaluator: cross-entropy on class labels
+# is meaningless
+_LOSS_SEMANTICS = ("auto", "probabilities")
+
+
+def _gather_deferred(preds_parts, labels_parts):
+    """THE whole-column gather: 'auto' scalar semantics defer to here.
+    One named seam so tests can prove the declared-semantics path never
+    reaches it (two SCALAR arrays — vectors never defer)."""
+    return np.concatenate(preds_parts), np.concatenate(labels_parts)
+
+
+def _binary_scalar_loss(preds: np.ndarray,
+                        labels: np.ndarray) -> Tuple[float, int]:
+    """(sum of -log p_picked, count) for scalar binary P(class 1) —
+    shared by the streaming (declared-semantics) and gathered (auto)
+    paths so their clip/threshold semantics can never diverge."""
+    p = np.clip(preds, 1e-7, 1.0 - 1e-7)
+    y = labels.astype(np.float64)
+    picked = np.where(y > 0.5, p, 1.0 - p)
+    return float(-np.log(picked).sum()), len(picked)
+
+
+def _scalar_pred_ids(preds: np.ndarray, semantics: str,
+                     col: str) -> np.ndarray:
+    """Scalar predictions → class ids under a DECLARED semantic:
+    ``labels`` casts (values are class ids), ``probabilities``
+    thresholds at 0.5 (binary P(class 1)). Values that contradict the
+    declaration raise — scoring a mis-wired column under a declared
+    semantic would silently return a plausible metric."""
+    if semantics == "labels":
+        if preds.size and not np.all(preds == np.round(preds)):
+            raise ValueError(
+                f"column {col!r} holds non-integral values but "
+                "predictionSemantics='labels'; use 'probabilities' "
+                "for binary score columns")
+        return preds.astype(np.int64)
+    if preds.size and (preds.min() < 0.0 or preds.max() > 1.0):
+        raise ValueError(
+            f"column {col!r} holds values outside [0, 1] but "
+            "predictionSemantics='probabilities'; use 'labels' for "
+            "class-id columns")
+    return (preds > 0.5).astype(np.int64)
 
 
 class ClassificationEvaluator(Evaluator):
@@ -51,9 +97,15 @@ class ClassificationEvaluator(Evaluator):
     matrix, so scoring a frame holds one batch (not the table of
     prediction vectors) in memory — all four metrics are confusion
     functions, so this is exact, not approximate. The one case that
-    still gathers a column is scalar predictions, whose "class labels
-    or probabilities?" disambiguation is a whole-column property; that
-    gathers two scalar arrays, never vectors."""
+    gathers a column is scalar predictions under the default
+    ``predictionSemantics="auto"``, whose "class labels or
+    probabilities?" disambiguation is a whole-column property (a batch
+    of saturated 0.0/1.0 probabilities is indistinguishable from binary
+    labels); that gathers two scalar arrays, never vectors. Declaring
+    the semantic — ``predictionSemantics="labels"`` (class ids, e.g.
+    LogisticRegressionModel's predictionCol) or ``"probabilities"``
+    (binary P(class 1), thresholded at 0.5) — removes the gather and
+    keeps scalar scoring fully streaming."""
 
     predictionCol = Param("ClassificationEvaluator", "predictionCol",
                           "prediction vector column",
@@ -62,19 +114,28 @@ class ClassificationEvaluator(Evaluator):
                      TypeConverters.toString)
     metricName = Param("ClassificationEvaluator", "metricName",
                        f"one of {_CLS_METRICS}", TypeConverters.toString)
+    predictionSemantics = Param(
+        "ClassificationEvaluator", "predictionSemantics",
+        f"scalar-prediction semantic, one of {_PRED_SEMANTICS}",
+        TypeConverters.toString)
 
     @keyword_only
     def __init__(self, *, predictionCol="prediction", labelCol="label",
-                 metricName="accuracy"):
+                 metricName="accuracy", predictionSemantics="auto"):
         super().__init__()
         self._setDefault(predictionCol="prediction", labelCol="label",
-                         metricName="accuracy")
+                         metricName="accuracy", predictionSemantics="auto")
         self._set(predictionCol=predictionCol, labelCol=labelCol,
-                  metricName=metricName)
+                  metricName=metricName,
+                  predictionSemantics=predictionSemantics)
         if self.getOrDefault("metricName") not in _CLS_METRICS:
             raise ValueError(
                 f"metricName must be one of {_CLS_METRICS}, got "
                 f"{metricName!r}")
+        if self.getOrDefault("predictionSemantics") not in _PRED_SEMANTICS:
+            raise ValueError(
+                f"predictionSemantics must be one of {_PRED_SEMANTICS}, "
+                f"got {predictionSemantics!r}")
 
     def evaluate(self, dataset) -> float:
         metric = self.getOrDefault("metricName")
@@ -85,29 +146,41 @@ class ClassificationEvaluator(Evaluator):
             raise ValueError(
                 f"metricName must be one of {_CLS_METRICS}, got "
                 f"{metric!r}")
+        semantics = self.getOrDefault("predictionSemantics")
+        if semantics not in _PRED_SEMANTICS:
+            raise ValueError(
+                f"predictionSemantics must be one of {_PRED_SEMANTICS}, "
+                f"got {semantics!r}")
+        pred_col = self.getOrDefault("predictionCol")
         conf: dict = {}  # (pred_id, label_id) -> count; SPARSE so
         # large un-reindexed ids never allocate a dense id²-sized matrix
         scalar_preds, scalar_labels = [], []
         for preds, labels in _stream_pred_and_labels(
-                dataset, self.getOrDefault("predictionCol"),
-                self.getOrDefault("labelCol")):
+                dataset, pred_col, self.getOrDefault("labelCol")):
             if labels.ndim > 1:  # one-hot labels
                 labels = labels.argmax(-1)
             labels = labels.astype(np.int64)
             if preds.ndim > 1 and preds.shape[-1] == 1:
                 preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
             if preds.ndim == 1:
-                # "class labels vs probabilities" is a whole-column
-                # decision (a batch of saturated 0.0/1.0 probabilities
-                # is indistinguishable from binary labels) — defer;
-                # scalars only, never vectors
-                scalar_preds.append(preds)
-                scalar_labels.append(labels)
+                if semantics != "auto":
+                    # declared semantic: reduce this batch into the
+                    # confusion counts now — nothing is gathered
+                    _accumulate_confusion(
+                        conf,
+                        _scalar_pred_ids(preds, semantics, pred_col),
+                        labels)
+                else:
+                    # "class labels vs probabilities" is a whole-column
+                    # decision (a batch of saturated 0.0/1.0
+                    # probabilities is indistinguishable from binary
+                    # labels) — defer; scalars only, never vectors
+                    scalar_preds.append(preds)
+                    scalar_labels.append(labels)
             else:
                 _accumulate_confusion(conf, preds.argmax(-1), labels)
         if scalar_preds:
-            preds = np.concatenate(scalar_preds)
-            labels = np.concatenate(scalar_labels)
+            preds, labels = _gather_deferred(scalar_preds, scalar_labels)
             if np.all(preds == np.round(preds)):
                 # integral values: already class labels (e.g.
                 # LogisticRegressionModel's predictionCol)
@@ -339,19 +412,37 @@ class LossEvaluator(Evaluator):
     (Spark convention): cross-entropy on labels is meaningless, and for
     a binary model it is undetectable from values alone (all 0.0/1.0
     looks like a saturated sigmoid), so the default must point at
-    probabilities."""
+    probabilities.
+
+    ``predictionSemantics="probabilities"`` declares a SCALAR column to
+    be binary P(class 1): the column-level "is this actually labels?"
+    guards (which gather two scalar arrays) are replaced by per-batch
+    range checks and scalar scoring streams like the vector path. The
+    ``"auto"`` default keeps the protective whole-column analysis."""
 
     predictionCol = Param("LossEvaluator", "predictionCol",
                           "probability vector column",
                           TypeConverters.toString)
     labelCol = Param("LossEvaluator", "labelCol", "label column",
                      TypeConverters.toString)
+    predictionSemantics = Param(
+        "LossEvaluator", "predictionSemantics",
+        "scalar-prediction semantic: 'auto' or 'probabilities' "
+        "('labels' is invalid here — cross-entropy on class labels is "
+        "meaningless)", TypeConverters.toString)
 
     @keyword_only
-    def __init__(self, *, predictionCol="probability", labelCol="label"):
+    def __init__(self, *, predictionCol="probability", labelCol="label",
+                 predictionSemantics="auto"):
         super().__init__()
-        self._setDefault(predictionCol="probability", labelCol="label")
-        self._set(predictionCol=predictionCol, labelCol=labelCol)
+        self._setDefault(predictionCol="probability", labelCol="label",
+                         predictionSemantics="auto")
+        self._set(predictionCol=predictionCol, labelCol=labelCol,
+                  predictionSemantics=predictionSemantics)
+        if self.getOrDefault("predictionSemantics") not in _LOSS_SEMANTICS:
+            raise ValueError(
+                f"predictionSemantics must be one of {_LOSS_SEMANTICS} "
+                f"for LossEvaluator, got {predictionSemantics!r}")
 
     def isLargerBetter(self) -> bool:
         return False
@@ -360,7 +451,14 @@ class LossEvaluator(Evaluator):
         # Streams: probability VECTORS (the memory hog — C can be 1000)
         # reduce per batch into (sum of -log picked, count); scalar
         # probabilities gather as two scalar arrays because their
-        # labels-vs-probabilities guards are whole-column properties.
+        # labels-vs-probabilities guards are whole-column properties —
+        # unless predictionSemantics declares them, which swaps the
+        # column analysis for per-batch range checks and streams.
+        semantics = self.getOrDefault("predictionSemantics")
+        if semantics not in _LOSS_SEMANTICS:
+            raise ValueError(
+                f"predictionSemantics must be one of {_LOSS_SEMANTICS} "
+                f"for LossEvaluator, got {semantics!r}")
         pred_col = self.getOrDefault("predictionCol")
         total, n = 0.0, 0
         scal_p, scal_l = [], []
@@ -370,6 +468,22 @@ class LossEvaluator(Evaluator):
                 # squeeze BEFORE the class-label guard, or an (N,1)
                 # tensor column of integer labels would bypass it
                 preds = preds[..., 0]  # (N,1) sigmoid outputs → binary
+            if preds.ndim == 1 and semantics == "probabilities":
+                if preds.size and (preds.min() < 0.0
+                                   or preds.max() > 1.0):
+                    # values outside [0,1] are definitively not
+                    # probabilities, declared semantic or not
+                    raise ValueError(
+                        f"column {pred_col!r} holds values outside "
+                        "[0, 1] but predictionSemantics="
+                        "'probabilities'; point predictionCol at the "
+                        "probability column")
+                batch_total, batch_n = _binary_scalar_loss(
+                    preds, labels.argmax(-1) if labels.ndim > 1
+                    else labels)
+                total += batch_total
+                n += batch_n
+                continue
             if preds.ndim == 1:
                 scal_p.append(preds)
                 scal_l.append(labels.argmax(-1) if labels.ndim > 1
@@ -403,8 +517,7 @@ class LossEvaluator(Evaluator):
             total += float(-np.log(picked).sum())
             n += len(picked)
         if scal_p:
-            preds = np.concatenate(scal_p)
-            labels = np.concatenate(scal_l)
+            preds, labels = _gather_deferred(scal_p, scal_l)
             if len(preds) and preds.min(initial=1.0) < 0.0:
                 # negative values are as definitively not-probabilities
                 # as values above 1 (e.g. a {-1, 1} label convention
@@ -437,11 +550,9 @@ class LossEvaluator(Evaluator):
                     "than saturated probabilities, this loss is "
                     "meaningless; point predictionCol at the "
                     "probability column", pred_col)
-            p = np.clip(preds, 1e-7, 1.0 - 1e-7)
-            y = labels.astype(np.float64)
-            picked = np.where(y > 0.5, p, 1.0 - p)
-            total += float(-np.log(picked).sum())
-            n += len(picked)
+            batch_total, batch_n = _binary_scalar_loss(preds, labels)
+            total += batch_total
+            n += batch_n
         if n == 0:
             # same convention as the other evaluators (advisor r4 #4)
             raise ValueError(

