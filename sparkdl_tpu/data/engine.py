@@ -359,15 +359,32 @@ class LocalEngine:
         independent units, so sharing the pool with the upstream prefix
         cannot deadlock)."""
         pending: collections.deque = collections.deque()
-        for idx, batch in stream:
-            pending.append((idx, self._pool.submit(
-                self._apply_stream_stage, stage, batch, idx)))
-            while len(pending) > self.max_inflight:
+        try:
+            for idx, batch in stream:
+                pending.append((idx, self._pool.submit(
+                    self._apply_stream_stage, stage, batch, idx)))
+                while len(pending) > self.max_inflight:
+                    i, fut = pending.popleft()
+                    yield i, fut.result()
+            while pending:
                 i, fut = pending.popleft()
                 yield i, fut.result()
-        while pending:
-            i, fut = pending.popleft()
-            yield i, fut.result()
+        finally:
+            # same QUIESCE discipline as _execute_indexed: on a stage
+            # error (or the consumer abandoning the generator, e.g.
+            # take(n)), in-flight siblings keep producing side effects —
+            # a _write_part task re-creating write_parquet's just-swept
+            # staging dir AFTER the caller's cleanup ran leaves the next
+            # write permanently refused. Cancel what hasn't started,
+            # then drain what has, BEFORE control returns.
+            for _, fut in pending:
+                fut.cancel()
+            for _, fut in pending:
+                if not fut.cancelled():
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass  # the primary error already propagated
 
     def _stream_rechunk(self, stream, stage, inflight_box=None,
                         max_hint=None):

@@ -176,10 +176,22 @@ class Source:
     ``with_index`` stages — so reordering/subsetting partitions
     (``with_partition_order``, host sharding, per-epoch shuffles) never
     changes what a deterministic stage like ``sample`` draws for a
-    given partition. None = use the positional index."""
+    given partition. None = use the positional index.
+    ``schema_hint``, when set, must EQUAL ``load()``'s schema — it lets
+    ``DataFrame.schema`` probe the plan on an empty prototype without
+    materializing the first partition (decoding a whole image partition
+    to answer ``.columns`` is the trap; only leaf constructors whose
+    schema is statically known set it)."""
     load: Callable[[], pa.RecordBatch]
     num_rows: Optional[int] = None
     logical_index: Optional[int] = None
+    schema_hint: Optional[pa.Schema] = None
+
+
+def _empty_batch(schema: pa.Schema) -> pa.RecordBatch:
+    """Zero-row batch carrying ``schema`` (field metadata included)."""
+    return pa.RecordBatch.from_arrays(
+        [pa.array([], f.type) for f in schema], schema=schema)
 
 
 class DataFrame:
@@ -216,7 +228,8 @@ class DataFrame:
                 return pa.Table.from_batches(batches).combine_chunks() \
                     .to_batches()[0]
 
-            sources.append(Source(_load, hi_i - lo_i))
+            sources.append(Source(_load, hi_i - lo_i,
+                                  schema_hint=table.schema))
         return DataFrame(sources, engine=engine)
 
     @staticmethod
@@ -233,7 +246,8 @@ class DataFrame:
     @staticmethod
     def from_batches(batches: Sequence[pa.RecordBatch],
                      engine=None) -> "DataFrame":
-        sources = [Source((lambda b=b: b), b.num_rows) for b in batches]
+        sources = [Source((lambda b=b: b), b.num_rows,
+                          schema_hint=b.schema) for b in batches]
         return DataFrame(sources, engine=engine)
 
     @staticmethod
@@ -577,10 +591,7 @@ class DataFrame:
                                  .to_batches())
                 frags = [b for b in frags if b.num_rows]
                 if not frags:
-                    schema = pq.read_schema(files[0])
-                    return pa.RecordBatch.from_arrays(
-                        [pa.array([], f.type) for f in schema],
-                        schema=schema)
+                    return _empty_batch(pq.read_schema(files[0]))
                 # _concat_batches raises loudly on >2GiB columns that
                 # refuse to combine — returning a subset would silently
                 # drop rows on exactly the larger-than-RAM path this
@@ -591,7 +602,11 @@ class DataFrame:
 
         sources = [Source(_make_load(int(lo), int(hi)), int(hi - lo))
                    for lo, hi in zip(bounds[:-1], bounds[1:])]
-        return DataFrame(sources, engine=engine)
+        out = DataFrame(sources, engine=engine)
+        # footer-only read: the default probe would load a whole row
+        # range (the read_parquet precedent)
+        out._schema = pq.read_schema(files[0])
+        return out
 
     def coalesce(self, num_partitions: int) -> "DataFrame":
         """Merge ADJACENT partitions down to ``num_partitions`` without
@@ -723,8 +738,10 @@ class DataFrame:
                 f"union schema mismatch: {self.schema.names} vs "
                 f"{other.schema.names}")
         if self._plan == other._plan:
-            return DataFrame(self._sources + other._sources, self._plan,
-                             self._engine)
+            out = DataFrame(self._sources + other._sources, self._plan,
+                            self._engine)
+            out._schema = self._schema  # just computed by the check
+            return out
 
         def deferred(df: "DataFrame") -> List[Source]:
             side = _DeferredSide(df._engine, df._plan, df._sources)
@@ -733,8 +750,10 @@ class DataFrame:
                            s.num_rows if preserving else None)
                     for i, s in enumerate(df._sources)]
 
-        return DataFrame(deferred(self) + deferred(other),
-                         engine=self._engine)
+        out = DataFrame(deferred(self) + deferred(other),
+                        engine=self._engine)
+        out._schema = self._schema  # deferred loads END in this plan
+        return out
 
     def join(self, other: "DataFrame", on, how: str = "inner", *,
              broadcast_limit_rows: int = 2_000_000,
@@ -1036,16 +1055,22 @@ class DataFrame:
 
     @property
     def schema(self) -> pa.Schema:
-        """Schema after the plan, computed once on the first partition's
-        batch sliced to zero rows (stages must tolerate empty batches)
-        and cached — ``limit``/``union``/``show`` all consult it, and a
-        decode-bearing plan must not re-load partition 0 per access."""
+        """Schema after the plan, computed once on a zero-row prototype
+        (stages must tolerate empty batches) and cached — ``limit``/
+        ``union``/``show`` all consult it, and a decode-bearing plan
+        must not re-load partition 0 per access. When the first source
+        publishes a ``schema_hint`` (statically-known leaf schemas:
+        in-memory tables, file listings) the prototype is built from it
+        WITHOUT loading the partition; otherwise the source loads once
+        and is sliced to zero rows."""
         if self._schema is None:
             if not self._sources:
                 return pa.schema([])
             src = self._sources[0]
             idx = src.logical_index if src.logical_index is not None else 0
-            proto = src.load().slice(0, 0)
+            proto = (_empty_batch(src.schema_hint)
+                     if src.schema_hint is not None
+                     else src.load().slice(0, 0))
             for stage in self._plan:
                 proto = (stage.fn(proto, idx) if stage.with_index
                          else stage.fn(proto))

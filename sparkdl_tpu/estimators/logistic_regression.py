@@ -233,9 +233,11 @@ class LogisticRegression(Estimator, HasLabelCol):
                                 ) -> Optional[int]:
         """f32 feature-matrix size the collected path would build, or
         None when it can't be known for free (unknown row count — e.g.
-        a filter upstream — or a width-less feature column). Uses the
-        frame's footer/source counts and schema metadata only; never
-        executes the plan."""
+        a filter upstream — or a width-less feature column). Row count
+        comes from footer/source counts; the schema probe runs the plan
+        on a ZERO-row prototype only (and when the leaf source
+        publishes a ``schema_hint`` — in-memory tables, image readers —
+        it never loads partition 0 at all)."""
         rows = getattr(dataset, "known_count", lambda: None)()
         if not rows:
             return None

@@ -417,12 +417,18 @@ def filesToDF(paths: Sequence[str], numPartitions: int = 8,
             })
         return _load
 
-    sources = [Source(_make_load(c), len(c)) for c in chunks if len(c)]
+    files_schema = pa.schema([("filePath", pa.string()),
+                              ("fileData", pa.binary())])
+    # schema_hint: DataFrame.schema probes the decode plan on an empty
+    # prototype instead of READING partition 0's files (e.g.
+    # LogisticRegression's free sizing estimate over a featurize plan)
+    sources = [Source(_make_load(c), len(c), schema_hint=files_schema)
+               for c in chunks if len(c)]
     if not sources:
         empty = pa.RecordBatch.from_pydict({
             "filePath": pa.array([], type=pa.string()),
             "fileData": pa.array([], type=pa.binary())})
-        sources = [Source(lambda: empty, 0)]
+        sources = [Source(lambda: empty, 0, schema_hint=files_schema)]
     return DataFrame(sources, engine=engine)
 
 

@@ -1086,6 +1086,108 @@ class TestOutOfCoreRepartition:
         assert out.count() == 96
 
 
+class TestSchemaHint:
+    """Leaf sources with a statically-known schema publish it as
+    ``Source.schema_hint`` so the zero-row schema probe never
+    materializes partition 0 (review r5: LR's free sizing estimate was
+    decoding a whole image partition just to read the feature width)."""
+
+    def test_schema_probe_does_not_load_partition(self):
+        loads = {"n": 0}
+        batch = pa.RecordBatch.from_pydict(
+            {"x": pa.array([1.0, 2.0]), "s": pa.array(["a", "b"])})
+
+        def load():
+            loads["n"] += 1
+            return batch
+
+        df = DataFrame([Source(load, batch.num_rows,
+                               schema_hint=batch.schema)])
+        assert df.columns == ["x", "s"]
+        assert loads["n"] == 0  # hint answered the probe
+        assert df.collect().num_rows == 2
+        assert loads["n"] == 1
+
+    def test_plan_stages_run_on_hint_prototype(self):
+        # the probe still runs the plan (on a zero-row prototype), so
+        # plan-added columns appear in .columns without a load
+        loads = {"n": 0}
+        batch = pa.RecordBatch.from_pydict({"x": pa.array([1.0, 2.0])})
+
+        def load():
+            loads["n"] += 1
+            return batch
+
+        df = DataFrame([Source(load, 2, schema_hint=batch.schema)])
+        df = df.with_column(
+            "y", lambda b: np.zeros((b.num_rows, 3), np.float32))
+        assert df.columns == ["x", "y"]
+        assert loads["n"] == 0
+
+    def test_files_frame_schema_without_reading_files(self):
+        from sparkdl_tpu.image.imageIO import filesToDF
+
+        df = filesToDF(["/nonexistent/zzz.bin"], numPartitions=1)
+        assert df.columns == ["filePath", "fileData"]  # no open()
+        with pytest.raises(Exception):
+            df.collect()
+
+    def test_reader_hint_schema_matches_loaded(self, tmp_path):
+        # the hint path must produce EXACTLY the loaded path's schema,
+        # through the full decode plans of both readers
+        from PIL import Image
+
+        from sparkdl_tpu.image import imageIO
+
+        rng = np.random.default_rng(0)
+        for i in range(2):
+            Image.fromarray(
+                rng.integers(0, 255, (16, 20, 3), dtype=np.uint8),
+                "RGB").save(tmp_path / f"i{i}.png")
+        for df in (imageIO.readImages(str(tmp_path), numPartitions=2),
+                   imageIO.readImagesPacked(str(tmp_path), (8, 8),
+                                            numPartitions=2)):
+            assert df.schema == df.collect().schema
+
+
+def test_pooled_downstream_quiesces_on_error():
+    """review r5: a failing pooled host stage downstream of a
+    re-chunked device stage must DRAIN its in-flight siblings before
+    the error reaches the caller — a straggler completing after the
+    caller's cleanup (write_parquet sweeping its staging dir) corrupts
+    the cleanup's outcome."""
+    import time
+
+    from sparkdl_tpu.data.engine import LocalEngine
+    from sparkdl_tpu.data.frame import Stage
+
+    eng = LocalEngine(num_workers=4, max_inflight=2, max_retries=0)
+    batches = []
+    for lo in range(0, 24, 4):
+        batches.append(pa.RecordBatch.from_pydict(
+            {"rid": pa.array(np.arange(lo, lo + 4))}))
+    effects = []
+
+    def host_fn(batch):
+        chunk = int(batch.column(0)[0].as_py()) // 4
+        if chunk == 0:
+            raise ValueError("boom")
+        time.sleep(0.2)
+        effects.append(time.perf_counter())
+        return batch
+
+    plan = [Stage(lambda b: b, kind="device", name="dev", batch_hint=4),
+            Stage(host_fn, kind="host", name="fx")]
+    sources = [Source((lambda bb=bb: bb), bb.num_rows)
+               for bb in batches]
+    with pytest.raises(ValueError, match="boom"):
+        for _ in eng.execute(sources, plan):
+            pass
+    t_err = time.perf_counter()
+    time.sleep(0.5)  # stragglers would land in this window
+    assert all(t <= t_err for t in effects), (effects, t_err)
+
+
 def test_interrupted_commit_keeps_refusal_evidence(tmp_path,
                                                    monkeypatch):
     """A write_parquet that fails mid-commit (after some parts moved

@@ -335,6 +335,32 @@ class TestLogisticRegression:
         assert runs["n"] == counted.num_partitions
         assert zero_rows["n"] <= 1  # the budget estimate's schema probe
 
+    def test_fit_budget_probe_never_loads_partition0(self):
+        """review r5: the default-budget sizing estimate's schema probe
+        must ride the leaf schema_hint — partition 0's SOURCE must load
+        exactly once (the collect pass), not once more for the probe."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.frame import Source
+        from sparkdl_tpu.data.tensors import append_tensor_column
+
+        rng = np.random.default_rng(0)
+        batch = pa.RecordBatch.from_pylist(
+            [{"label": int(i % 2)} for i in range(8)])
+        batch = append_tensor_column(
+            batch, "features",
+            rng.normal(size=(8, 3)).astype(np.float32))
+        loads = {"n": 0}
+
+        def load():
+            loads["n"] += 1
+            return batch
+
+        df = DataFrame([Source(load, batch.num_rows,
+                               schema_hint=batch.schema)])
+        LogisticRegression(maxIter=2).fit(df)
+        assert loads["n"] == 1, loads
+
     def test_bad_labels_rejected(self):
         import pyarrow as pa
         from sparkdl_tpu.data.tensors import append_tensor_column
