@@ -362,6 +362,15 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
                     int H, int W, int scaled) {
     jpeg_decompress_struct cinfo;
     JpegErr jerr;
+    // Constructed BEFORE setjmp: a longjmp out of libjpeg mid-decode
+    // (corrupt payload behind a valid header) must not jump out of
+    // these objects' scopes — skipped destructors would leak one
+    // image's worth of heap per corrupt row, and the jump is formally
+    // UB. Declared here, the error path returns through their normal
+    // destruction.
+    std::vector<uint8_t> buf[3];   // raw420 per-component planes
+    std::vector<uint8_t> tmp;      // grayscale / RGB decode scratch
+    std::vector<uint8_t> sized;    // RGB resize scratch
     cinfo.err = jpeg_std_error(&jerr.mgr);
     jerr.mgr.error_exit = jpeg_err_exit;
     if (setjmp(jerr.jump)) {
@@ -418,7 +427,6 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
             (static_cast<int>(cinfo.output_height) + mcu_h - 1) / mcu_h;
         int rows_per[3], dh[3], dw[3];
         size_t stride[3];
-        std::vector<uint8_t> buf[3];
         for (int i = 0; i < 3; ++i) {
             const jpeg_component_info& ci = cinfo.comp_info[i];
             rows_per[i] = ci.v_samp_factor * SDL_COMP_DCT_V(ci);
@@ -464,7 +472,7 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         cinfo.out_color_space = JCS_GRAYSCALE;
         jpeg_start_decompress(&cinfo);
         const int h = cinfo.output_height, w = cinfo.output_width;
-        std::vector<uint8_t> tmp(static_cast<size_t>(h) * w);
+        tmp.resize(static_cast<size_t>(h) * w);
         while (cinfo.output_scanline < cinfo.output_height) {
             JSAMPROW row = tmp.data()
                 + static_cast<size_t>(cinfo.output_scanline) * w;
@@ -489,7 +497,7 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
         return 2;
     }
     const int h = cinfo.output_height, w = cinfo.output_width;
-    std::vector<uint8_t> tmp(static_cast<size_t>(h) * w * 3);
+    tmp.resize(static_cast<size_t>(h) * w * 3);
     while (cinfo.output_scanline < cinfo.output_height) {
         JSAMPROW row = tmp.data()
             + static_cast<size_t>(cinfo.output_scanline) * w * 3;
@@ -497,7 +505,7 @@ int jpeg_decode_420(const uint8_t* data, size_t len, uint8_t* dst,
     }
     jpeg_finish_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
-    std::vector<uint8_t> sized(static_cast<size_t>(H) * W * 3);
+    sized.resize(static_cast<size_t>(H) * W * 3);
     if (resize_one(tmp.data(), h, w, 3, sized.data(), H, W, 3)) return 2;
     rgb_to_yuv420(sized.data(), H, W, dst);
     return 0;
