@@ -363,7 +363,10 @@ class LocalEngine:
             for idx, batch in stream:
                 pending.append((idx, self._pool.submit(
                     self._apply_stream_stage, stage, batch, idx)))
-                while len(pending) > self.max_inflight:
+                # >=: the documented bound is AT MOST max_inflight
+                # in-flight (submit-then-drain at > held one extra
+                # partition's device output beyond the window)
+                while len(pending) >= self.max_inflight:
                     i, fut = pending.popleft()
                     yield i, fut.result()
             while pending:

@@ -288,9 +288,13 @@ class DataFrame:
                 import logging
                 logging.getLogger(__name__).warning(
                     "%r has no _SUCCESS marker%s: serving a dataset "
-                    "this library did not commit (foreign writers "
-                    "don't produce the marker; interrupted commits "
-                    "are detected via _tmp.* remnants)", path,
+                    "this library did not commit. COMPLETENESS CANNOT "
+                    "BE VERIFIED — foreign writers (pyarrow/pandas) "
+                    "don't produce the marker, but a writer that died "
+                    "without leaving its _tmp.* staging remnant looks "
+                    "identical. If these rows feed training, confirm "
+                    "the row count or rewrite via write_parquet (touch "
+                    "_SUCCESS to silence this warning).", path,
                     " and a _tmp.* staging remnant" if staging else "")
         else:
             files = [path]
@@ -1087,8 +1091,19 @@ class DataFrame:
         """Ordered iterator of fully-transformed partition batches."""
         return self._engine.execute(self._sources, self._plan)
 
-    def collect(self) -> pa.Table:
-        batches = list(self.stream())
+    def collect(self, on_batch=None) -> pa.Table:
+        """Materialize the frame as one Arrow table.
+
+        ``on_batch``: optional observer called with each streamed batch
+        as it arrives — the seam for byte/row watchdogs (e.g.
+        ``LogisticRegression``'s mid-collect budget warning) so callers
+        that need to watch the stream don't re-implement collect's
+        empty-batch rules."""
+        batches = []
+        for b in self.stream():
+            if on_batch is not None:
+                on_batch(b)
+            batches.append(b)
         if not batches:
             return pa.table({})
         non_empty = [b for b in batches if b.num_rows]
@@ -1101,6 +1116,12 @@ class DataFrame:
             # sample — routinely empty whole partitions). Drop them
             # rather than fail the concat.
             batches = non_empty
+        elif not non_empty:
+            # ALL partitions emptied: the same imprecise-type hazard
+            # means sibling empty batches can disagree with each other
+            # — keep one as the schema carrier instead of failing a
+            # meaningless 0-row concat
+            batches = batches[:1]
         return pa.Table.from_batches(batches)
 
     def collect_rows(self) -> List[Row]:

@@ -10,6 +10,7 @@ from sparkdl_tpu.estimators.evaluators import (
     ClassificationEvaluator,
     LossEvaluator,
 )
+from sparkdl_tpu.params.pipeline import EmptyScoredFrameError
 from sparkdl_tpu.estimators.keras_image_file_estimator import (
     KerasImageFileEstimator,
     KerasImageFileModel,
@@ -27,4 +28,5 @@ __all__ = [
     "BinaryClassificationEvaluator",
     "ClassificationEvaluator",
     "LossEvaluator",
+    "EmptyScoredFrameError",
 ]

@@ -15,7 +15,7 @@ import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
-from sparkdl_tpu.params.pipeline import Evaluator
+from sparkdl_tpu.params.pipeline import EmptyScoredFrameError, Evaluator
 
 
 def _pred_and_labels(table, predictionCol: str, labelCol: str):
@@ -215,8 +215,9 @@ def _metric_from_confusion(conf: dict, metric: str) -> float:
         # one convention across all three evaluators (advisor r4 #4):
         # an empty scored frame RAISES, matching
         # BinaryClassificationEvaluator — a CV fold whose validation
-        # side filtered every row out must not silently score 0.0
-        raise ValueError(
+        # side filtered every row out must not silently score 0.0.
+        # Typed so CrossValidator can nan-skip the fold (loudly).
+        raise EmptyScoredFrameError(
             "cannot evaluate an empty scored frame (0 rows with "
             "predictions and labels); check upstream filters/folds")
     if metric == "accuracy":
@@ -361,7 +362,7 @@ class BinaryClassificationEvaluator(Evaluator):
             neg_parts.append(np.bincount(inv, weights=(labels == 0),
                                          minlength=len(uniq)))
         if not uniq_parts:
-            raise ValueError(
+            raise EmptyScoredFrameError(
                 "cannot evaluate an empty scored frame (0 rows — e.g. "
                 "a validation fold that filtered every row out)")
         merged, inv = np.unique(np.concatenate(uniq_parts),
@@ -555,7 +556,7 @@ class LossEvaluator(Evaluator):
             n += batch_n
         if n == 0:
             # same convention as the other evaluators (advisor r4 #4)
-            raise ValueError(
+            raise EmptyScoredFrameError(
                 "cannot evaluate an empty scored frame (0 rows with "
                 "predictions and labels); check upstream filters/folds")
         return total / n

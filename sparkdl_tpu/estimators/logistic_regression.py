@@ -242,11 +242,17 @@ class LogisticRegression(Estimator, HasLabelCol):
         if not rows:
             return None
         try:
+            from sparkdl_tpu.data.frame import column_index
             from sparkdl_tpu.data.tensors import tensor_shape_of
+            # column_index raises KeyError on a missing column —
+            # schema.field(get_field_index(miss)) would NEGATIVE-index
+            # the LAST field and estimate from the wrong column's width
             field = dataset.schema.field(
-                dataset.schema.get_field_index(feat))
+                column_index(dataset.schema, feat))
             shape = tensor_shape_of(field)
         except Exception:
+            # unknown width (or missing column: the collect path's own
+            # lookup raises the clear error) -> no free estimate
             return None
         if not shape or any(d is None for d in shape):
             return None
@@ -292,35 +298,31 @@ class LogisticRegression(Estimator, HasLabelCol):
 
         # materialize ONCE: the upstream plan may include the expensive
         # featurization; read features and labels from the same table.
-        # Accumulated streaming with a running byte watchdog: when the
-        # estimate above couldn't be known for free (filtered frames),
-        # crossing the budget still warns loudly mid-collect.
-        import pyarrow as pa
-
+        # collect()'s on_batch seam carries the running byte watchdog:
+        # when the estimate above couldn't be known for free (filtered
+        # frames), crossing the budget still warns loudly mid-collect —
+        # and the empty-batch concat rules stay collect()'s alone.
         from sparkdl_tpu.data.tensors import arrow_to_tensor
-        batches = []
-        seen_bytes = 0
-        warned = False
-        for b in dataset.stream():
-            batches.append(b)
-            seen_bytes += sum(
+
+        seen = {"bytes": 0, "warned": False}
+
+        def _watch(b):
+            seen["bytes"] += sum(
                 buf.size for col in b.columns
                 for buf in col.buffers() if buf is not None)
-            if budget > 0 and seen_bytes > budget and not warned:
-                warned = True
+            if budget > 0 and seen["bytes"] > budget \
+                    and not seen["warned"]:
+                seen["warned"] = True
                 logging.getLogger(__name__).warning(
                     "collected fit has already buffered %.1f GiB "
                     "(memoryBudgetBytes=%.1f GiB) and the frame isn't "
                     "finished; use streaming=True (with batchSize) to "
                     "fit without materializing the feature table",
-                    seen_bytes / 2**30, budget / 2**30)
-        if not batches:
+                    seen["bytes"] / 2**30, budget / 2**30)
+
+        table = dataset.collect(on_batch=_watch)
+        if table.num_columns == 0 or table.num_rows == 0:
             raise ValueError("cannot fit on an empty dataset")
-        # plan-emptied partitions can carry imprecise computed-column
-        # types at 0 rows — drop empty batches when non-empty exist
-        # (the same rule collect()/join() apply)
-        non_empty = [b for b in batches if b.num_rows]
-        table = pa.Table.from_batches(non_empty or batches[:1])
         fidx = column_index(table, feat)
         X = np.asarray(arrow_to_tensor(table.column(fidx),
                                        table.schema.field(fidx)),
@@ -330,8 +332,6 @@ class LogisticRegression(Estimator, HasLabelCol):
         y = np.asarray(
             table.column(column_index(table, self.getLabelCol()))
             .to_pylist())
-        if len(X) == 0:
-            raise ValueError("cannot fit on an empty dataset")
         y = self._clean_labels(y)
         declared = int(self.getOrDefault("numClasses"))
         if declared > 0:

@@ -245,6 +245,15 @@ class Pipeline(Estimator):
         return PipelineModel(fitted)
 
 
+class EmptyScoredFrameError(ValueError):
+    """Raised by evaluators when the scored frame has 0 rows (e.g. a
+    validation fold whose rows were all filtered out). A TYPED error so
+    tuning can distinguish "this fold had nothing to score" (skippable
+    with a loud warning — CrossValidator nan-skips the fold) from a
+    genuine evaluator misuse, while standalone ``evaluate`` calls still
+    fail loudly (it is a ValueError)."""
+
+
 class Evaluator(Params):
     """Scores a transformed DataFrame; used by CrossValidator."""
 

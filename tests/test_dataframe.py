@@ -1086,6 +1086,24 @@ class TestOutOfCoreRepartition:
         assert out.count() == 96
 
 
+class TestCollectSeam:
+    def test_on_batch_observes_every_batch(self):
+        seen = []
+        table = _df(40, 4).collect(on_batch=lambda b: seen.append(
+            b.num_rows))
+        assert table.num_rows == 40
+        assert sum(seen) == 40 and len(seen) == 4
+
+    def test_all_empty_keeps_one_schema_carrier(self):
+        # every partition emptied: sibling empty batches may carry
+        # imprecise computed-column types that disagree — collect keeps
+        # one as the schema carrier instead of failing the concat
+        df = _df(40, 4).filter(lambda b: np.zeros(b.num_rows, bool))
+        table = df.collect()
+        assert table.num_rows == 0
+        assert table.schema.names == ["x", "s"]
+
+
 class TestSchemaHint:
     """Leaf sources with a statically-known schema publish it as
     ``Source.schema_hint`` so the zero-row schema probe never

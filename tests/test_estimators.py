@@ -938,22 +938,104 @@ class TestTargetPrep:
 
 def test_evaluators_raise_on_empty_scored_frame():
     """One convention across all three evaluators (advisor r4 #4): an
-    empty scored frame raises instead of silently scoring 0.0/NaN."""
+    empty scored frame raises — the TYPED EmptyScoredFrameError (a
+    ValueError), so tuning can nan-skip a degenerate fold while
+    standalone calls still fail loudly."""
     import pyarrow as pa
 
+    from sparkdl_tpu.estimators import EmptyScoredFrameError
     from sparkdl_tpu.estimators.evaluators import (
         BinaryClassificationEvaluator,
         ClassificationEvaluator,
         LossEvaluator,
     )
+    assert issubclass(EmptyScoredFrameError, ValueError)
     empty = DataFrame.from_table(pa.table({
         "prediction": pa.array([], pa.float64()),
         "label": pa.array([], pa.float64())}))
     for ev in (ClassificationEvaluator(), LossEvaluator(),
                BinaryClassificationEvaluator(
                    rawPredictionCol="prediction")):
-        with pytest.raises(ValueError, match="empty|no rows|0 rows"):
+        with pytest.raises(EmptyScoredFrameError,
+                           match="empty|no rows|0 rows"):
             ev.evaluate(empty)
+
+
+class TestEmptyFoldHandling:
+    """review r5: one degenerate CV fold (validation side emptied by
+    upstream filters) must not crash the whole search after N-1 folds
+    of work — the fold nan-skips with a loud warning. TVS's single
+    validation side is shared by every candidate, so there it stays a
+    hard error, with attribution."""
+
+    def _stub(self):
+        from sparkdl_tpu.params.pipeline import Estimator, Model
+
+        class _M(Model):
+            def _transform(self, dataset):
+                return dataset
+
+        class _E(Estimator):
+            def _fit(self, dataset):
+                return _M()
+
+        return _E()
+
+    def _flaky_ev(self, fail_calls):
+        from sparkdl_tpu.params.pipeline import (
+            EmptyScoredFrameError,
+            Evaluator,
+        )
+
+        class _Ev(Evaluator):
+            calls = 0
+
+            def evaluate(self, dataset):
+                _Ev.calls += 1
+                if _Ev.calls in fail_calls:
+                    raise EmptyScoredFrameError("0 rows")
+                return float(_Ev.calls)
+
+        return _Ev()
+
+    def _df(self):
+        import pyarrow as pa
+        return DataFrame.from_table(
+            pa.table({"x": np.arange(24.0), "label": [0, 1] * 12}), 4)
+
+    def test_cv_nan_skips_empty_fold(self, caplog):
+        import logging
+
+        from sparkdl_tpu.params.tuning import CrossValidator
+
+        # call order: fold0 cand0 (empty -> skipped), fold0 cand1 = 2,
+        # fold1 cand0 = 3, fold1 cand1 = 4
+        cv = CrossValidator(estimator=self._stub(),
+                            estimatorParamMaps=[{}, {}],
+                            evaluator=self._flaky_ev({1}), numFolds=2)
+        with caplog.at_level(logging.WARNING):
+            m = cv.fit(self._df())
+        assert m.avgMetrics == pytest.approx([3.0, 3.0])
+        assert any("scored 0 rows" in r.message for r in caplog.records)
+
+    def test_cv_all_empty_raises(self):
+        from sparkdl_tpu.params.tuning import CrossValidator
+
+        cv = CrossValidator(estimator=self._stub(),
+                            estimatorParamMaps=[{}, {}],
+                            evaluator=self._flaky_ev(set(range(1, 20))),
+                            numFolds=2)
+        with pytest.raises(ValueError, match="every fold"):
+            cv.fit(self._df())
+
+    def test_tvs_empty_validation_raises_with_context(self):
+        from sparkdl_tpu.params.tuning import TrainValidationSplit
+
+        tvs = TrainValidationSplit(
+            estimator=self._stub(), estimatorParamMaps=[{}],
+            evaluator=self._flaky_ev({1}))
+        with pytest.raises(ValueError, match="validation side"):
+            tvs.fit(self._df())
 
 
 class TestLRMemoryBudget:
@@ -1028,4 +1110,21 @@ class TestLRMemoryBudget:
         lr = self.LR(maxIter=2, memoryBudgetBytes=0)
         with caplog.at_level(logging.WARNING):
             lr.fit(df)
+        assert "auto-switching" not in caplog.text
+
+    def test_misspelled_features_col_fails_clearly(self, caplog):
+        """review r5: schema.field(get_field_index('typo')) == -1
+        negative-indexes the LAST field — the estimate must not be
+        computed from the wrong column (which could trigger a bogus
+        auto-switch before the real missing-column error)."""
+        import logging
+
+        # big tensor column LAST in the schema: the buggy lookup would
+        # estimate from it and cross the tiny budget
+        df = self._frame(n=64, width=64)
+        lr = self.LR(maxIter=2, featuresCol="featurs",
+                     memoryBudgetBytes=1024)
+        with caplog.at_level(logging.WARNING):
+            with pytest.raises(KeyError, match="featurs"):
+                lr.fit(df)
         assert "auto-switching" not in caplog.text
