@@ -115,6 +115,7 @@ def _decodeImage(imageData: bytes, origin: str = "") -> Optional[dict]:
 
 
 _JPEG_MAGIC = b"\xff\xd8\xff"
+_warned_fused_fallback = False
 
 
 def _decodeBatch(origins: Sequence[str],
@@ -573,7 +574,24 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                             sel, height, width, nChannels,
                             num_threads=nt,
                             scaled_decode=scaledDecode))
-            except Exception:
+            except Exception as e:
+                # missing shim/libjpeg is the expected reason (PIL path
+                # is the designed fallback, per-row corruption included)
+                # — but a silent fall-through on an unexpected binding
+                # error would hide a real bug as a quiet slowdown, so
+                # say what happened, once per process. The flag lives
+                # on the CANONICAL module object (imported here, in the
+                # executing process) — a `global` in this closure would
+                # hit cloudpickle's per-deserialization globals dict on
+                # Spark executors and fire once per TASK instead.
+                import sparkdl_tpu.image.imageIO as _mod
+                if not _mod._warned_fused_fallback:
+                    _mod._warned_fused_fallback = True
+                    import logging
+                    logging.getLogger(_mod.__name__).warning(
+                        "fused native decode unavailable (%s: %s); "
+                        "using the per-row PIL fallback",
+                        type(e).__name__, e)
                 fused = None
         if fused is not None:
             packed, okm = fused
