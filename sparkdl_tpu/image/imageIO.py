@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import io
 import os
+import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -116,6 +117,7 @@ def _decodeImage(imageData: bytes, origin: str = "") -> Optional[dict]:
 
 _JPEG_MAGIC = b"\xff\xd8\xff"
 _warned_fused_fallback = False
+_warn_lock = threading.Lock()
 
 
 def _decodeBatch(origins: Sequence[str],
@@ -585,8 +587,10 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                 # hit cloudpickle's per-deserialization globals dict on
                 # Spark executors and fire once per TASK instead.
                 import sparkdl_tpu.image.imageIO as _mod
-                if not _mod._warned_fused_fallback:
+                with _mod._warn_lock:
+                    fire = not _mod._warned_fused_fallback
                     _mod._warned_fused_fallback = True
+                if fire:
                     import logging
                     logging.getLogger(_mod.__name__).warning(
                         "fused native decode unavailable (%s: %s); "

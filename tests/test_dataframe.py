@@ -1168,6 +1168,89 @@ class TestSchemaHint:
             assert df.schema == df.collect().schema
 
 
+class TestRechunkChaos:
+    """Interaction coverage: the re-chunk stream phase composed with
+    TRANSIENT failures injected into every stage kind at once — random
+    partition layouts (empties included), an upstream host stage, the
+    re-chunked device stage, and a pooled downstream host stage, all
+    failing intermittently with retryable errors. Row identity, order,
+    and values must come out exact; retries must not double-apply."""
+
+    def test_random_layouts_with_transient_failures(self):
+        import pyarrow as pa
+
+        from sparkdl_tpu.data.engine import LocalEngine
+        from sparkdl_tpu.data.frame import Source, Stage
+
+        rng = np.random.default_rng(7)
+        for trial in range(4):
+            sizes = [int(s) for s in
+                     rng.integers(0, 9, size=int(rng.integers(3, 9)))]
+            n = sum(sizes)
+            if n == 0:
+                sizes.append(5)
+                n = 5
+            batches, lo = [], 0
+            for s in sizes:
+                batches.append(pa.RecordBatch.from_pydict(
+                    {"rid": pa.array(np.arange(lo, lo + s))}))
+                lo += s
+            # failure schedule keyed on batch CONTENT (first rid), not
+            # call order — pool interleaving must not shift which call
+            # fails, and a retried batch recomputes the same key so it
+            # fails exactly ONCE per (stage, batch) and then succeeds
+            # within max_retries. Guarded: concurrent first attempts of
+            # different batches share the set.
+            lock = threading.Lock()
+            failed_once: set = set()
+
+            def flaky(kind, batch, transform):
+                key = (kind, batch.column(0)[0].as_py()
+                       if batch.num_rows else -1)
+                with lock:
+                    fresh = key not in failed_once
+                    failed_once.add(key)
+                if fresh:
+                    raise OSError(f"transient {kind} {key}")
+                return transform(batch)
+
+            def add_col(b, name, fn):
+                vals = fn(np.asarray(b.column(0).to_pylist(),
+                                     np.float64))
+                return b.append_column(name, pa.array(vals))
+
+            plan = [
+                Stage(lambda b: flaky(
+                    "pre", b, lambda x: add_col(x, "a",
+                                                lambda v: v * 2.0)),
+                      kind="host", name="pre"),
+                Stage(lambda b: flaky(
+                    "dev", b, lambda x: add_col(x, "d",
+                                                lambda v: v + 0.5)),
+                      kind="device", name="dev", batch_hint=4),
+                Stage(lambda b: flaky(
+                    "post", b, lambda x: add_col(x, "p",
+                                                 lambda v: -v)),
+                      kind="host", name="post"),
+            ]
+            sources = [Source((lambda bb=bb: bb), bb.num_rows)
+                       for bb in batches]
+            eng = LocalEngine(num_workers=3, max_retries=2)
+            out = list(eng.execute(sources, plan))
+            table = pa.Table.from_batches(
+                [b for b in out if b.num_rows] or out[:1])
+            assert table.num_rows == n, (trial, sizes)
+            rid = np.asarray(table.column("rid").to_pylist(), np.float64)
+            np.testing.assert_array_equal(rid, np.arange(n))
+            np.testing.assert_allclose(
+                np.asarray(table.column("a").to_pylist()), rid * 2.0)
+            np.testing.assert_allclose(
+                np.asarray(table.column("d").to_pylist()), rid + 0.5)
+            np.testing.assert_allclose(
+                np.asarray(table.column("p").to_pylist()), -rid)
+            assert failed_once, "schedule never injected a failure"
+
+
 def test_pooled_downstream_quiesces_on_error():
     """review r5: a failing pooled host stage downstream of a
     re-chunked device stage must DRAIN its in-flight siblings before
