@@ -98,10 +98,17 @@ class ParamGridBuilder:
 
 
 class CrossValidatorModel(Model):
-    def __init__(self, bestModel: Model, avgMetrics: List[float]):
+    """``subModels`` (``collectSubModels=True`` only, else None) is
+    ``[fold][candidate] -> Model`` — pyspark 2.3's layout. In-memory
+    only: like pyspark, sub-models are a debugging/inspection aid and
+    are NOT persisted by ``save`` (only ``bestModel`` round-trips)."""
+
+    def __init__(self, bestModel: Model, avgMetrics: List[float],
+                 subModels: Optional[List[List[Model]]] = None):
         super().__init__()
         self.bestModel = bestModel
         self.avgMetrics = avgMetrics
+        self.subModels = subModels
 
     def _transform(self, dataset):
         return self.bestModel.transform(dataset)
@@ -137,15 +144,22 @@ class CrossValidator(Estimator):
     cacheDir = Param("CrossValidator", "cacheDir",
                      "spill directory for larger-than-RAM datasets",
                      TypeConverters.toString)
+    collectSubModels = Param(
+        "CrossValidator", "collectSubModels",
+        "keep every (fold, candidate) fitted model on the result "
+        "(memory scales with numFolds * len(paramMaps))",
+        TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, *, estimator=None, estimatorParamMaps=None,
-                 evaluator=None, numFolds=3, seed=42, cacheDir=None):
+                 evaluator=None, numFolds=3, seed=42, cacheDir=None,
+                 collectSubModels=False):
         super().__init__()
-        self._setDefault(numFolds=3, seed=42, cacheDir=None)
+        self._setDefault(numFolds=3, seed=42, cacheDir=None,
+                         collectSubModels=False)
         self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
                   evaluator=evaluator, numFolds=numFolds, seed=seed,
-                  cacheDir=cacheDir)
+                  cacheDir=cacheDir, collectSubModels=collectSubModels)
 
     def _kfold(self, dataset):
         """Split rows into k (train, validation) DataFrame pairs —
@@ -175,11 +189,17 @@ class CrossValidator(Estimator):
         # Materialize the upstream plan ONCE (decode-once, VERDICT r2
         # weak #2); with cacheDir the materialization is a disk spill,
         # never a full collected table (ADVICE r3 / VERDICT r3 #3).
+        collect_sub = bool(self.getOrDefault("collectSubModels"))
+        sub: Optional[List[List[Model]]] = \
+            ([[None] * len(maps) for _ in range(nfolds)]
+             if collect_sub else None)
         dataset, cleanup = _cached_for_tuning(
             dataset, self.getOrDefault("cacheDir"))
         try:
             for fold, (train, valid) in enumerate(self._kfold(dataset)):
                 for idx, model in est.fitMultiple(train, maps):
+                    if sub is not None:
+                        sub[fold][idx] = model
                     try:
                         scores[idx, fold] = ev.evaluate(
                             model.transform(valid))
@@ -205,14 +225,21 @@ class CrossValidator(Estimator):
             bestModel = est.fit(dataset, maps[best])
         finally:
             cleanup()
-        return CrossValidatorModel(bestModel, list(metrics))
+        return CrossValidatorModel(bestModel, list(metrics),
+                                   subModels=sub)
 
 
 class TrainValidationSplitModel(Model):
-    def __init__(self, bestModel: Model, validationMetrics: List[float]):
+    """``subModels`` (``collectSubModels=True`` only, else None) is
+    ``[candidate] -> Model``. In-memory only, like pyspark — not
+    persisted by ``save``."""
+
+    def __init__(self, bestModel: Model, validationMetrics: List[float],
+                 subModels: Optional[List[Model]] = None):
         super().__init__()
         self.bestModel = bestModel
         self.validationMetrics = validationMetrics
+        self.subModels = subModels
 
     def _transform(self, dataset):
         return self.bestModel.transform(dataset)
@@ -248,15 +275,21 @@ class TrainValidationSplit(Estimator):
     cacheDir = Param("TrainValidationSplit", "cacheDir",
                      "spill directory for larger-than-RAM datasets",
                      TypeConverters.toString)
+    collectSubModels = Param(
+        "TrainValidationSplit", "collectSubModels",
+        "keep every candidate's fitted model on the result",
+        TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, *, estimator=None, estimatorParamMaps=None,
-                 evaluator=None, trainRatio=0.75, seed=42, cacheDir=None):
+                 evaluator=None, trainRatio=0.75, seed=42, cacheDir=None,
+                 collectSubModels=False):
         super().__init__()
-        self._setDefault(trainRatio=0.75, seed=42, cacheDir=None)
+        self._setDefault(trainRatio=0.75, seed=42, cacheDir=None,
+                         collectSubModels=False)
         self._set(estimator=estimator, estimatorParamMaps=estimatorParamMaps,
                   evaluator=evaluator, trainRatio=trainRatio, seed=seed,
-                  cacheDir=cacheDir)
+                  cacheDir=cacheDir, collectSubModels=collectSubModels)
 
     def _split(self, dataset):
         """(train, valid) via :func:`_seeded_split`: a per-partition
@@ -282,7 +315,12 @@ class TrainValidationSplit(Estimator):
 
             train, valid = self._split(dataset)
             metrics = [0.0] * len(maps)
+            sub: Optional[List[Model]] = \
+                ([None] * len(maps)
+                 if self.getOrDefault("collectSubModels") else None)
             for idx, model in est.fitMultiple(train, maps):
+                if sub is not None:
+                    sub[idx] = model
                 try:
                     metrics[idx] = ev.evaluate(model.transform(valid))
                 except EmptyScoredFrameError as e:
@@ -299,4 +337,5 @@ class TrainValidationSplit(Estimator):
             bestModel = est.fit(dataset, maps[best])
         finally:
             cleanup()
-        return TrainValidationSplitModel(bestModel, metrics)
+        return TrainValidationSplitModel(bestModel, metrics,
+                                         subModels=sub)
