@@ -131,6 +131,17 @@ class Params:
         self._paramMap[p] = p.typeConverter(value)
         return self
 
+    def setParams(self, **kwargs) -> "Params":
+        """Set several params by name in one call (the pyspark
+        convention — ``lr.setParams(maxIter=10, labelCol="y")``).
+        Unknown names raise; values pass through the same typed
+        converters as :meth:`set`. Unlike the keyword_only ``_set`` in
+        constructors, an explicit ``None`` here is a real assignment,
+        not "leave unset"."""
+        for name, value in kwargs.items():
+            self.set(name, value)
+        return self
+
     def _set(self, **kwargs) -> "Params":
         for name, value in kwargs.items():
             if value is None:

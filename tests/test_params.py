@@ -113,6 +113,18 @@ class TestParams:
         with pytest.raises(AttributeError):
             t.getParam("nope")
 
+    def test_set_params(self):
+        """pyspark convention: setParams(**kwargs) sets several params
+        through the typed converters, raising on unknown names."""
+        t = AddConst(inputCol="x", outputCol="y")
+        assert t.setParams(value=3, outputCol="z") is t
+        assert t.getOrDefault("value") == 3.0  # converter applied
+        assert t.getOutputCol() == "z"
+        with pytest.raises(AttributeError):
+            t.setParams(nope=1)
+        with pytest.raises(TypeError):
+            t.setParams(value="not-a-number")
+
     def test_explain_params(self):
         t = AddConst(inputCol="x", outputCol="y")
         s = t.explainParams()
