@@ -53,6 +53,22 @@ class LogisticRegressionModel(Model):
     def numClasses(self) -> int:
         return self.coefficients.shape[1]
 
+    @property
+    def numFeatures(self) -> int:
+        return self.coefficients.shape[0]
+
+    @property
+    def coefficientMatrix(self) -> np.ndarray:
+        """[numClasses, numFeatures] — pyspark's multinomial layout
+        (``self.coefficients`` stores the transpose, [D, C]). A COPY,
+        like pyspark's detached Matrix: mutating it must not corrupt
+        the fitted model."""
+        return self.coefficients.T.copy()
+
+    @property
+    def interceptVector(self) -> np.ndarray:
+        return self.intercept.copy()
+
     def _transform(self, dataset):
         import pyarrow as pa
 

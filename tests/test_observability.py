@@ -112,6 +112,11 @@ class TestLogisticRegression:
         preds = np.asarray([r["prediction"] for r in out.collect_rows()])
         assert preds.dtype == np.float64
         np.testing.assert_array_equal(preds, probs.argmax(-1))
+        # pyspark model-inspection surface (coefficientMatrix is
+        # [numClasses, numFeatures], the multinomial layout)
+        assert model.numFeatures == 5
+        assert model.coefficientMatrix.shape == (2, 5)
+        assert model.interceptVector.shape == (2,)
 
     def test_minibatch_matches_full_batch_quality(self):
         """batchSize>0 streams shuffled minibatches through a
