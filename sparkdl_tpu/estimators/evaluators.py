@@ -137,7 +137,7 @@ class ClassificationEvaluator(Evaluator):
                 f"predictionSemantics must be one of {_PRED_SEMANTICS}, "
                 f"got {predictionSemantics!r}")
 
-    def evaluate(self, dataset) -> float:
+    def _evaluate(self, dataset) -> float:
         metric = self.getOrDefault("metricName")
         if metric not in _CLS_METRICS:
             # re-validate here too: set()/copy(extra) bypass __init__,
@@ -314,7 +314,7 @@ class BinaryClassificationEvaluator(Evaluator):
             return "probability"
         return col  # let the column-lookup error name the missing col
 
-    def evaluate(self, dataset) -> float:
+    def _evaluate(self, dataset) -> float:
         metric = self.getOrDefault("metricName")
         if metric not in _BIN_METRICS:
             raise ValueError(
@@ -448,7 +448,7 @@ class LossEvaluator(Evaluator):
     def isLargerBetter(self) -> bool:
         return False
 
-    def evaluate(self, dataset) -> float:
+    def _evaluate(self, dataset) -> float:
         # Streams: probability VECTORS (the memory hog — C can be 1000)
         # reduce per batch into (sum of -log picked, count); scalar
         # probabilities gather as two scalar arrays because their

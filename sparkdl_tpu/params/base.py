@@ -167,13 +167,16 @@ class Params:
             pm.update(extra)
         return pm
 
+    def explainParam(self, param) -> str:
+        """One param's doc + current value (pyspark convention;
+        accepts a Param or its name)."""
+        p = self._resolveParam(param)
+        cur = (repr(self.getOrDefault(p))
+               if self.isDefined(p) else "undefined")
+        return f"{p.name}: {p.doc} (current: {cur})"
+
     def explainParams(self) -> str:
-        lines = []
-        for p in self.params:
-            cur = (repr(self.getOrDefault(p))
-                   if self.isDefined(p) else "undefined")
-            lines.append(f"{p.name}: {p.doc} (current: {cur})")
-        return "\n".join(lines)
+        return "\n".join(self.explainParam(p) for p in self.params)
 
     # -- copy ---------------------------------------------------------------
 

@@ -255,10 +255,27 @@ class EmptyScoredFrameError(ValueError):
 
 
 class Evaluator(Params):
-    """Scores a transformed DataFrame; used by CrossValidator."""
+    """Scores a transformed DataFrame; used by CrossValidator.
 
-    @abstractmethod
-    def evaluate(self, dataset) -> float:
+    ``evaluate(dataset, params)`` with a param-map override scores
+    through a copy carrying those params (pyspark convention) — the
+    instance itself is never mutated. Implement ``_evaluate`` in
+    subclasses (pyspark's convention too); a subclass that overrides
+    ``evaluate`` itself bypasses this base and owns the params-override
+    contract."""
+
+    def evaluate(self, dataset, params: Optional[dict] = None) -> float:
+        if params is not None and not isinstance(params, dict):
+            raise TypeError(
+                "params must be a dict of (Param | name) -> value, got "
+                f"{type(params).__name__}")
+        if params:
+            # through the copy's own evaluate, so a subclass overriding
+            # evaluate(dataset) still runs its override after the copy
+            return self.copy(params).evaluate(dataset)
+        return self._evaluate(dataset)
+
+    def _evaluate(self, dataset) -> float:
         raise NotImplementedError
 
     def isLargerBetter(self) -> bool:

@@ -129,6 +129,28 @@ class TestParams:
         t = AddConst(inputCol="x", outputCol="y")
         s = t.explainParams()
         assert "inputCol" in s and "value" in s
+        # singular form (pyspark convention), by name or Param
+        assert t.explainParam("inputCol").startswith("inputCol:")
+        assert "'x'" in t.explainParam(t.inputCol)
+        with pytest.raises(AttributeError):
+            t.explainParam("nope")
+
+    def test_evaluator_params_override(self):
+        """evaluate(dataset, params) scores through a COPY carrying the
+        override (pyspark convention); the instance is untouched."""
+        import pyarrow as pa
+
+        from sparkdl_tpu.estimators import ClassificationEvaluator
+
+        rows = [{"label": 0, "prediction": 0.0, "alt": 1.0},
+                {"label": 1, "prediction": 1.0, "alt": 0.0}]
+        df = DataFrame.from_batches([pa.RecordBatch.from_pylist(rows)])
+        ev = ClassificationEvaluator(predictionCol="prediction")
+        assert ev.evaluate(df) == 1.0
+        assert ev.evaluate(df, {ev.predictionCol: "alt"}) == 0.0
+        assert ev.getOrDefault("predictionCol") == "prediction"
+        with pytest.raises(TypeError, match="dict"):
+            ev.evaluate(df, [{ev.predictionCol: "alt"}])
 
 
 class TestTransform:
