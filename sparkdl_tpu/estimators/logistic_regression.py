@@ -59,14 +59,24 @@ class LogisticRegressionModel(Model):
 
     @property
     def coefficientMatrix(self) -> np.ndarray:
-        """[numClasses, numFeatures] — pyspark's multinomial layout
-        (``self.coefficients`` stores the transpose, [D, C]). A COPY,
-        like pyspark's detached Matrix: mutating it must not corrupt
-        the fitted model."""
+        """pyspark's layouts exactly: binomial (numClasses == 2) is ONE
+        signed-margin row [1, numFeatures] (margin = class-1 row −
+        class-0 row of the stored softmax weights; migration code like
+        ``coefficientMatrix[0]`` reads the margin, as in MLlib);
+        multinomial is [numClasses, numFeatures]. A COPY, like
+        pyspark's detached Matrix: mutating it must not corrupt the
+        fitted model (``self.coefficients`` stores the softmax [D, C])."""
+        if self.numClasses == 2:
+            return (self.coefficients[:, 1]
+                    - self.coefficients[:, 0])[None, :]
         return self.coefficients.T.copy()
 
     @property
     def interceptVector(self) -> np.ndarray:
+        """Binomial: length-1 signed-margin intercept (pyspark);
+        multinomial: length-numClasses. A copy."""
+        if self.numClasses == 2:
+            return np.asarray([self.intercept[1] - self.intercept[0]])
         return self.intercept.copy()
 
     def _transform(self, dataset):

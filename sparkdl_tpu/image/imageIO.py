@@ -120,6 +120,27 @@ _warned_fused_fallback = False
 _warn_lock = threading.Lock()
 
 
+def _warn_native_fallback_once(e: BaseException, where: str) -> None:
+    """An UNEXPECTED exception from the native decode call falls back
+    to the per-row PIL path (a missing shim is not unexpected — those
+    calls return None, and the build/load already logged) — but doing
+    so silently would hide a real binding bug as a quiet slowdown, so
+    say what happened, once per process. Module-level on purpose: a
+    `global` in a shipped closure would hit cloudpickle's
+    per-deserialization globals on Spark executors and fire per task;
+    this function pickles by reference, so its globals are the real
+    module's everywhere."""
+    global _warned_fused_fallback
+    with _warn_lock:
+        fire = not _warned_fused_fallback
+        _warned_fused_fallback = True
+    if fire:
+        import logging
+        logging.getLogger(__name__).warning(
+            "native decode raised unexpectedly in %s (%s: %s); using "
+            "the per-row PIL fallback", where, type(e).__name__, e)
+
+
 def _decodeBatch(origins: Sequence[str],
                  blobs: Sequence[bytes]) -> List[Optional[dict]]:
     """Decode a partition's files: JPEGs in ONE native libjpeg call
@@ -137,7 +158,8 @@ def _decodeBatch(origins: Sequence[str],
             from sparkdl_tpu import native
             decoded = native.decode_jpeg_batch(
                 [blobs[i] for i in jpeg_idx])
-        except Exception:  # any native failure → full PIL fallback
+        except Exception as e:  # unexpected native failure → PIL, loudly
+            _warn_native_fallback_once(e, "decode_jpeg_batch")
             decoded = None
     if decoded is not None:
         for i, arr in zip(jpeg_idx, decoded):
@@ -577,25 +599,10 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
                             num_threads=nt,
                             scaled_decode=scaledDecode))
             except Exception as e:
-                # missing shim/libjpeg is the expected reason (PIL path
-                # is the designed fallback, per-row corruption included)
-                # — but a silent fall-through on an unexpected binding
-                # error would hide a real bug as a quiet slowdown, so
-                # say what happened, once per process. The flag lives
-                # on the CANONICAL module object (imported here, in the
-                # executing process) — a `global` in this closure would
-                # hit cloudpickle's per-deserialization globals dict on
-                # Spark executors and fire once per TASK instead.
-                import sparkdl_tpu.image.imageIO as _mod
-                with _mod._warn_lock:
-                    fire = not _mod._warned_fused_fallback
-                    _mod._warned_fused_fallback = True
-                if fire:
-                    import logging
-                    logging.getLogger(_mod.__name__).warning(
-                        "fused native decode unavailable (%s: %s); "
-                        "using the per-row PIL fallback",
-                        type(e).__name__, e)
+                # a missing shim/libjpeg is NOT this path (those calls
+                # return None, logged at build/load); an unexpected
+                # binding error must not hide as a quiet slowdown
+                _warn_native_fallback_once(e, "decode_resize_pack")
                 fused = None
         if fused is not None:
             packed, okm = fused

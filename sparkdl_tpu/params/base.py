@@ -135,11 +135,15 @@ class Params:
         """Set several params by name in one call (the pyspark
         convention — ``lr.setParams(maxIter=10, labelCol="y")``).
         Unknown names raise; values pass through the same typed
-        converters as :meth:`set`. Unlike the keyword_only ``_set`` in
-        constructors, an explicit ``None`` here is a real assignment,
-        not "leave unset"."""
+        converters as :meth:`set`. An explicit ``None`` CLEARS the
+        param back to its default (the typed converters don't accept
+        None, and for nullable params like ``cacheDir`` the default is
+        None — so this is how you set them back)."""
         for name, value in kwargs.items():
-            self.set(name, value)
+            if value is None:
+                self.clear(name)
+            else:
+                self.set(name, value)
         return self
 
     def _set(self, **kwargs) -> "Params":
@@ -169,7 +173,14 @@ class Params:
 
     def explainParam(self, param) -> str:
         """One param's doc + current value (pyspark convention;
-        accepts a Param or its name)."""
+        accepts a Param or its name). A Param OBJECT from another class
+        raises, as in pyspark — name-resolving it against this instance
+        would explain a plausible-but-wrong same-named param."""
+        if isinstance(param, Param) \
+                and not any(p is param for p in self.params):
+            raise ValueError(
+                f"Param {param.name!r} does not belong to "
+                f"{type(self).__name__}")
         p = self._resolveParam(param)
         cur = (repr(self.getOrDefault(p))
                if self.isDefined(p) else "undefined")

@@ -112,11 +112,20 @@ class TestLogisticRegression:
         preds = np.asarray([r["prediction"] for r in out.collect_rows()])
         assert preds.dtype == np.float64
         np.testing.assert_array_equal(preds, probs.argmax(-1))
-        # pyspark model-inspection surface (coefficientMatrix is
-        # [numClasses, numFeatures], the multinomial layout)
+        # pyspark model-inspection surface: BINOMIAL layout for 2
+        # classes — one signed-margin row — exactly like MLlib, so
+        # migration code reading coefficientMatrix[0] gets the margin
         assert model.numFeatures == 5
-        assert model.coefficientMatrix.shape == (2, 5)
-        assert model.interceptVector.shape == (2,)
+        assert model.coefficientMatrix.shape == (1, 5)
+        assert model.interceptVector.shape == (1,)
+        # the margin must separate the blobs in the right DIRECTION:
+        # features are shifted +3 for class 1, so margin weights sum > 0
+        assert float(model.coefficientMatrix[0].sum()) > 0
+        # detached copies: mutation cannot corrupt the model
+        model.coefficientMatrix[0, 0] = 1e9
+        model.interceptVector[0] = 1e9
+        assert abs(model.coefficients).max() < 1e8
+        assert abs(model.intercept).max() < 1e8
 
     def test_minibatch_matches_full_batch_quality(self):
         """batchSize>0 streams shuffled minibatches through a

@@ -115,7 +115,9 @@ class TestParams:
 
     def test_set_params(self):
         """pyspark convention: setParams(**kwargs) sets several params
-        through the typed converters, raising on unknown names."""
+        through the typed converters, raising on unknown names; an
+        explicit None clears back to the default (the only way typed
+        converters allow returning a nullable param to None)."""
         t = AddConst(inputCol="x", outputCol="y")
         assert t.setParams(value=3, outputCol="z") is t
         assert t.getOrDefault("value") == 3.0  # converter applied
@@ -124,6 +126,12 @@ class TestParams:
             t.setParams(nope=1)
         with pytest.raises(TypeError):
             t.setParams(value="not-a-number")
+        t.setParams(value=None)  # clear → default
+        assert t.getOrDefault("value") == 1.0
+        from sparkdl_tpu.params.tuning import CrossValidator
+        cv = CrossValidator(cacheDir="/tmp/x")
+        cv.setParams(cacheDir=None)
+        assert cv.getOrDefault("cacheDir") is None
 
     def test_explain_params(self):
         t = AddConst(inputCol="x", outputCol="y")
@@ -134,6 +142,11 @@ class TestParams:
         assert "'x'" in t.explainParam(t.inputCol)
         with pytest.raises(AttributeError):
             t.explainParam("nope")
+        # a Param OBJECT from another class raises (pyspark), instead
+        # of silently explaining this instance's same-named param
+        from sparkdl_tpu.estimators import ClassificationEvaluator
+        with pytest.raises(ValueError, match="does not belong"):
+            t.explainParam(ClassificationEvaluator.labelCol)
 
     def test_evaluator_params_override(self):
         """evaluate(dataset, params) scores through a COPY carrying the
