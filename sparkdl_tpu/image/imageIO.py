@@ -321,11 +321,21 @@ def _draftDecodeResize(blob: bytes, height: int, width: int,
         return None
 
 
+def _validate_size(height: int, width: int) -> None:
+    """Positive-dims guard shared by every size-taking entry point:
+    zero dims degenerate to silently-empty tensors (0 is even, and the
+    resize math produces empty outputs instead of failing)."""
+    if height <= 0 or width <= 0:
+        raise ValueError(
+            f"size must be positive, got {height}x{width}")
+
+
 def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
                          ) -> Callable[[pa.RecordBatch], pa.Array]:
     """Batch function resizing the ``image`` column to (height, width) —
     usable with ``DataFrame.with_column``."""
     height, width = int(size[0]), int(size[1])
+    _validate_size(height, width)
 
     def _resize(batch: pa.RecordBatch) -> pa.Array:
         from sparkdl_tpu import native
@@ -361,8 +371,9 @@ def rgbToYuv420(arr: np.ndarray) -> np.ndarray:
         raise ValueError(f"expected HWC RGB uint8, got {arr.shape} "
                          f"{arr.dtype}")
     h, w, _ = arr.shape
-    if h % 2 or w % 2:
-        raise ValueError(f"yuv420 packing needs even dims, got {h}x{w}")
+    if h <= 0 or w <= 0 or h % 2 or w % 2:
+        raise ValueError(
+            f"yuv420 packing needs positive even dims, got {h}x{w}")
     f = arr.astype(np.float32)
     r, g, b = f[..., 0], f[..., 1], f[..., 2]
     y = 0.299 * r + 0.587 * g + 0.114 * b
@@ -541,6 +552,7 @@ def readImagesPacked(imageDirectory: str, size: Tuple[int, int],
     ``draft``.
     """
     height, width = int(size[0]), int(size[1])
+    _validate_size(height, width)
     if packedFormat not in ("rgb", "yuv420"):
         raise ValueError(f"packedFormat must be 'rgb' or 'yuv420', "
                          f"got {packedFormat!r}")

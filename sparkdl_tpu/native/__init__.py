@@ -297,10 +297,11 @@ def decode_resize_pack(blobs: Sequence[bytes], height: int, width: int,
 
 def yuv420_packed_size(height: int, width: int) -> int:
     """Bytes per image of the planar 4:2:0 payload: Y[H*W] ++
-    Cb[H/2*W/2] ++ Cr[H/2*W/2]. H and W must be even."""
-    if height % 2 or width % 2:
+    Cb[H/2*W/2] ++ Cr[H/2*W/2]. H and W must be positive and even."""
+    if height <= 0 or width <= 0 or height % 2 or width % 2:
         raise ValueError(
-            f"yuv420 packing needs even dims, got {height}x{width}")
+            f"yuv420 packing needs positive even dims, got "
+            f"{height}x{width}")
     return height * width + 2 * (height // 2) * (width // 2)
 
 

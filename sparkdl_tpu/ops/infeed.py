@@ -55,6 +55,11 @@ def bilinear_weight_matrix(src: int, dst: int) -> np.ndarray:
     half-pixel convention — the same kernel ``jax.image.resize`` applies
     (support widens by 1/scale when downsampling, so downscales average
     instead of skipping rows)."""
+    if src <= 0 or dst <= 0:
+        # a zero dim degenerates to empty matmuls and empty outputs
+        # downstream instead of an attributable error here
+        raise ValueError(
+            f"resize dims must be positive, got {src} -> {dst}")
     if src == dst:
         return np.eye(dst, dtype=np.float32)
     scale = dst / src
@@ -126,8 +131,11 @@ def yuv420_unpack(x, src_hw: Tuple[int, int]):
     """Split a packed planar 4:2:0 batch [N, H*W*3/2] into
     (y [N,H,W,1], cb [N,H/2,W/2,1], cr [N,H/2,W/2,1]) views."""
     H, W = int(src_hw[0]), int(src_hw[1])
-    if H % 2 or W % 2:
-        raise ValueError(f"yuv420 needs even source dims, got {H}x{W}")
+    if H <= 0 or W <= 0 or H % 2 or W % 2:
+        # 0 is even — guard positivity too, or (0, 0) slips through to
+        # empty planes
+        raise ValueError(
+            f"yuv420 needs positive even source dims, got {H}x{W}")
     n = x.shape[0]
     q = (H // 2) * (W // 2)
     expect = H * W + 2 * q

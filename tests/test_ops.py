@@ -157,6 +157,32 @@ class TestYuv420DeviceOp:
             fused_yuv420_resize_normalize(
                 np.zeros((1, 10), np.uint8), (4, 4), (4, 4))
 
+    def test_nonpositive_dims_rejected(self):
+        """(0, 0) is even — zero/negative dims must raise everywhere
+        instead of silently producing empty tensors (review r5 probe)."""
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.ops import fused_yuv420_resize_normalize
+        from sparkdl_tpu.ops.infeed import bilinear_weight_matrix
+
+        with pytest.raises(ValueError, match="positive"):
+            bilinear_weight_matrix(0, 8)
+        with pytest.raises(ValueError, match="positive"):
+            bilinear_weight_matrix(8, 0)
+        with pytest.raises(ValueError, match="positive"):
+            fused_yuv420_resize_normalize(
+                np.zeros((1, 0), np.uint8), (0, 0), (4, 4))
+        with pytest.raises(ValueError, match="positive"):
+            imageIO.readImagesPacked("/nonexistent", (0, 0))
+        with pytest.raises(ValueError, match="positive"):
+            imageIO.readImagesPacked("/nonexistent", (-4, 8))
+        with pytest.raises(ValueError, match="positive"):
+            imageIO.createResizeImageUDF((0, 8))
+        with pytest.raises(ValueError, match="positive"):
+            imageIO.rgbToYuv420(np.zeros((0, 0, 3), np.uint8))
+        from sparkdl_tpu import native
+        with pytest.raises(ValueError, match="positive"):
+            native.yuv420_packed_size(0, 0)
+
     def test_jittable_and_device_resize_model(self):
         """deviceResizeModel(packedFormat='yuv420') embeds the op in one
         jitted program and reproduces the RGB-input model's output on a
