@@ -456,8 +456,10 @@ class DataFrame:
     def with_column(self, name: str,
                     fn: Callable[[pa.RecordBatch], pa.Array],
                     kind: str = "host") -> "DataFrame":
-        """Append a column computed per batch. ``fn`` may return an Arrow
-        array or a numpy array (auto-converted to a tensor column)."""
+        """Add — or REPLACE, pyspark ``withColumn`` semantics, position
+        preserved — a column computed per batch. ``fn`` may return an
+        Arrow array or a numpy array (auto-converted to a tensor
+        column)."""
         from sparkdl_tpu.data.tensors import append_tensor_column
 
         if not callable(fn):
@@ -470,9 +472,19 @@ class DataFrame:
         def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
             col = fn(batch)
             if isinstance(col, np.ndarray):
-                return append_tensor_column(batch, name, col)
+                return append_tensor_column(batch, name, col,
+                                            replace=True)
             if isinstance(col, pa.ChunkedArray):
                 col = col.combine_chunks()
+            # all-indices: get_field_index reads DUPLICATED names as -1
+            idxs = batch.schema.get_all_field_indices(name)
+            if len(idxs) > 1:
+                raise ValueError(
+                    f"cannot replace column {name!r}: {len(idxs)} "
+                    "columns share that name (e.g. after a join); "
+                    "rename/drop first")
+            if idxs:
+                return batch.set_column(idxs[0], name, col)
             return batch.append_column(name, col)
 
         return self.map_batches(_stage, kind=kind, name=f"with_column({name})")

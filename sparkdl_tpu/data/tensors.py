@@ -57,11 +57,50 @@ def tensor_to_arrow(array: np.ndarray) -> Tuple[pa.Array, bytes]:
 
 
 def append_tensor_column(batch: pa.RecordBatch, name: str,
-                         array: np.ndarray) -> pa.RecordBatch:
-    """Append ndarray [N, *shape] as a tensor column to a record batch."""
+                         array: np.ndarray,
+                         replace: bool = False) -> pa.RecordBatch:
+    """Append ndarray [N, *shape] as a tensor column to a record batch.
+
+    A name collision RAISES by default (Spark ML's "output column
+    already exists" semantics — Arrow happily stores duplicate names,
+    and every by-name lookup would then silently serve the ORIGINAL
+    column, not this output). ``replace=True`` swaps the column
+    in-place instead (pyspark ``withColumn`` semantics — used by
+    ``DataFrame.with_column``)."""
     fsl, meta = tensor_to_arrow(array)
     field = pa.field(name, fsl.type, metadata={TENSOR_SHAPE_KEY: meta})
+    # get_all_field_indices, NOT get_field_index: the latter returns -1
+    # for DUPLICATED names too (post-join batches), which would read as
+    # "absent" and silently append another duplicate
+    idxs = batch.schema.get_all_field_indices(name)
+    if idxs:
+        if not replace:
+            raise ValueError(
+                f"output column {name!r} already exists; choose a "
+                "different output column or drop/rename the existing "
+                "one first")
+        if len(idxs) > 1:
+            raise ValueError(
+                f"cannot replace column {name!r}: {len(idxs)} columns "
+                "share that name (e.g. after a join); rename/drop "
+                "first")
+        return batch.set_column(idxs[0], field, fsl)
     return batch.append_column(field, fsl)
+
+
+def append_unique_column(batch: pa.RecordBatch, field,
+                         col) -> pa.RecordBatch:
+    """``append_column`` with the same Spark-ML collision error as
+    :func:`append_tensor_column` — for plain (non-tensor) output
+    columns. (Joins deliberately bypass this: Spark joins DO produce
+    duplicate names.)"""
+    name = field.name if isinstance(field, pa.Field) else field
+    if batch.schema.get_all_field_indices(name):
+        raise ValueError(
+            f"output column {name!r} already exists; choose a "
+            "different output column or drop/rename the existing one "
+            "first")
+    return batch.append_column(field, col)
 
 
 def tensor_shape_of(field: pa.Field) -> Optional[Tuple[int, ...]]:

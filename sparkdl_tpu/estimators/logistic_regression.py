@@ -84,6 +84,7 @@ class LogisticRegressionModel(Model):
 
         from sparkdl_tpu.data.tensors import (
             append_tensor_column,
+            append_unique_column,
             arrow_to_tensor,
         )
         W, b = self.coefficients, self.intercept
@@ -102,7 +103,8 @@ class LogisticRegressionModel(Model):
             probs = (e / e.sum(-1, keepdims=True)).astype(np.float32)
             batch = append_tensor_column(batch, prob_col, probs)
             labels = probs.argmax(-1).astype(np.float64)
-            return batch.append_column(pred_col, pa.array(labels))
+            return append_unique_column(batch, pred_col,
+                                        pa.array(labels))
 
         return dataset.map_batches(apply, name=f"logreg({feat})")
 

@@ -339,7 +339,8 @@ def createResizeImageUDF(size: Tuple[int, int], nChannels: int = 3
 
     def _resize(batch: pa.RecordBatch) -> pa.Array:
         from sparkdl_tpu import native
-        idx = batch.schema.get_field_index("image")
+        from sparkdl_tpu.data.frame import column_index
+        idx = column_index(batch, "image")  # raises on missing/dup
         structs = batchToStructs(batch.column(idx))
         live = [(i, imageStructToArray(s))
                 for i, s in enumerate(structs) if s is not None]

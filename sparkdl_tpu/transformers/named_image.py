@@ -133,8 +133,12 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
             pa.field("score", pa.float32()),
         ]))
 
+        from sparkdl_tpu.data.tensors import (
+            append_unique_column,
+            arrow_to_tensor,
+        )
+
         def decode_stage(batch: pa.RecordBatch) -> pa.RecordBatch:
-            from sparkdl_tpu.data.tensors import arrow_to_tensor
             idx = batch.schema.get_field_index(raw_col)
             logits = arrow_to_tensor(batch.column(idx),
                                      batch.schema.field(idx))
@@ -143,7 +147,7 @@ class DeepImagePredictor(_HasModelName, HasInputCol, HasOutputCol,
             rows = [[{"class": c, "description": d, "score": s}
                      for (c, d, s) in row] for row in decoded]
             batch = batch.remove_column(idx)
-            return batch.append_column(out_col,
-                                       pa.array(rows, type=pred_type))
+            return append_unique_column(batch, out_col,
+                                        pa.array(rows, type=pred_type))
 
         return result.map_batches(decode_stage, name="decodePredictions")

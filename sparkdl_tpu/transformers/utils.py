@@ -85,11 +85,14 @@ def appendModelOutput(batch: pa.RecordBatch, out_col: str,
     """Append a model's output as either a flat float32 vector column or
     an image struct column — shared tail of ImageTransformer and
     KerasImageFileTransformer."""
-    from sparkdl_tpu.data.tensors import append_tensor_column
+    from sparkdl_tpu.data.tensors import (
+        append_tensor_column,
+        append_unique_column,
+    )
     out = np.asarray(out)
     if mode == "image":
-        return batch.append_column(out_col,
-                                   outputToImageStructs(out, origins))
+        return append_unique_column(batch, out_col,
+                                    outputToImageStructs(out, origins))
     width = int(np.prod(out.shape[1:])) if out.ndim > 1 else 1
     flat = out.reshape(len(out), width).astype(np.float32, copy=False)
     return append_tensor_column(batch, out_col, flat)

@@ -1086,6 +1086,58 @@ class TestOutOfCoreRepartition:
         assert out.count() == 96
 
 
+class TestColumnCollisions:
+    """Arrow happily stores duplicate column names, and every by-name
+    lookup then silently serves the FIRST (stale) one — so name
+    collisions follow pyspark: with_column REPLACES in place
+    (withColumn semantics); transformer/model output columns RAISE
+    (Spark ML's 'output column already exists'); joins keep Spark's
+    duplicate-name behavior."""
+
+    def test_with_column_replaces_in_place(self):
+        df = _df(10, 2).with_column(
+            "x", lambda b: pa.array(np.full(b.num_rows, 7.5)))
+        table = df.collect()
+        assert table.schema.names == ["x", "s"]  # position preserved
+        np.testing.assert_array_equal(table.column("x").to_numpy(), 7.5)
+        # tensor-valued replacement too
+        df2 = _df(6, 2).with_column(
+            "x", lambda b: np.ones((b.num_rows, 2), np.float32))
+        t2 = df2.collect()
+        assert t2.schema.names == ["x", "s"]
+        assert arrow_to_tensor(t2.column("x")).shape == (6, 2)
+
+    def test_transformer_output_collision_raises(self):
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.transformers.tensor_transform import (
+            TensorTransformer,
+        )
+
+        b = pa.RecordBatch.from_pydict({"rid": pa.array([0, 1])})
+        b = append_tensor_column(b, "x", np.ones((2, 3), np.float32))
+        df = DataFrame.from_batches([b])
+        mf = ModelFunction(lambda p, i: {"y": i["x"] * 2}, params={},
+                           input_signature={"x": ((3,), np.float32)},
+                           output_names=["y"])
+        t = TensorTransformer(modelFunction=mf, inputMapping={"x": "x"},
+                              outputMapping={"y": "x"}, batchSize=2)
+        with pytest.raises(ValueError, match="already exists"):
+            t.transform(df).collect()
+
+    def test_lr_output_collision_raises(self):
+        from sparkdl_tpu.estimators import LogisticRegression
+
+        b = pa.RecordBatch.from_pylist(
+            [{"label": 0, "prediction": 9.0},
+             {"label": 1, "prediction": 9.0}])
+        b = append_tensor_column(
+            b, "features", np.eye(2, dtype=np.float32))
+        df = DataFrame.from_batches([b])
+        model = LogisticRegression(maxIter=2).fit(df)
+        with pytest.raises(ValueError, match="already exists"):
+            model.transform(df).collect()
+
+
 class TestCollectSeam:
     def test_on_batch_observes_every_batch(self):
         seen = []
