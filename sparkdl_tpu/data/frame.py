@@ -135,10 +135,19 @@ class _CoalescedGroup:
 def column_index(data, name: str) -> int:
     """Resolve a column name to its index in a RecordBatch/Table/Schema,
     raising KeyError for unknown names (pyarrow's get_field_index
-    returns -1, which would silently negative-index the last column)."""
+    returns -1, which would silently negative-index the last column —
+    and it returns -1 for DUPLICATED names too, so the ambiguous case
+    gets its own message instead of reading as 'missing')."""
     schema = data if isinstance(data, pa.Schema) else data.schema
     idx = schema.get_field_index(name)
     if idx < 0:
+        dups = schema.get_all_field_indices(name)
+        if len(dups) > 1:
+            raise KeyError(
+                f"column {name!r} is ambiguous: {len(dups)} columns "
+                "share that name (e.g. after a join); drop the "
+                "unwanted one by position or avoid the collision "
+                "upstream")
         raise KeyError(
             f"column {name!r} not in batch ({schema.names})")
     return idx
@@ -507,9 +516,28 @@ class DataFrame:
         return self.map_batches(_stage, name=f"drop({','.join(cols)})")
 
     def rename(self, mapping: dict) -> "DataFrame":
+        # Validate EAGERLY (the schema is cached/hinted, and batches all
+        # share it): Spark tolerates the duplicate and errors lazily on
+        # the first ambiguous resolution; our by-name lookups would
+        # serve the FIRST column silently — fail here, where the
+        # mistake is attributable. Only names whose count INCREASES are
+        # the mapping's fault (a frame already carrying duplicates may
+        # still rename its other columns).
+        import collections
+
+        old = list(self.schema.names)
+        before = collections.Counter(old)
+        after = collections.Counter(mapping.get(n, n) for n in old)
+        dup = sorted(n for n, c in after.items()
+                     if c > 1 and c > before[n])
+        if dup:
+            raise ValueError(
+                f"rename would duplicate column name(s) {dup}; drop "
+                "the existing column first")
+
         def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
-            names = [mapping.get(n, n) for n in batch.schema.names]
-            return batch.rename_columns(names)
+            return batch.rename_columns(
+                [mapping.get(n, n) for n in batch.schema.names])
 
         return self.map_batches(_stage, name="rename")
 
@@ -542,6 +570,9 @@ class DataFrame:
         whole frame. The spill persists for the returned frame's
         lifetime; it lives under a unique subdirectory of ``cacheDir``
         and can be reclaimed by deleting it once the frame is done."""
+        if int(num_partitions) <= 0:
+            raise ValueError(  # Spark raises too; clamping hides typos
+                f"num_partitions must be positive, got {num_partitions}")
         if cacheDir is None:
             return DataFrame.from_table(self.collect(), num_partitions,
                                         self._engine)
@@ -639,7 +670,10 @@ class DataFrame:
         ``with_index`` plan stages keep each input partition's own
         logical identity, so deterministic stages like ``sample`` draw
         exactly what they draw un-coalesced."""
-        n_out = max(1, min(int(num_partitions), len(self._sources)))
+        if int(num_partitions) <= 0:
+            raise ValueError(  # Spark raises too; clamping hides typos
+                f"num_partitions must be positive, got {num_partitions}")
+        n_out = min(int(num_partitions), len(self._sources))
         if n_out == len(self._sources):
             return self
         preserving = all(st.row_preserving for st in self._plan)

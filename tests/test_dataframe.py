@@ -1124,6 +1124,40 @@ class TestColumnCollisions:
         with pytest.raises(ValueError, match="already exists"):
             t.transform(df).collect()
 
+    def test_rename_collision_raises(self):
+        # EAGER: the error fires at rename(), not at execution
+        with pytest.raises(ValueError, match="duplicate"):
+            _df(6, 2).rename({"x": "s"})
+
+    def test_rename_tolerates_preexisting_duplicates(self):
+        # only count INCREASES are the mapping's fault: a frame already
+        # carrying duplicate names may rename its OTHER columns
+        b = pa.RecordBatch.from_arrays(
+            [pa.array([1.0]), pa.array([2.0]), pa.array([3.0])],
+            names=["x", "x", "y"])
+        df = DataFrame.from_batches([b])
+        out = df.rename({"y": "z"}).collect()
+        assert out.schema.names == ["x", "x", "z"]
+
+    def test_nonpositive_partition_counts_raise(self):
+        # Spark raises for repartition/coalesce(<=0); clamping hid typos
+        df = _df(10, 2)
+        with pytest.raises(ValueError, match="positive"):
+            df.repartition(0)
+        with pytest.raises(ValueError, match="positive"):
+            df.repartition(-3)
+        with pytest.raises(ValueError, match="positive"):
+            df.coalesce(0)
+
+    def test_ambiguous_column_message(self):
+        # duplicated names read as -1 from get_field_index; the lookup
+        # error must say AMBIGUOUS, not missing
+        from sparkdl_tpu.data.frame import column_index
+        b = pa.RecordBatch.from_arrays(
+            [pa.array([1.0]), pa.array([2.0])], names=["x", "x"])
+        with pytest.raises(KeyError, match="ambiguous"):
+            column_index(b, "x")
+
     def test_lr_output_collision_raises(self):
         from sparkdl_tpu.estimators import LogisticRegression
 
