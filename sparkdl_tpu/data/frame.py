@@ -516,26 +516,39 @@ class DataFrame:
         return self.map_batches(_stage, name=f"drop({','.join(cols)})")
 
     def rename(self, mapping: dict) -> "DataFrame":
-        # Validate EAGERLY (the schema is cached/hinted, and batches all
-        # share it): Spark tolerates the duplicate and errors lazily on
-        # the first ambiguous resolution; our by-name lookups would
-        # serve the FIRST column silently — fail here, where the
-        # mistake is attributable. Only names whose count INCREASES are
-        # the mapping's fault (a frame already carrying duplicates may
-        # still rename its other columns).
+        # Duplicate-creating renames fail LOUDLY (Spark tolerates the
+        # duplicate and errors lazily on the first ambiguous
+        # resolution; our by-name lookups would serve the FIRST column
+        # silently). Only names whose count INCREASES are the mapping's
+        # fault — a frame already carrying duplicates may still rename
+        # its other columns. Validation runs eagerly when the schema is
+        # free (cached, or a leaf schema_hint means the probe loads
+        # nothing); otherwise per batch at execution — computing the
+        # schema here would load a whole partition just to check names.
         import collections
 
-        old = list(self.schema.names)
-        before = collections.Counter(old)
-        after = collections.Counter(mapping.get(n, n) for n in old)
-        dup = sorted(n for n, c in after.items()
-                     if c > 1 and c > before[n])
-        if dup:
-            raise ValueError(
-                f"rename would duplicate column name(s) {dup}; drop "
-                "the existing column first")
+        def _validate(names) -> None:
+            before = collections.Counter(names)
+            after = collections.Counter(mapping.get(n, n)
+                                        for n in names)
+            dup = sorted(n for n, c in after.items()
+                         if c > 1 and c > before[n])
+            if dup:
+                raise ValueError(
+                    f"rename would duplicate column name(s) {dup}; "
+                    "drop the existing column first")
+
+        probe_free = (self._schema is not None or not self._sources
+                      or self._sources[0].schema_hint is not None)
+        if probe_free:
+            _validate(list(self.schema.names))
+            validate_per_batch = None
+        else:
+            validate_per_batch = _validate
 
         def _stage(batch: pa.RecordBatch) -> pa.RecordBatch:
+            if validate_per_batch is not None:
+                validate_per_batch(batch.schema.names)
             return batch.rename_columns(
                 [mapping.get(n, n) for n in batch.schema.names])
 

@@ -29,14 +29,43 @@ def _pred_and_labels(table, predictionCol: str, labelCol: str):
     return preds, labels
 
 
+def _check_finite(arr: np.ndarray, col: str, what: str) -> None:
+    """NaN/Inf in a scored column is a diverged model or a broken
+    upstream transform — scoring them as ordinary values returns
+    plausible-looking garbage (all-NaN predictions measured accuracy
+    0.5 and AUC 0.5, numbers a CV could SELECT on). Refuse loudly.
+    No copies on the hot path: floats are checked in their own dtype;
+    integers are finite by construction; only object arrays (pylist
+    labels) pay a cast."""
+    if not arr.size:
+        return
+    kind = arr.dtype.kind
+    if kind in "iub":
+        return
+    if kind in "fc":
+        ok = bool(np.isfinite(arr).all())
+    else:
+        ok = bool(np.isfinite(np.asarray(arr, dtype=np.float64)).all())
+    if not ok:
+        raise ValueError(
+            f"column {col!r} contains non-finite {what} (NaN/Inf — "
+            "diverged model or broken upstream transform); refusing "
+            "to score them as ordinary values")
+
+
 def _stream_pred_and_labels(dataset, predictionCol: str, labelCol: str):
     """Per-batch (preds, labels) pairs from the partition stream —
     evaluators accumulate sufficient statistics batch-by-batch, so the
     scored table (prediction vectors + every other column) is never
-    held whole in driver memory (VERDICT r3 weak #4)."""
+    held whole in driver memory (VERDICT r3 weak #4). Non-finite
+    values raise per batch (:func:`_check_finite`)."""
     for batch in dataset.stream():
         if batch.num_rows:
-            yield _pred_and_labels(batch, predictionCol, labelCol)
+            preds, labels = _pred_and_labels(batch, predictionCol,
+                                             labelCol)
+            _check_finite(preds, predictionCol, "predictions")
+            _check_finite(labels, labelCol, "labels")
+            yield preds, labels
 
 
 _CLS_METRICS = ("accuracy", "f1", "weightedPrecision", "weightedRecall")
@@ -337,6 +366,8 @@ class BinaryClassificationEvaluator(Evaluator):
                 score_col = self._score_column(batch.schema)
             scores, labels = _pred_and_labels(batch, score_col,
                                               label_col)
+            _check_finite(scores, score_col, "scores")
+            _check_finite(labels, label_col, "labels")
             if scores.ndim > 1:
                 if scores.shape[-1] == 1:
                     scores = scores[..., 0]

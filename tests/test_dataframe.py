@@ -1125,9 +1125,24 @@ class TestColumnCollisions:
             t.transform(df).collect()
 
     def test_rename_collision_raises(self):
-        # EAGER: the error fires at rename(), not at execution
+        # EAGER when the schema is free: the error fires at rename()
         with pytest.raises(ValueError, match="duplicate"):
             _df(6, 2).rename({"x": "s"})
+        # hint-less sources must NOT load a partition at rename() —
+        # validation defers to execution, same error
+        loads = {"n": 0}
+        b = pa.RecordBatch.from_pydict(
+            {"x": pa.array([1.0]), "s": pa.array(["a"])})
+
+        def load():
+            loads["n"] += 1
+            return b
+
+        df = DataFrame([Source(load, 1)])
+        renamed = df.rename({"x": "s"})  # no raise, no load
+        assert loads["n"] == 0
+        with pytest.raises(ValueError, match="duplicate"):
+            renamed.collect()
 
     def test_rename_tolerates_preexisting_duplicates(self):
         # only count INCREASES are the mapping's fault: a frame already

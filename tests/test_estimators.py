@@ -961,6 +961,37 @@ def test_evaluators_raise_on_empty_scored_frame():
             ev.evaluate(empty)
 
 
+def test_evaluators_refuse_non_finite_scores():
+    """NaN predictions measured accuracy 0.5 and AUC 0.5 before the
+    guard — plausible numbers a CV could SELECT on from a diverged
+    model. All three evaluators must refuse NaN/Inf loudly."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.data.tensors import append_tensor_column
+    from sparkdl_tpu.estimators import (
+        BinaryClassificationEvaluator,
+        ClassificationEvaluator,
+        LossEvaluator,
+    )
+
+    rows = [{"label": i % 2, "prediction": float("nan")}
+            for i in range(4)]
+    df = DataFrame.from_batches([pa.RecordBatch.from_pylist(rows)])
+    for ev in (ClassificationEvaluator(predictionCol="prediction"),
+               BinaryClassificationEvaluator(
+                   rawPredictionCol="prediction"),
+               LossEvaluator(predictionCol="prediction")):
+        with pytest.raises(ValueError, match="non-finite"):
+            ev.evaluate(df)
+    # vector predictions too
+    b = pa.RecordBatch.from_pylist([{"label": i % 2} for i in range(4)])
+    b = append_tensor_column(
+        b, "prediction", np.full((4, 2), np.inf, np.float32))
+    df2 = DataFrame.from_batches([b])
+    with pytest.raises(ValueError, match="non-finite"):
+        ClassificationEvaluator(predictionCol="prediction").evaluate(df2)
+
+
 class TestEmptyFoldHandling:
     """review r5: one degenerate CV fold (validation side emptied by
     upstream filters) must not crash the whole search after N-1 folds
