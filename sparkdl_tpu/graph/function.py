@@ -320,7 +320,17 @@ class ModelFunction:
         The result is jittable and composable (it re-traces through the
         exported computation)."""
         from jax import export as jax_export
-        exported = jax_export.deserialize(blob)
+        try:
+            exported = jax_export.deserialize(blob)
+        except Exception as e:
+            # jax surfaces raw flatbuffer unpack errors here ("requires
+            # a buffer of at least 544501618 bytes") — name the actual
+            # problem
+            raise ValueError(
+                f"not a serialized StableHLO export ({len(blob)} "
+                "bytes; produce one with ModelFunction.export / "
+                f"ModelIngest.fromExport): {type(e).__name__}: "
+                f"{str(e)[:120]}") from e
         in_tree = exported.in_tree
         # input signature from the exported avals: one dict arg
         avals = exported.in_avals

@@ -25,6 +25,7 @@ from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from sparkdl_tpu.runtime.runner import (
     MAX_INFLIGHT_BATCHES,
     RunnerMetrics,
+    check_against_signature,
     check_row_counts,
     drain_bounded,
     empty_jax_outputs,
@@ -77,8 +78,9 @@ class ShardedBatchRunner:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]};
         N is cut into global batches, the tail padded then truncated."""
         n = check_row_counts(inputs)
-        if n == 0:
+        if n == 0:  # before the signature check: empty flat inputs
             return empty_jax_outputs(self.model_fn)
+        check_against_signature(inputs, self.model_fn)
 
         # compile + replicate lazily, cached on the ModelFunction so
         # multiple runners over the same model share one program and one

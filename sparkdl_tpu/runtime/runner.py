@@ -127,6 +127,34 @@ def check_row_counts(inputs: Dict[str, np.ndarray]) -> int:
     return n
 
 
+def check_against_signature(inputs: Dict[str, np.ndarray],
+                            model_fn: ModelFunction) -> None:
+    """Every declared model input must be present with the declared
+    per-row shape — checked here, where both names are known, instead
+    of surfacing as a bare KeyError or a flax shape error from deep
+    inside the traced program. Extra keys are tolerated (the model
+    ignores them). Unknowns skip the shape check: None dims, and the
+    empty shape () on HOST-backend models, where ingested TF graphs
+    use it as the unknown-rank sentinel (graph/ingest.py) — on jax
+    models () genuinely means scalar rows and IS enforced."""
+    sig = model_fn.input_signature
+    missing = [k for k in sig if k not in inputs]
+    if missing:
+        raise ValueError(
+            f"model {model_fn.name!r} inputs {missing} missing from "
+            f"runner inputs {sorted(inputs)}")
+    for k, (shape, _dtype) in sig.items():
+        if any(d is None for d in shape):
+            continue
+        if shape == () and model_fn.backend != "jax":
+            continue
+        got = tuple(np.shape(inputs[k])[1:])
+        if got != tuple(shape):
+            raise ValueError(
+                f"input {k!r} rows have shape {got}; model "
+                f"{model_fn.name!r} expects {tuple(shape)}")
+
+
 def iter_padded_chunks(inputs: Dict[str, np.ndarray], n: int,
                        chunk_size: int
                        ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
@@ -259,7 +287,11 @@ class BatchRunner:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]}."""
         n = check_row_counts(inputs)
         if n == 0:
+            # BEFORE the signature check: empty variable-list columns
+            # arrive flat — (0,) — and stages must tolerate empty
+            # batches (the schema-probe contract)
             return self._empty_outputs()
+        check_against_signature(inputs, self.model_fn)
 
         t0 = time.perf_counter()
         if self.model_fn.backend == "host":

@@ -56,6 +56,36 @@ class TestBatchRunner:
             r.run({"a": np.zeros((3, 2), np.float32),
                    "b": np.zeros((4, 2), np.float32)})
 
+    def test_signature_validation_names_both_sides(self):
+        """A missing/mis-shaped input raises HERE with both names —
+        not a bare KeyError or a flax shape error from inside the
+        traced program (review r5 probe)."""
+        r = BatchRunner(_double_fn(), batch_size=4)
+        with pytest.raises(ValueError, match="missing from"):
+            r.run({"wrong": np.zeros((4, 3), np.float32)})
+        with pytest.raises(ValueError, match="expects"):
+            r.run({"input": np.zeros((4, 7), np.float32)})
+        # extra keys are tolerated (the model ignores them)
+        out = r.run({"input": np.ones((2, 3), np.float32),
+                     "extra": np.zeros((2, 1), np.float32)})
+        np.testing.assert_allclose(out["output"], 2.0)
+        # zero-row inputs keep their empty-batch tolerance even when
+        # FLAT (empty variable-list columns arrive as (0,))
+        empty = r.run({"input": np.zeros((0,), np.float32)})
+        assert empty["output"].shape == (0, 3)
+        # jax models with scalar rows () ARE enforced ((4,3) into a
+        # scalar-input model must not sail into an XLA error)
+        scal = BatchRunner(ModelFunction.fromSingle(
+            lambda x: x * 2.0, None, input_shape=()), batch_size=4)
+        with pytest.raises(ValueError, match="expects"):
+            scal.run({"input": np.zeros((4, 3), np.float32)})
+
+    def test_deserialize_garbage_raises_clearly(self):
+        from sparkdl_tpu.graph.ingest import ModelIngest
+
+        with pytest.raises(ValueError, match="StableHLO"):
+            ModelIngest.fromExport(b"definitely not an export")
+
     def test_bad_batch_size(self):
         with pytest.raises(ValueError):
             BatchRunner(_double_fn(), batch_size=0)
