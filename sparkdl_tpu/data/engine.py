@@ -149,9 +149,14 @@ class LocalEngine:
                  retryable_exceptions: Optional[Tuple[type, ...]] = None):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
         # Enough in-flight partitions to keep workers busy while the
-        # consumer drains in order.
-        self._explicit_inflight = max_inflight is not None
-        self.max_inflight = max_inflight or self.num_workers * 2
+        # consumer drains in order. A falsy sentinel (0/None) is NOT an
+        # explicit window: treating 0 as explicit would disable the
+        # adaptive widening while the `or` fallback discarded the 0
+        # itself — the engine would honor a value the caller never got.
+        self._explicit_inflight = (max_inflight is not None
+                                   and max_inflight > 0)
+        self.max_inflight = (max_inflight if self._explicit_inflight
+                             else self.num_workers * 2)
         self.max_retries = max_retries
         # normalize to tuple: `except` rejects lists/sets at failure
         # time (masking the real error); an explicit () means "retry
@@ -283,6 +288,13 @@ class LocalEngine:
         is a one-element mutable window size a downstream re-chunk
         stage may widen once it has seen real partition sizes."""
         box = inflight_box or [self.max_inflight]
+        # Drain in-flight siblings on exit only when the plan has side
+        # effects: a straggler _write_part re-creating write_parquet's
+        # just-swept staging dir AFTER cleanup ran corrupts the
+        # cleanup's outcome. Pure plans cancel-only — take(1)/first()
+        # on a decode-heavy frame must not block for a whole in-flight
+        # wave of partition decodes (review r5).
+        drain = any(getattr(st, "effectful", False) for st in plan)
 
         def _logical(pos: int) -> int:
             logical = getattr(sources[pos], "logical_index", None)
@@ -308,17 +320,17 @@ class LocalEngine:
             finally:
                 for fut in pending.values():
                     fut.cancel()
-                # QUIESCE before returning control: a task that was
-                # already running when a sibling failed can't be
-                # cancelled and would otherwise keep producing side
-                # effects (e.g. re-creating write_parquet's staging
-                # dir) AFTER the caller's cleanup ran
-                for fut in pending.values():
-                    if not fut.cancelled():
-                        try:
-                            fut.result()
-                        except Exception:
-                            pass  # the primary error already propagated
+                if drain:
+                    # QUIESCE before returning control: a running task
+                    # can't be cancelled and would otherwise keep
+                    # producing side effects AFTER the caller's
+                    # cleanup ran
+                    for fut in pending.values():
+                        if not fut.cancelled():
+                            try:
+                                fut.result()
+                            except Exception:
+                                pass  # primary error already propagated
 
         return _gen()
 
@@ -373,21 +385,21 @@ class LocalEngine:
                 i, fut = pending.popleft()
                 yield i, fut.result()
         finally:
-            # same QUIESCE discipline as _execute_indexed: on a stage
-            # error (or the consumer abandoning the generator, e.g.
-            # take(n)), in-flight siblings keep producing side effects —
-            # a _write_part task re-creating write_parquet's just-swept
-            # staging dir AFTER the caller's cleanup ran leaves the next
-            # write permanently refused. Cancel what hasn't started,
-            # then drain what has, BEFORE control returns.
+            # same QUIESCE discipline as _execute_indexed, gated the
+            # same way: only an EFFECTFUL stage (a _write_part task
+            # re-creating write_parquet's just-swept staging dir AFTER
+            # the caller's cleanup ran) needs its in-flight siblings
+            # drained before control returns; pure stages cancel-only
+            # so take(n) stays interactive.
             for _, fut in pending:
                 fut.cancel()
-            for _, fut in pending:
-                if not fut.cancelled():
-                    try:
-                        fut.result()
-                    except Exception:
-                        pass  # the primary error already propagated
+            if getattr(stage, "effectful", False):
+                for _, fut in pending:
+                    if not fut.cancelled():
+                        try:
+                            fut.result()
+                        except Exception:
+                            pass  # the primary error already propagated
 
     def _stream_rechunk(self, stream, stage, inflight_box=None,
                         max_hint=None):

@@ -175,6 +175,13 @@ class Stage:
     row_preserving: bool = True
     with_index: bool = False
     batch_hint: Optional[int] = None
+    # True for stages with externally visible side effects (parquet
+    # part writers): on error/abandonment the engine then DRAINS
+    # in-flight siblings before returning control, so a straggler
+    # can't e.g. re-create a staging dir after cleanup swept it. Pure
+    # plans skip the drain — take(1)/first() must not block for a
+    # full in-flight wave of decodes.
+    effectful: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -417,7 +424,8 @@ class DataFrame:
             entries = []
             for b in self.map_batches(_write_part, name="write_parquet",
                                       row_preserving=False,
-                                      with_index=True).stream():
+                                      with_index=True,
+                                      effectful=True).stream():
                 entries.extend(b.to_pylist())
             if not entries:
                 # all-empty frame: one empty part so the dataset (and
@@ -455,11 +463,12 @@ class DataFrame:
                     kind: str = "host", name: str = "map_batches",
                     row_preserving: bool = True,
                     with_index: bool = False,
-                    batch_hint: Optional[int] = None) -> "DataFrame":
+                    batch_hint: Optional[int] = None,
+                    effectful: bool = False) -> "DataFrame":
         return DataFrame(
             self._sources,
             self._plan + [Stage(fn, kind, name, row_preserving,
-                                with_index, batch_hint)],
+                                with_index, batch_hint, effectful)],
             self._engine)
 
     def with_column(self, name: str,
@@ -538,9 +547,7 @@ class DataFrame:
                     f"rename would duplicate column name(s) {dup}; "
                     "drop the existing column first")
 
-        probe_free = (self._schema is not None or not self._sources
-                      or self._sources[0].schema_hint is not None)
-        if probe_free:
+        if self.schema_probe_free:
             _validate(list(self.schema.names))
             validate_per_batch = None
         else:
@@ -1139,6 +1146,17 @@ class DataFrame:
                          else stage.fn(proto))
             self._schema = proto.schema
         return self._schema
+
+    @property
+    def schema_probe_free(self) -> bool:
+        """Whether reading :attr:`schema` costs no partition load:
+        already cached, or the first source publishes a ``schema_hint``
+        (the probe then runs the plan on an empty prototype only).
+        Free-by-contract callers — ``rename`` validation, sizing
+        estimates — consult this instead of silently decoding a
+        partition at plan time."""
+        return (self._schema is not None or not self._sources
+                or self._sources[0].schema_hint is not None)
 
     @property
     def columns(self) -> List[str]:

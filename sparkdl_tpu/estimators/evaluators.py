@@ -215,6 +215,19 @@ class ClassificationEvaluator(Evaluator):
                 # LogisticRegressionModel's predictionCol)
                 pred_ids = preds.astype(np.int64)
             else:
+                if preds.min() < 0.0 or preds.max() > 1.0:
+                    # non-integral AND outside [0,1]: neither class
+                    # labels nor probabilities — raw scores/margins
+                    # mistakenly wired in; thresholding them at 0.5
+                    # would return a plausible metric (the declared-
+                    # semantics and vector paths both refuse this)
+                    raise ValueError(
+                        f"column "
+                        f"{self.getOrDefault('predictionCol')!r} "
+                        "holds non-integral values outside [0, 1] "
+                        "(raw scores?): neither class labels nor "
+                        "probabilities — point predictionCol at the "
+                        "prediction or probability column")
                 pred_ids = (preds > 0.5).astype(np.int64)
             _accumulate_confusion(conf, pred_ids, labels)
         return _metric_from_confusion(conf, metric)
@@ -571,6 +584,16 @@ class LossEvaluator(Evaluator):
                         "labels, not probabilities; point "
                         "LossEvaluator(predictionCol=...) at the "
                         "probability vector column (e.g. 'probability')")
+            elif len(preds) and preds.max(initial=0.0) > 1.0:
+                # NON-integral values above 1 are raw scores/logits —
+                # as definitively not-probabilities as negatives;
+                # clipping to 1-1e-7 would return a plausible loss
+                # (the vector path's 'raw logits?' guard, scalar twin)
+                raise ValueError(
+                    f"column {pred_col!r} holds values above 1 (raw "
+                    "scores?), not probabilities; point "
+                    "LossEvaluator(predictionCol=...) at the "
+                    "probability vector column (e.g. 'probability')")
                 # All values exactly 0.0/1.0 is ambiguous: binary class
                 # labels (garbage loss) or a fully saturated sigmoid in
                 # float32 (legitimate). Warn instead of crashing a

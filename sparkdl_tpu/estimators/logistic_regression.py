@@ -269,6 +269,11 @@ class LogisticRegression(Estimator, HasLabelCol):
         rows = getattr(dataset, "known_count", lambda: None)()
         if not rows:
             return None
+        if not getattr(dataset, "schema_probe_free", False):
+            # a hint-less leaf would LOAD (decode) partition 0 just to
+            # read the feature width — that is not "for free"; the
+            # mid-collect byte watchdog covers these frames instead
+            return None
         try:
             from sparkdl_tpu.data.frame import column_index
             from sparkdl_tpu.data.tensors import tensor_shape_of

@@ -116,7 +116,7 @@ def _decodeImage(imageData: bytes, origin: str = "") -> Optional[dict]:
 
 
 _JPEG_MAGIC = b"\xff\xd8\xff"
-_warned_fused_fallback = False
+_warned_fused_fallback: set = set()  # (where, exc type) already warned
 _warn_lock = threading.Lock()
 
 
@@ -125,15 +125,17 @@ def _warn_native_fallback_once(e: BaseException, where: str) -> None:
     to the per-row PIL path (a missing shim is not unexpected — those
     calls return None, and the build/load already logged) — but doing
     so silently would hide a real binding bug as a quiet slowdown, so
-    say what happened, once per process. Module-level on purpose: a
+    say what happened, once per process PER (call site, error type):
+    a transient error in one seam must not suppress the warning for a
+    later, different bug in the other. Module-level on purpose: a
     `global` in a shipped closure would hit cloudpickle's
     per-deserialization globals on Spark executors and fire per task;
     this function pickles by reference, so its globals are the real
     module's everywhere."""
-    global _warned_fused_fallback
+    key = (where, type(e).__name__)
     with _warn_lock:
-        fire = not _warned_fused_fallback
-        _warned_fused_fallback = True
+        fire = key not in _warned_fused_fallback
+        _warned_fused_fallback.add(key)
     if fire:
         import logging
         logging.getLogger(__name__).warning(

@@ -177,7 +177,9 @@ class Params:
         raises, as in pyspark — name-resolving it against this instance
         would explain a plausible-but-wrong same-named param."""
         if isinstance(param, Param) \
-                and not any(p is param for p in self.params):
+                and not any(p == param for p in self.params):
+            # == (the class's declared (owner, name) identity), not
+            # `is`: Params round-trip through cloudpickle on executors
             raise ValueError(
                 f"Param {param.name!r} does not belong to "
                 f"{type(self).__name__}")

@@ -1353,7 +1353,7 @@ class TestRechunkChaos:
 
 
 def test_pooled_downstream_quiesces_on_error():
-    """review r5: a failing pooled host stage downstream of a
+    """review r5: a failing pooled EFFECTFUL stage downstream of a
     re-chunked device stage must DRAIN its in-flight siblings before
     the error reaches the caller — a straggler completing after the
     caller's cleanup (write_parquet sweeping its staging dir) corrupts
@@ -1379,7 +1379,7 @@ def test_pooled_downstream_quiesces_on_error():
         return batch
 
     plan = [Stage(lambda b: b, kind="device", name="dev", batch_hint=4),
-            Stage(host_fn, kind="host", name="fx")]
+            Stage(host_fn, kind="host", name="fx", effectful=True)]
     sources = [Source((lambda bb=bb: bb), bb.num_rows)
                for bb in batches]
     with pytest.raises(ValueError, match="boom"):
@@ -1388,6 +1388,53 @@ def test_pooled_downstream_quiesces_on_error():
     t_err = time.perf_counter()
     time.sleep(0.5)  # stragglers would land in this window
     assert all(t <= t_err for t in effects), (effects, t_err)
+
+
+def test_zero_max_inflight_is_not_explicit():
+    """max_inflight=0 is a falsy sentinel, not an explicit window:
+    treating it as explicit disabled the adaptive load-ahead widening
+    while the 0 itself was discarded (review r5 high #5)."""
+    from sparkdl_tpu.data.engine import LocalEngine
+
+    eng = LocalEngine(num_workers=4, max_inflight=0)
+    assert eng.max_inflight == 8  # the default window
+    assert not eng._explicit_inflight
+    explicit = LocalEngine(num_workers=4, max_inflight=3)
+    assert explicit.max_inflight == 3 and explicit._explicit_inflight
+
+
+def test_pure_plan_abandonment_does_not_drain():
+    """The drain is gated on effectful stages: take(1) on a pure
+    decode-heavy plan must return without waiting for the in-flight
+    wave of sibling partitions (review r5 high #2). Structural proof:
+    partition 0 is fast, siblings slow — siblings must still be
+    RUNNING when take returns (with the old unconditional drain, no
+    load ever completes after the return)."""
+    import time
+
+    from sparkdl_tpu.data.engine import LocalEngine
+    from sparkdl_tpu.data.frame import DataFrame, Source
+
+    done = []
+
+    def make_load(lo, seconds):
+        def _load():
+            time.sleep(seconds)
+            done.append(time.perf_counter())
+            return pa.RecordBatch.from_pydict(
+                {"rid": pa.array(np.arange(lo, lo + 2))})
+        return _load
+
+    eng = LocalEngine(num_workers=4, max_inflight=8)
+    sources = [Source(make_load(0, 0.05), 2)] + [
+        Source(make_load(i * 2, 0.6), 2) for i in range(1, 6)]
+    df = DataFrame(sources, engine=eng)
+    rows = df.take(1)
+    t_ret = time.perf_counter()
+    assert len(rows) == 1
+    time.sleep(1.0)  # let the abandoned siblings finish
+    late = [t for t in done if t > t_ret]
+    assert late, "take(1) blocked until every sibling load finished"
 
 
 def test_interrupted_commit_keeps_refusal_evidence(tmp_path,

@@ -675,6 +675,19 @@ class TestEvaluators:
             predictionSemantics="probabilities").evaluate(df)
         assert loss > 0.0  # clipped log(1e-7) terms, finite
 
+    def test_auto_semantics_rejects_raw_scores(self):
+        """review r5 high #1: non-integral scalars OUTSIDE [0,1] are
+        neither labels nor probabilities (raw margins mistakenly wired
+        in) — auto must refuse like the declared and vector paths, for
+        both the classifier and the loss."""
+        df = self._scalar_df([0.3, 2.7, 5.1, 1.4], [0, 1, 1, 0],
+                             parts=2)
+        with pytest.raises(ValueError, match="raw scores"):
+            ClassificationEvaluator(
+                predictionCol="prediction").evaluate(df)
+        with pytest.raises(ValueError, match="raw scores"):
+            LossEvaluator(predictionCol="prediction").evaluate(df)
+
     def test_prediction_semantics_contradiction_raises(self):
         """Values contradicting the declared semantic raise instead of
         silently scoring a mis-wired column."""

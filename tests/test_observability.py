@@ -374,6 +374,14 @@ class TestLogisticRegression:
                                schema_hint=batch.schema)])
         LogisticRegression(maxIter=2).fit(df)
         assert loads["n"] == 1, loads
+        # HINT-LESS sources: the estimate must bail (None) rather than
+        # load partition 0 just to read a column width — still exactly
+        # one load (the collect), with the mid-collect watchdog
+        # covering the budget instead (review r5 high #3)
+        loads["n"] = 0
+        df2 = DataFrame([Source(load, batch.num_rows)])
+        LogisticRegression(maxIter=2).fit(df2)
+        assert loads["n"] == 1, loads
 
     def test_bad_labels_rejected(self):
         import pyarrow as pa
