@@ -124,7 +124,9 @@ class Params:
             return self._paramMap[p]
         if p in self._defaultParamMap:
             return self._defaultParamMap[p]
-        raise KeyError(f"param {p.name} is not set and has no default")
+        raise KeyError(
+            f"param {p.name!r} of {type(self).__name__} is not set "
+            "and has no default")
 
     def set(self, param, value) -> "Params":
         p = self._resolveParam(param)
