@@ -188,12 +188,11 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, HasOutputMode,
         def apply(batch: pa.RecordBatch) -> pa.RecordBatch:
             from sparkdl_tpu.data.tensors import arrow_to_tensor
             idx = column_index(batch, _LOADED_COL)
-            arr = np.asarray(arrow_to_tensor(batch.column(idx),
-                                             batch.schema.field(idx)))
+            arr = arrow_to_tensor(batch.column(idx),
+                                  batch.schema.field(idx))
             shape, dtype = mf.input_signature[in_name]
-            if shape and arr.ndim >= 2 and arr.shape[1:] != tuple(shape):
-                arr = arr.reshape((arr.shape[0],) + tuple(shape))
-            out = runner.run({in_name: arr.astype(dtype, copy=False)})
+            arr = tfr_utils.reshapeLoadedRows(arr, shape, dtype, mf.name)
+            out = runner.run({in_name: arr})
             batch = batch.remove_column(idx)
             return tfr_utils.appendModelOutput(batch, out_col,
                                                out[out_name], mode)

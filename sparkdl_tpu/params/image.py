@@ -43,6 +43,17 @@ class CanLoadImage(Params):
             arrs = [np.asarray(loader(u), dtype=np.float32) for u in uris]
             if not arrs:
                 return np.zeros((0, 1), dtype=np.float32)
+            first = arrs[0].shape
+            bad = next((i for i, a in enumerate(arrs)
+                        if a.shape != first), None)
+            if bad is not None:
+                # np.stack's bare "all input arrays must have the same
+                # shape" names neither the loader nor the row
+                raise ValueError(
+                    f"imageLoader returned differing shapes: row 0 is "
+                    f"{first} but row {bad} ({uris[bad]!r}) is "
+                    f"{arrs[bad].shape}; the loader must produce one "
+                    "fixed shape (resize inside it)")
             return np.stack(arrs)
 
         return dataframe.with_column(out_col, _load)

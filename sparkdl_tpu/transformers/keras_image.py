@@ -66,10 +66,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
             arr = arrow_to_tensor(batch.column(idx),
                                   batch.schema.field(idx))
             shape, dtype = mf.input_signature[in_name]
-            arr = np.asarray(arr)
-            if shape and arr.ndim >= 2 and arr.shape[1:] != tuple(shape):
-                arr = arr.reshape((arr.shape[0],) + tuple(shape))
-            out = runner.run({in_name: arr.astype(dtype, copy=False)})
+            arr = tfr_utils.reshapeLoadedRows(arr, shape, dtype, mf.name)
+            out = runner.run({in_name: arr})
             out = out[out_name]
             batch = batch.remove_column(idx)
             return tfr_utils.appendModelOutput(batch, out_col, out, mode)

@@ -85,7 +85,7 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
 
     def _transform(self, dataset):
         mf, in_map, out_map, hparams = self._validate()
-        from sparkdl_tpu.transformers.utils import make_runner
+        from sparkdl_tpu.transformers.utils import make_runner, reshapeRows
         runner = make_runner(mf, self.getBatchSize(),
                              use_mesh=self.getUseMesh(),
                              metrics=self.metrics)
@@ -98,34 +98,24 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
                 arr = arrow_to_tensor(batch.column(idx),
                                       batch.schema.field(idx))
                 shape, dtype = sig[input_name]
-                arr = np.asarray(arr)
-                static = shape and all(d is not None for d in shape)
-                if static and arr.shape[1:] != tuple(shape):
-                    expect = int(np.prod(shape))
-                    got = int(np.prod(arr.shape[1:], dtype=np.int64))
-                    # zero-ROW chunks arrive as flat (0,) arrays whose
-                    # reshape to (0, *shape) is legal — exempt those,
-                    # but not N>0 rows of empty payloads (shape (N, 0)
-                    # from a list column of empty lists), which must
-                    # get the diagnostic too
-                    if got != expect and arr.shape[0] > 0:
-                        # a bare reshape error here reads as numpy
-                        # noise; the actual mistake is a frame whose
-                        # payload doesn't match the model — most often
-                        # a reader size/packedFormat that disagrees
-                        # with deviceResizeModel's
-                        raise ValueError(
-                            f"column {col!r} rows carry {got} "
-                            f"elements (row shape {arr.shape[1:]}) "
-                            f"but model input {input_name!r} expects "
-                            f"shape {tuple(shape)} ({expect} "
-                            "elements). The frame's payload does not "
-                            "match this ModelFunction — check the "
-                            "reader's size/packedFormat against the "
-                            "model's (deviceResizeModel and "
-                            "readImagesPacked must agree on both)")
-                    arr = arr.reshape((arr.shape[0],) + tuple(shape))
-                inputs[input_name] = arr.astype(dtype, copy=False)
+                # shared seam guard (transformers.utils.reshapeRows):
+                # a bare reshape error here reads as numpy noise; the
+                # actual mistake is a frame whose payload doesn't
+                # match the model — most often a reader
+                # size/packedFormat that disagrees with
+                # deviceResizeModel's
+                inputs[input_name] = reshapeRows(
+                    arr, shape, dtype,
+                    lambda row_shape, got, expect, col=col,
+                    input_name=input_name, shape=shape: (
+                        f"column {col!r} rows carry {got} elements "
+                        f"(row shape {row_shape}) but model input "
+                        f"{input_name!r} expects shape {tuple(shape)} "
+                        f"({expect} elements). The frame's payload "
+                        "does not match this ModelFunction — check "
+                        "the reader's size/packedFormat against the "
+                        "model's (deviceResizeModel and "
+                        "readImagesPacked must agree on both)"))
             for input_name, value in hparams.items():
                 # a hyperparameter constant rides along as a
                 # row-broadcast input so the jitted program stays a

@@ -98,6 +98,40 @@ def appendModelOutput(batch: pa.RecordBatch, out_col: str,
     return append_tensor_column(batch, out_col, flat)
 
 
+def reshapeRows(arr, shape, dtype, describe_mismatch) -> np.ndarray:
+    """``[N, *row_shape]`` → ``[N, *shape]`` + dtype cast, with an
+    ATTRIBUTABLE error on element-count mismatch — the bare numpy
+    reshape error ("cannot reshape array of size 150 into shape
+    (2,8,8,3)") names neither side. ONE implementation for every
+    payload→model seam (TensorTransformer columns, Keras imageLoader
+    rows) so the guards can't drift: dynamic (None) dims skip the
+    reshape entirely; zero-ROW chunks reshape legally (flat (0,)
+    arrays → (0, *shape)) while N>0 rows of wrong-count payloads get
+    ``describe_mismatch(row_shape, got, expect) -> str``."""
+    arr = np.asarray(arr)
+    static = shape and all(d is not None for d in shape)
+    if static and arr.shape[1:] != tuple(shape):
+        expect = int(np.prod(shape))
+        got = int(np.prod(arr.shape[1:], dtype=np.int64))
+        if got != expect and arr.shape[0] > 0:
+            raise ValueError(describe_mismatch(arr.shape[1:], got,
+                                               expect))
+        arr = arr.reshape((arr.shape[0],) + tuple(shape))
+    return arr.astype(dtype, copy=False)
+
+
+def reshapeLoadedRows(arr, shape, dtype, model_name: str) -> np.ndarray:
+    """:func:`reshapeRows` with the imageLoader-seam message (Keras
+    image transformer + estimator model)."""
+    return reshapeRows(
+        arr, shape, dtype,
+        lambda row_shape, got, expect: (
+            f"imageLoader rows carry shape {row_shape} ({got} "
+            f"elements) but model {model_name!r} expects input shape "
+            f"{tuple(shape)} ({expect} elements); make the loader "
+            "emit the model's input size"))
+
+
 def make_runner(model_fn, batch_size: int, use_mesh: bool = False,
                 metrics=None):
     """Select the batch runner: ``ShardedBatchRunner`` over this host's

@@ -352,6 +352,32 @@ class TestKerasTransformers:
                              verbose=0)
         np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
+    def test_loader_mistakes_raise_attributably(self, tmp_path):
+        """A loader emitting the wrong size (or ragged sizes) must
+        raise errors naming the LOADER and the model — not numpy's
+        bare reshape/stack messages (review r5 probe)."""
+        import keras
+
+        m = keras.Sequential([keras.layers.Input((8, 8, 3)),
+                              keras.layers.Flatten(),
+                              keras.layers.Dense(2)])
+        mpath = str(tmp_path / "m.keras")
+        m.save(mpath)
+        df = DataFrame.from_table(pa.table({"uri": ["a", "b"]}), 1)
+
+        wrong = KerasImageFileTransformer(
+            inputCol="uri", outputCol="o", modelFile=mpath,
+            imageLoader=lambda u: np.zeros((5, 5, 3), np.float32))
+        with pytest.raises(ValueError, match="imageLoader.*expects"):
+            wrong.transform(df).collect()
+
+        shapes = {"a": (8, 8, 3), "b": (6, 6, 3)}
+        ragged = KerasImageFileTransformer(
+            inputCol="uri", outputCol="o", modelFile=mpath,
+            imageLoader=lambda u: np.zeros(shapes[u], np.float32))
+        with pytest.raises(ValueError, match="differing shapes"):
+            ragged.transform(df).collect()
+
 
 class TestTensorTransformerMultiIO:
     def test_multi_input_multi_output(self):
