@@ -116,12 +116,10 @@ class TestExoticModes:
         pal = self._bytes(Image.fromarray(
             rng.integers(0, 255, (16, 16), dtype=np.uint8), "L")
             .convert("P"), "PNG")
-        # frombuffer, not fromarray(mode=): the 'mode' data-type
-        # override is deprecated for removal in Pillow 13
-        i16_arr = rng.integers(0, 60000, (12, 14), dtype=np.uint16)
-        i16 = self._bytes(Image.frombuffer(
-            "I;16", (14, 12), np.ascontiguousarray(i16_arr).tobytes(),
-            "raw", "I;16", 0, 1), "PNG")
+        # no mode override (deprecated for removal in Pillow 13):
+        # fromarray's uint16 typemap already yields I;16
+        i16 = self._bytes(Image.fromarray(
+            rng.integers(0, 60000, (12, 14), dtype=np.uint16)), "PNG")
 
         structs = imageIO._decodeBatch(
             ["cmyk", "pal", "i16"], [cmyk, pal, i16])

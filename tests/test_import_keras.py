@@ -14,6 +14,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+# keras warns when predict() gets a bare array instead of its named
+# input structure — the standard calling convention for single-input
+# models; pure noise in the oracle comparisons
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:The structure of `inputs` doesn't match")
+
 from sparkdl_tpu.models.import_keras import (
     import_keras_weights,
     import_named_model,
