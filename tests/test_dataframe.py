@@ -1390,6 +1390,55 @@ def test_pooled_downstream_quiesces_on_error():
     assert all(t <= t_err for t in effects), (effects, t_err)
 
 
+def test_concurrent_transforms_of_one_frame():
+    """Spark delegated concurrent-job safety to its scheduler; here the
+    engine owns it: several threads transforming the SAME frame through
+    the SAME ModelFunction (shared jit cache, shared device lock,
+    per-call re-chunk bookkeeping) must all get exact, order-preserved
+    results."""
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.transformers.tensor_transform import (
+        TensorTransformer,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    b = pa.RecordBatch.from_pydict({"rid": pa.array(np.arange(200))})
+    b = append_tensor_column(b, "x", X)
+    df = DataFrame.from_table(pa.Table.from_batches([b]), 8)
+    mf = ModelFunction(lambda p, i: {"y": i["x"] * 3.0}, params={},
+                       input_signature={"x": ((4,), np.float32)},
+                       output_names=["y"])
+    t = TensorTransformer(modelFunction=mf, inputMapping={"x": "x"},
+                          outputMapping={"y": "y"}, batchSize=16)
+    results: dict = {}
+    errors: list = []
+    # barrier: without it the millisecond transforms can run serially
+    # and the test would pass without ever overlapping
+    gate = threading.Barrier(4)
+
+    def work(i):
+        try:
+            gate.wait(timeout=10)
+            out = t.transform(df).collect()
+            results[i] = (np.asarray(out.column("rid").to_pylist()),
+                          arrow_to_tensor(out.column("y")))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    assert len(results) == 4
+    for rid, y in results.values():
+        np.testing.assert_array_equal(rid, np.arange(200))
+        np.testing.assert_allclose(y, X * 3.0, atol=1e-6)
+
+
 def test_zero_max_inflight_is_not_explicit():
     """max_inflight=0 is a falsy sentinel, not an explicit window:
     treating it as explicit disabled the adaptive load-ahead widening
