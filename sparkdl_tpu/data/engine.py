@@ -288,13 +288,19 @@ class LocalEngine:
         is a one-element mutable window size a downstream re-chunk
         stage may widen once it has seen real partition sizes."""
         box = inflight_box or [self.max_inflight]
-        # Drain in-flight siblings on exit only when the plan has side
-        # effects: a straggler _write_part re-creating write_parquet's
-        # just-swept staging dir AFTER cleanup ran corrupts the
-        # cleanup's outcome. Pure plans cancel-only — take(1)/first()
-        # on a decode-heavy frame must not block for a whole in-flight
-        # wave of partition decodes (review r5).
-        drain = any(getattr(st, "effectful", False) for st in plan)
+        # Drain in-flight siblings on exit only when the plan OR a
+        # source has side effects: a straggler _write_part re-creating
+        # write_parquet's just-swept staging dir AFTER cleanup ran
+        # corrupts the cleanup's outcome — and cache_to_disk spill
+        # sources write IPC files inside Source.load, so a straggler
+        # LOAD can equally re-create spill files after the
+        # tuning-cleanup rmtree (ADVICE r5). Pure plans over pure
+        # sources cancel-only — take(1)/first() on a decode-heavy
+        # frame must not block for a whole in-flight wave of partition
+        # decodes (review r5).
+        drain = (any(getattr(st, "effectful", False) for st in plan)
+                 or any(getattr(src, "effectful", False)
+                        for src in sources))
 
         def _logical(pos: int) -> int:
             logical = getattr(sources[pos], "logical_index", None)
@@ -415,17 +421,35 @@ class LocalEngine:
         out_rows = 0
         segs: collections.deque = collections.deque()  # (idx, nrows, out)
 
-        def run_rows(n: int):
+        def run_rows(total: int):
+            # Cut at fragment boundaries that land on hint multiples: a
+            # whole fragment that is itself a hint multiple dispatches
+            # AS-IS — its Arrow buffers reach the device stage as
+            # zero-copy views (the runner stages nothing for aligned
+            # contiguous blocks), where folding it into one greedy
+            # concat with its neighbors would re-copy every row. Only
+            # misaligned spans concatenate; they still dispatch
+            # greedily so the runner's internal async chunk pipelining
+            # is preserved.
             nonlocal in_rows, out_rows
-            chunk = _take_rows(in_frags, n)
-            in_rows -= n
-            out = self._apply_stream_stage(stage, chunk, -1)
-            if out.num_rows != chunk.num_rows:
-                raise RuntimeError(
-                    f"stage {stage.name!r} declared row_preserving but "
-                    f"returned {out.num_rows} rows for {chunk.num_rows}")
-            out_frags.append(out)
-            out_rows += out.num_rows
+            while total:
+                head = in_frags[0]
+                if 0 < head.num_rows <= total \
+                        and head.num_rows % hint == 0:
+                    n = head.num_rows
+                else:
+                    n = total
+                chunk = _take_rows(in_frags, n)
+                in_rows -= n
+                total -= n
+                out = self._apply_stream_stage(stage, chunk, -1)
+                if out.num_rows != chunk.num_rows:
+                    raise RuntimeError(
+                        f"stage {stage.name!r} declared row_preserving "
+                        f"but returned {out.num_rows} rows for "
+                        f"{chunk.num_rows}")
+                out_frags.append(out)
+                out_rows += out.num_rows
 
         def ready():
             nonlocal out_rows

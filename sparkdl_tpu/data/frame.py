@@ -197,11 +197,18 @@ class Source:
     ``DataFrame.schema`` probe the plan on an empty prototype without
     materializing the first partition (decoding a whole image partition
     to answer ``.columns`` is the trap; only leaf constructors whose
-    schema is statically known set it)."""
+    schema is statically known set it).
+    ``effectful`` marks a ``load`` with externally visible side effects
+    (cache_to_disk spill sources write Arrow IPC files inside load):
+    the engine then QUIESCES in-flight sibling loads before returning
+    control on error/abandonment, so a straggler load can't e.g.
+    re-create spill files after the owner's cleanup rmtree ran — the
+    Source twin of ``Stage.effectful``."""
     load: Callable[[], pa.RecordBatch]
     num_rows: Optional[int] = None
     logical_index: Optional[int] = None
     schema_hint: Optional[pa.Schema] = None
+    effectful: bool = False
 
 
 def _empty_batch(schema: pa.Schema) -> pa.RecordBatch:
@@ -1093,9 +1100,13 @@ class DataFrame:
                 os.replace(tmp, path)
                 return batch
 
+            # effectful: the first load WRITES the spill file — the
+            # engine must drain straggler loads on error so none can
+            # re-create a file after the tuning cleanup's rmtree
             return Source(_load,
                           src.num_rows if preserving else None,
-                          logical_index=src.logical_index)
+                          logical_index=src.logical_index,
+                          effectful=True)
 
         out = DataFrame([make(i, s) for i, s in enumerate(self._sources)],
                         engine=self._engine)

@@ -118,9 +118,19 @@ def arrow_to_tensor(column, field: Optional[pa.Field] = None) -> np.ndarray:
 
     Accepts FixedSizeList (tensor), variable List (ragged rows must agree
     in length), or plain numeric columns (→ [N]).
+
+    The FixedSizeList path is ZERO-COPY for single-chunk null-free
+    columns: the returned ndarray is a (read-only) view over the Arrow
+    values buffer — exactly what the batch runners' copy-minimal chunk
+    path consumes, so an engine-aligned block flows from Arrow to the
+    device transfer with no host-side staging copy at all. Multi-chunk
+    columns pay one consolidating copy (combine_chunks).
     """
     if isinstance(column, pa.ChunkedArray):
-        column = column.combine_chunks()
+        # single-chunk fast path: unwrap without the combine machinery
+        # so the zero-copy view below is taken from the original buffer
+        column = (column.chunk(0) if column.num_chunks == 1
+                  else column.combine_chunks())
     typ = column.type
     if pa.types.is_fixed_size_list(typ):
         size = typ.list_size

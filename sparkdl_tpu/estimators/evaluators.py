@@ -584,6 +584,18 @@ class LossEvaluator(Evaluator):
                         "labels, not probabilities; point "
                         "LossEvaluator(predictionCol=...) at the "
                         "probability vector column (e.g. 'probability')")
+                # All values exactly 0.0/1.0 is ambiguous: binary class
+                # labels (garbage loss) or a fully saturated sigmoid in
+                # float32 (legitimate). Warn instead of crashing a
+                # scoring loop. (ADVICE r5: this block previously sat
+                # unreachable after the raw-scores raise below.)
+                import logging
+                logging.getLogger(__name__).warning(
+                    "LossEvaluator: column %r contains only exact "
+                    "0.0/1.0 values — if these are class labels rather "
+                    "than saturated probabilities, this loss is "
+                    "meaningless; point predictionCol at the "
+                    "probability column", pred_col)
             elif len(preds) and preds.max(initial=0.0) > 1.0:
                 # NON-integral values above 1 are raw scores/logits —
                 # as definitively not-probabilities as negatives;
@@ -594,17 +606,6 @@ class LossEvaluator(Evaluator):
                     "scores?), not probabilities; point "
                     "LossEvaluator(predictionCol=...) at the "
                     "probability vector column (e.g. 'probability')")
-                # All values exactly 0.0/1.0 is ambiguous: binary class
-                # labels (garbage loss) or a fully saturated sigmoid in
-                # float32 (legitimate). Warn instead of crashing a
-                # scoring loop.
-                import logging
-                logging.getLogger(__name__).warning(
-                    "LossEvaluator: column %r contains only exact "
-                    "0.0/1.0 values — if these are class labels rather "
-                    "than saturated probabilities, this loss is "
-                    "meaningless; point predictionCol at the "
-                    "probability column", pred_col)
             batch_total, batch_n = _binary_scalar_loss(preds, labels)
             total += batch_total
             n += batch_n

@@ -12,9 +12,9 @@ host→device transfer of batch *i+1* overlaps device compute of batch
 
 from __future__ import annotations
 
-import collections
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -23,14 +23,16 @@ from jax.sharding import Mesh
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from sparkdl_tpu.runtime.runner import (
-    MAX_INFLIGHT_BATCHES,
+    CopyCounters,
+    PadStaging,
     RunnerMetrics,
+    SlabSink,
     check_against_signature,
     check_row_counts,
-    drain_bounded,
+    checkout_staging,
+    dispatch_chunks,
     empty_jax_outputs,
     iter_padded_chunks,
-    start_host_copies,
 )
 
 
@@ -66,6 +68,10 @@ class ShardedBatchRunner:
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
+        # persistent pad staging (BatchRunner's checkout discipline):
+        # concurrent run() calls fall back to a throwaway stager
+        self._staging = PadStaging()
+        self._staging_lock = threading.Lock()
 
     @property
     def preferred_chunk(self) -> int:
@@ -92,33 +98,38 @@ class ShardedBatchRunner:
         # a multi-process runtime refuses numpy for non-trivially
         # sharded args even on an all-local mesh — place each chunk
         # explicitly there (all this mesh's devices are addressable, so
-        # the device_put is purely local).
+        # the device_put is purely local). The prefetch strategy always
+        # places with the data sharding: an unsharded device_put would
+        # commit the chunk to one device and force an on-device reshard
+        # at dispatch.
         place = None
-        if jax.process_count() > 1:
+        dat = None
+        place_required = jax.process_count() > 1
+        if place_required or self.strategy == "prefetch":
             from sparkdl_tpu.parallel.mesh import data_sharding
             dat = data_sharding(self.mesh)
+        if place_required:
             place = lambda c: {k: jax.device_put(v, dat)  # noqa: E731
                                for k, v in c.items()}
 
         t0 = time.perf_counter()
-        gb = self._global_batch
-        host_async = self.strategy == "host_async"
-        limit = self.max_inflight
-        pending: collections.deque = collections.deque()
-        outs: Dict[str, List[np.ndarray]] = {}
-        batches = 0
-        for valid, chunk in iter_padded_chunks(inputs, n, gb):
-            if place is not None:
-                chunk = place(chunk)
-            res = fn(params, chunk)
-            if host_async and not start_host_copies(res):
-                # missing API: shallow queue, like BatchRunner
-                host_async = False
-                limit = min(limit, MAX_INFLIGHT_BATCHES)
-            pending.append((valid, res))
-            batches += 1
-            drain_bounded(pending, outs, limit)
-        drain_bounded(pending, outs, 0)
-        out = {k: np.concatenate(v) for k, v in outs.items()}
-        self.metrics.add(n, batches, time.perf_counter() - t0)
-        return out
+        sink = SlabSink(n)
+        counters = CopyCounters()
+        staging, locked = checkout_staging(self._staging,
+                                           self._staging_lock)
+        try:
+            chunks = iter_padded_chunks(inputs, n, self._global_batch,
+                                        staging, counters)
+            # the shared dispatch state machine (runtime/runner.py),
+            # with the mesh's data sharding for prefetched chunks
+            batches = dispatch_chunks(fn, params, chunks, self.strategy,
+                                      self.max_inflight, sink,
+                                      place=place, sharding=dat)
+        finally:
+            if locked:
+                self._staging_lock.release()
+        self.metrics.add(n, batches, time.perf_counter() - t0,
+                         bytes_staged=counters.bytes_staged,
+                         bytes_copied=counters.bytes_copied,
+                         transfer_wait_seconds=sink.transfer_wait)
+        return sink.result()

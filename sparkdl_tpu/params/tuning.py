@@ -101,7 +101,17 @@ class CrossValidatorModel(Model):
     """``subModels`` (``collectSubModels=True`` only, else None) is
     ``[fold][candidate] -> Model`` — pyspark 2.3's layout. In-memory
     only: like pyspark, sub-models are a debugging/inspection aid and
-    are NOT persisted by ``save`` (only ``bestModel`` round-trips)."""
+    are NOT persisted by ``save`` (only ``bestModel`` round-trips).
+
+    ``avgMetrics[i]`` is candidate *i*'s mean metric over the COMMON
+    fold subset: a fold whose validation side scored 0 rows for ANY
+    candidate (``EmptyScoredFrameError`` — e.g. a candidate transform
+    that filters the fold empty) is excluded from EVERY candidate's
+    average, so candidates are always compared on the same folds — a
+    candidate must never win merely because it skipped a hard fold the
+    others were scored on. Values are therefore always finite: a fit
+    where no common fold survives raises instead of returning NaN
+    averages."""
 
     def __init__(self, bestModel: Model, avgMetrics: List[float],
                  subModels: Optional[List[List[Model]]] = None):
@@ -207,21 +217,34 @@ class CrossValidator(Estimator):
                         logging.getLogger(__name__).warning(
                             "fold %d scored 0 rows for candidate %d "
                             "(validation side empty after upstream "
-                            "filters); excluding the fold from that "
-                            "candidate's average", fold, idx)
-            counts = np.sum(~np.isnan(scores), axis=1)
-            if not counts.any():
+                            "filters); the fold will be excluded from "
+                            "EVERY candidate's average so candidates "
+                            "stay comparable", fold, idx)
+            # Candidates must be compared on the SAME fold subset: a
+            # fold any candidate nan-skipped is excluded from EVERY
+            # candidate's average (per-candidate nanmeans would let a
+            # candidate win merely by skipping a hard fold the others
+            # were scored on — ADVICE r5).
+            fold_ok = ~np.isnan(scores).any(axis=0)
+            if not fold_ok.any():
                 raise ValueError(
-                    f"every fold's validation side scored 0 rows "
-                    f"across all {len(maps)} candidates — the dataset "
-                    "is too small for numFolds or an upstream filter "
-                    "drops everything")
-            metrics = np.where(
-                counts > 0,
-                np.nansum(scores, axis=1) / np.maximum(counts, 1),
-                np.nan)
-            best = int(np.nanargmax(metrics) if ev.isLargerBetter()
-                       else np.nanargmin(metrics))
+                    f"no fold was scored by every candidate "
+                    f"(fold validation sides scored 0 rows for "
+                    f"{int(np.isnan(scores).any(axis=0).sum())} of "
+                    f"{nfolds} folds across {len(maps)} candidates) — "
+                    "the dataset is too small for numFolds or an "
+                    "upstream/candidate filter drops everything")
+            if not fold_ok.all():
+                logging.getLogger(__name__).warning(
+                    "excluding fold(s) %s from every candidate's "
+                    "average (some candidate scored 0 validation rows "
+                    "there); candidates are compared on the common "
+                    "%d-fold subset",
+                    [int(f) for f in np.nonzero(~fold_ok)[0]],
+                    int(fold_ok.sum()))
+            metrics = scores[:, fold_ok].mean(axis=1)
+            best = int(np.argmax(metrics) if ev.isLargerBetter()
+                       else np.argmin(metrics))
             bestModel = est.fit(dataset, maps[best])
         finally:
             cleanup()

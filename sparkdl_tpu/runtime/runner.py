@@ -25,10 +25,36 @@ Transfer strategy (measured, not asserted — tools/measure_transfer.py):
 * ``immediate`` — drain each chunk's result synchronously as soon as it
   is enqueued. The conservative fallback: no queue, flat memory, never
   pathological.
+* ``prefetch`` — everything ``host_async`` does PLUS a depth-1 input
+  prefetch: chunk *i+1* is ``jax.device_put`` while chunk *i* computes,
+  so the jitted call consumes an already-resident buffer instead of
+  transferring at dispatch time. Degrades to plain ``host_async``
+  dispatch (once, with a warning) on backends whose ``device_put``
+  cannot place ahead of dispatch — the same probe-and-degrade
+  discipline as ``start_host_copies``.
 
 Auto-selection keys off the tunnel's environment marker; override with
-``SPARKDL_TPU_RUNNER_STRATEGY=immediate|deferred|host_async`` or the
-``strategy`` ctor arg.
+``SPARKDL_TPU_RUNNER_STRATEGY=immediate|deferred|host_async|prefetch``
+or the ``strategy`` ctor arg.
+
+Copy discipline (BENCH r05: the pipeline is link-bound and on a 1-core
+host every ship-side byte the host copies comes straight out of
+pipeline throughput):
+
+* outputs land in ONE preallocated ``[N, *out_shape]`` slab per name —
+  each drained batch writes its row range in place, so there is no
+  per-batch list append and no final full-output ``np.concatenate``
+  (which re-copied the entire output after the last batch, serialized
+  behind all device work).
+* inputs chunk as plain views when the leading-dim slice is already
+  contiguous (no per-chunk ``ascontiguousarray`` copy); only the padded
+  tail — and non-contiguous rows — are staged, through ONE persistent
+  per-runner buffer reused across calls instead of a fresh
+  ``np.concatenate`` allocation per tail.
+* :class:`RunnerMetrics` counts ``bytes_staged`` / ``bytes_copied`` /
+  ``transfer_wait_seconds`` so the bench proves the copies went away
+  rather than asserting it. Batch-aligned contiguous device runs
+  report BOTH byte counters as exactly 0.
 
 Host-backend ModelFunctions (ingested TF SavedModels — see
 ``graph/ingest.py``) run synchronously on CPU, unpadded, exactly where
@@ -59,10 +85,11 @@ from sparkdl_tpu.graph.function import ModelFunction
 MAX_INFLIGHT_BATCHES = 2
 # host_async keeps a deeper queue: its entries' device→host copies are
 # already in flight, so draining old entries is cheap, and more overlap
-# helps on high-latency links (the strategy's whole point).
+# helps on high-latency links (the strategy's whole point). prefetch is
+# host_async plus input-side overlap and shares the depth.
 MAX_INFLIGHT_HOST_ASYNC = 8
 
-_STRATEGIES = ("immediate", "deferred", "host_async")
+_STRATEGIES = ("immediate", "deferred", "host_async", "prefetch")
 
 
 def _default_strategy() -> str:
@@ -111,7 +138,8 @@ def resolve_strategy(strategy: Optional[str],
         return strategy, 0
     if max_inflight is not None:
         return strategy, max_inflight
-    return strategy, (MAX_INFLIGHT_HOST_ASYNC if strategy == "host_async"
+    return strategy, (MAX_INFLIGHT_HOST_ASYNC
+                      if strategy in ("host_async", "prefetch")
                       else MAX_INFLIGHT_BATCHES)
 
 
@@ -155,33 +183,201 @@ def check_against_signature(inputs: Dict[str, np.ndarray],
                 f"{model_fn.name!r} expects {tuple(shape)}")
 
 
+class PadStaging:
+    """Persistent per-runner staging buffers for the padded tail chunk.
+
+    The tail is the only chunk that cannot ship as a plain view (XLA
+    needs the static chunk shape); it is written into ONE buffer per
+    input name, reused across ``run()`` calls, replacing the fresh
+    ``np.concatenate`` allocation every tail previously paid. Reuse is
+    safe because a runner drains every pending result before ``run()``
+    returns, and the tail is staged at most once per call — the buffer
+    is never rewritten while a batch that may alias it (CPU backends
+    zero-copy numpy inputs) is still in flight. Byte counters
+    accumulate per call into :class:`CopyCounters` so
+    :class:`RunnerMetrics` can prove what was and wasn't copied.
+    """
+
+    def __init__(self):
+        self._bufs: Dict[str, np.ndarray] = {}
+
+    def stage(self, name: str, rows: np.ndarray, chunk_size: int,
+              counters: Optional["CopyCounters"] = None) -> np.ndarray:
+        """Copy ``rows`` into the persistent ``[chunk_size, *row]``
+        buffer for ``name``, zero the pad region, return the buffer."""
+        shape = (chunk_size,) + rows.shape[1:]
+        buf = self._bufs.get(name)
+        if buf is None or buf.shape != shape or buf.dtype != rows.dtype:
+            buf = np.zeros(shape, rows.dtype)
+            self._bufs[name] = buf
+        valid = len(rows)
+        buf[:valid] = rows
+        # the buffer is reused: rows beyond this call's valid count may
+        # hold a previous tail's data and must be re-zeroed
+        if valid < chunk_size:
+            buf[valid:] = 0
+        if counters is not None:
+            counters.bytes_staged += rows.nbytes
+            if not rows.flags.c_contiguous:
+                counters.bytes_copied += rows.nbytes
+        return buf
+
+
+@dataclass
+class CopyCounters:
+    """Per-call host-copy accounting, folded into RunnerMetrics.
+
+    ``bytes_staged``: tail-chunk rows written through the persistent
+    pad-staging buffer (zero when N is a multiple of the chunk size).
+    ``bytes_copied``: input bytes copied to make a chunk contiguous
+    (non-contiguous sources, e.g. broadcast hyperparameter columns) —
+    exactly 0 for batch-aligned contiguous inputs: those ship as plain
+    views with no host-side staging copy at all."""
+
+    bytes_staged: int = 0
+    bytes_copied: int = 0
+
+
 def iter_padded_chunks(inputs: Dict[str, np.ndarray], n: int,
-                       chunk_size: int
+                       chunk_size: int,
+                       staging: Optional[PadStaging] = None,
+                       counters: Optional[CopyCounters] = None
                        ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
     """Cut [N, ...] host arrays into contiguous fixed-size chunks
     (XLA needs static shapes); the tail is zero-padded. Yields
-    ``(n_valid, chunk)`` — callers truncate outputs to ``n_valid``."""
+    ``(n_valid, chunk)`` — callers truncate outputs to ``n_valid``.
+
+    Full chunks whose leading-dim slice is already contiguous are
+    yielded as plain VIEWS — zero host copies; non-contiguous rows are
+    copied (counted in ``counters.bytes_copied``). The tail stages
+    through ``staging`` (one persistent buffer per input, reused across
+    calls) instead of a fresh concatenate-allocated copy."""
+    if staging is None:
+        staging = PadStaging()
     for lo in range(0, n, chunk_size):
         hi = min(lo + chunk_size, n)
-        chunk = {k: np.ascontiguousarray(v[lo:hi])
-                 for k, v in inputs.items()}
-        if hi - lo < chunk_size:
-            pad = chunk_size - (hi - lo)
-            chunk = {k: np.concatenate(
-                [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
-                for k, v in chunk.items()}
+        chunk = {}
+        for k, v in inputs.items():
+            rows = v[lo:hi]
+            if hi - lo < chunk_size:
+                chunk[k] = staging.stage(k, rows, chunk_size, counters)
+            elif rows.flags.c_contiguous:
+                chunk[k] = rows  # zero-copy view
+            else:
+                # a fresh copy per full chunk, NOT the shared staging
+                # buffer: several full chunks are in flight at once
+                # under async dispatch, and CPU backends may alias the
+                # numpy buffer zero-copy — a reused buffer would be
+                # rewritten under an unconsumed batch
+                chunk[k] = np.ascontiguousarray(rows)
+                if counters is not None:
+                    counters.bytes_copied += rows.nbytes
         yield hi - lo, chunk
 
 
-def drain_bounded(pending: "collections.deque", outs: Dict[str, List],
+class SlabSink:
+    """Preallocated ``[N, *out_shape]`` outputs, written in place.
+
+    Each drained batch writes ``res[k][:valid]`` directly into its row
+    range — no per-batch list append, no final full-output
+    ``np.concatenate`` (which re-copied the entire output in one
+    serialized pass after all device work finished). Slabs allocate
+    lazily from the first drained batch's shapes/dtypes, so the sink
+    needs no model signature and works for host backends too.
+    ``transfer_wait`` accumulates time blocked in ``device_get`` — the
+    ship-side stall the overlap strategies exist to hide."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.transfer_wait = 0.0
+        self._row = 0
+        self._slabs: Optional[Dict[str, np.ndarray]] = None
+
+    def write(self, valid: int, res) -> None:
+        t0 = time.perf_counter()
+        host = jax.device_get(res)
+        self.transfer_wait += time.perf_counter() - t0
+        if self._slabs is None:
+            self._slabs = {
+                k: np.empty((self.n,) + np.shape(v)[1:],
+                            np.asarray(v).dtype)
+                for k, v in host.items()}
+        lo = self._row
+        for k, v in host.items():
+            self._slabs[k][lo:lo + valid] = np.asarray(v)[:valid]
+        self._row = lo + valid
+
+    def result(self) -> Dict[str, np.ndarray]:
+        assert self._row == self.n and self._slabs is not None, \
+            (self._row, self.n)
+        return self._slabs
+
+
+def drain_bounded(pending: "collections.deque", sink: SlabSink,
                   limit: int):
-    """device_get completed batches until at most ``limit`` remain
-    enqueued (the backpressure half of async dispatch)."""
+    """device_get completed batches into the output slab until at most
+    ``limit`` remain enqueued (the backpressure half of async
+    dispatch)."""
     while len(pending) > limit:
-        valid, res = pending.popleft()
-        res = jax.device_get(res)
-        for k, v in res.items():
-            outs.setdefault(k, []).append(np.asarray(v)[:valid])
+        sink.write(*pending.popleft())
+
+
+def checkout_staging(staging: PadStaging, lock: threading.Lock
+                     ) -> Tuple[PadStaging, bool]:
+    """(stager, locked): the persistent stager when uncontended, else a
+    private throwaway — concurrent run() calls on one runner must not
+    race on the shared pad buffers; release the lock iff ``locked``."""
+    if lock.acquire(blocking=False):
+        return staging, True
+    return PadStaging(), False
+
+
+def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
+                    sink: SlabSink, place=None, sharding=None) -> int:
+    """THE dispatch state machine, shared by BatchRunner._run_device
+    and ShardedBatchRunner.run (one copy of the trickiest loop in the
+    codebase: generator look-ahead, placed-chunk hand-off, the
+    prefetch→host_async and host_async→deferred degrades, bounded
+    drain). Returns the number of batches dispatched.
+
+    ``place`` (optional) explicitly device_puts a chunk at dispatch —
+    the sharded runner's multi-process requirement. ``sharding``
+    (optional) is passed to :func:`start_device_prefetch` so prefetched
+    chunks land with the data sharding instead of committed to one
+    device."""
+    host_async = strategy in ("host_async", "prefetch")
+    prefetch = strategy == "prefetch"
+    limit = max_inflight
+    pending: collections.deque = collections.deque()
+    batches = 0
+    nxt = next(chunks, None)
+    placed = None
+    if prefetch and nxt is not None:
+        placed = start_device_prefetch(nxt[1], sharding)
+        prefetch = placed is not None
+    while nxt is not None:
+        valid, chunk = nxt
+        if placed is not None:
+            chunk, placed = placed, None
+        elif place is not None:
+            chunk = place(chunk)
+        nxt = next(chunks, None)
+        if prefetch and nxt is not None:
+            # start chunk i+1's host→device transfer BEFORE dispatching
+            # chunk i: the transfer proceeds while the device computes i
+            placed = start_device_prefetch(nxt[1], sharding)
+            prefetch = placed is not None
+        res = fn(params, chunk)
+        if host_async and not start_host_copies(res):
+            # missing API: the deep uncopied queue would recreate the
+            # stale-buffer collapse — shallow queue instead
+            host_async = False
+            limit = min(limit, MAX_INFLIGHT_BATCHES)
+        pending.append((valid, res))
+        batches += 1
+        drain_bounded(pending, sink, limit)
+    drain_bounded(pending, sink, 0)
+    return batches
 
 
 _warned_no_host_async = False
@@ -217,22 +413,70 @@ def start_host_copies(res: Dict[str, jax.Array]) -> bool:
     return True
 
 
+_warned_no_prefetch = False
+
+
+def start_device_prefetch(chunk: Dict[str, np.ndarray], sharding=None
+                          ) -> Optional[Dict[str, jax.Array]]:
+    """``jax.device_put`` the NEXT chunk so its host→device transfer
+    overlaps the CURRENT chunk's compute (the "prefetch" strategy's
+    depth-1 input hook); the jitted call then consumes an
+    already-resident buffer instead of transferring at dispatch time.
+
+    Returns None when the backend cannot place ahead of dispatch
+    (``NotImplementedError`` from ``device_put``) — callers must then
+    degrade to plain host_async dispatch for the rest of the run, and
+    the degradation warns exactly once per process (the same
+    probe-and-degrade discipline as :func:`start_host_copies`). Real
+    runtime errors propagate."""
+    global _warned_no_prefetch
+    try:
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding)
+                    for k, v in chunk.items()}
+        return {k: jax.device_put(v) for k, v in chunk.items()}
+    except NotImplementedError:
+        if not _warned_no_prefetch:
+            _warned_no_prefetch = True
+            logging.getLogger(__name__).warning(
+                "backend lacks async device_put; prefetch degrades to "
+                "host_async dispatch")
+        return None
+
+
 @dataclass
 class RunnerMetrics:
-    """Throughput counters (SURVEY §5: the reference had none — these
-    exist to prove the north-star number)."""
+    """Throughput + host-copy counters (SURVEY §5: the reference had
+    none — these exist to prove the north-star number, and since the
+    pipeline went link-bound, to prove the ship-path copies went away
+    rather than asserting it).
+
+    ``bytes_staged``: input bytes written through the reusable
+    pad-staging buffer (tail chunks only). ``bytes_copied``: input
+    bytes copied to make chunks contiguous — exactly 0 for
+    batch-aligned contiguous device runs, the zero-copy hot path.
+    ``transfer_wait_seconds``: time blocked in ``device_get`` drains
+    (the ship-side stall the overlap strategies hide)."""
 
     rows: int = 0
     batches: int = 0
     seconds: float = 0.0
+    bytes_staged: int = 0
+    bytes_copied: int = 0
+    transfer_wait_seconds: float = 0.0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
-    def add(self, rows: int, batches: int, seconds: float):
+    def add(self, rows: int, batches: int, seconds: float,
+            bytes_staged: int = 0, bytes_copied: int = 0,
+            transfer_wait_seconds: float = 0.0):
         with self._lock:
             self.rows += rows
             self.batches += batches
             self.seconds += seconds
+            self.bytes_staged += bytes_staged
+            self.bytes_copied += bytes_copied
+            self.transfer_wait_seconds += transfer_wait_seconds
 
     # Locks don't pickle; stage closures holding a metrics object must
     # ship to Spark executors (spark_binding), so the lock is dropped on
@@ -271,6 +515,28 @@ class BatchRunner:
         # immediate == a zero-length queue; deferred keeps a small one
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
+        # persistent pad staging, reused across run() calls; checked
+        # out under a try-lock so concurrent run() calls on one runner
+        # fall back to a private throwaway stager instead of racing
+        self._staging = PadStaging()
+        self._staging_lock = threading.Lock()
+
+    def _checkout_staging(self) -> Tuple[PadStaging, bool]:
+        return checkout_staging(self._staging, self._staging_lock)
+
+    # Locks (and warm staging buffers) don't pickle; device stage
+    # closures holding a runner ship to Spark executors
+    # (spark_binding) — same discipline as RunnerMetrics.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_staging", None)
+        state.pop("_staging_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._staging = PadStaging()
+        self._staging_lock = threading.Lock()
 
     @property
     def preferred_chunk(self) -> int:
@@ -294,48 +560,59 @@ class BatchRunner:
         check_against_signature(inputs, self.model_fn)
 
         t0 = time.perf_counter()
+        counters = CopyCounters()
         if self.model_fn.backend == "host":
-            out = self._run_host(inputs, n)
+            out, wait = self._run_host(inputs, n)
         else:
-            out = self._run_device(inputs, n)
+            out, wait = self._run_device(inputs, n, counters)
         self.metrics.add(n, -(-n // self.batch_size),
-                         time.perf_counter() - t0)
+                         time.perf_counter() - t0,
+                         bytes_staged=counters.bytes_staged,
+                         bytes_copied=counters.bytes_copied,
+                         transfer_wait_seconds=wait)
         return out
 
     # -- host path ----------------------------------------------------------
 
-    def _run_host(self, inputs, n) -> Dict[str, np.ndarray]:
-        parts: List[Dict[str, np.ndarray]] = []
+    def _run_host(self, inputs, n) -> Tuple[Dict[str, np.ndarray], float]:
+        # slab outputs here too: each chunk's result writes its row
+        # range of one preallocated [N, *out] array (lazily shaped from
+        # the first chunk), replacing the per-chunk list + final concat
+        slabs: Optional[Dict[str, np.ndarray]] = None
         for lo, hi in self._chunks(n):
             chunk = {k: v[lo:hi] for k, v in inputs.items()}
-            parts.append(self.model_fn.apply_fn(self.model_fn.params,
-                                                chunk))
-        return {k: np.concatenate([p[k] for p in parts])
-                for k in parts[0]}
+            out = self.model_fn.apply_fn(self.model_fn.params, chunk)
+            if slabs is None:
+                slabs = {k: np.empty((n,) + np.shape(v)[1:],
+                                     np.asarray(v).dtype)
+                         for k, v in out.items()}
+            for k, v in out.items():
+                slabs[k][lo:hi] = np.asarray(v)
+        assert slabs is not None
+        return slabs, 0.0
 
     # -- device path --------------------------------------------------------
 
-    def _run_device(self, inputs, n) -> Dict[str, np.ndarray]:
+    def _run_device(self, inputs, n, counters: CopyCounters
+                    ) -> Tuple[Dict[str, np.ndarray], float]:
         fn = self.model_fn.jitted()
         params = self.model_fn.device_params()
         # enqueue then drain to self.max_inflight: 0 = immediate drain,
         # >0 = bounded async dispatch; host_async also starts each
-        # result's device→host copy at enqueue (see module docstring)
-        host_async = self.strategy == "host_async"
-        limit = self.max_inflight
-        pending: collections.deque = collections.deque()
-        outs: Dict[str, List[np.ndarray]] = {}
-        for valid, chunk in iter_padded_chunks(inputs, n, self.batch_size):
-            res = fn(params, chunk)
-            if host_async and not start_host_copies(res):
-                # missing API: the deep uncopied queue would recreate
-                # the stale-buffer collapse — shallow queue instead
-                host_async = False
-                limit = min(limit, MAX_INFLIGHT_BATCHES)
-            pending.append((valid, res))
-            drain_bounded(pending, outs, limit)
-        drain_bounded(pending, outs, 0)
-        return {k: np.concatenate(v) for k, v in outs.items()}
+        # result's device→host copy at enqueue; prefetch additionally
+        # device_puts chunk i+1 while chunk i computes (module
+        # docstring)
+        sink = SlabSink(n)
+        staging, locked = self._checkout_staging()
+        try:
+            chunks = iter_padded_chunks(inputs, n, self.batch_size,
+                                        staging, counters)
+            dispatch_chunks(fn, params, chunks, self.strategy,
+                            self.max_inflight, sink)
+        finally:
+            if locked:
+                self._staging_lock.release()
+        return sink.result(), sink.transfer_wait
 
     def _empty_outputs(self) -> Dict[str, np.ndarray]:
         if self.model_fn.backend != "jax":

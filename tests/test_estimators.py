@@ -675,6 +675,34 @@ class TestEvaluators:
             predictionSemantics="probabilities").evaluate(df)
         assert loss > 0.0  # clipped log(1e-7) terms, finite
 
+    def test_auto_semantics_warns_on_saturated_01_column(self, caplog):
+        """ADVICE r5 medium: the all-0.0/1.0 warning block was dead
+        code — unreachable under the raw-scores raise it sat below.
+        Under predictionSemantics='auto' an all-0.0/1.0 scalar column
+        must SCORE (a fully saturated sigmoid is legitimate) but WARN
+        that the values may be class labels."""
+        import logging
+
+        vals = [1.0, 0.0, 1.0, 0.0]
+        labels = [1, 0, 0, 1]
+        df = self._scalar_df(vals, labels, parts=2)
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.estimators.evaluators"):
+            loss = LossEvaluator(predictionCol="prediction").evaluate(df)
+        assert np.isfinite(loss) and loss > 0.0
+        saturated = [r for r in caplog.records
+                     if "0.0/1.0" in r.getMessage()]
+        assert len(saturated) == 1, caplog.records
+        # a genuinely fractional column must NOT warn
+        caplog.clear()
+        df_frac = self._scalar_df([0.9, 0.2, 0.8, 0.4], [1, 0, 1, 1],
+                                  parts=2)
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.estimators.evaluators"):
+            LossEvaluator(predictionCol="prediction").evaluate(df_frac)
+        assert not [r for r in caplog.records
+                    if "0.0/1.0" in r.getMessage()]
+
     def test_auto_semantics_rejects_raw_scores(self):
         """review r5 high #1: non-integral scalars OUTSIDE [0,1] are
         neither labels nor probabilities (raw margins mistakenly wired
@@ -1053,14 +1081,18 @@ class TestEmptyFoldHandling:
         from sparkdl_tpu.params.tuning import CrossValidator
 
         # call order: fold0 cand0 (empty -> skipped), fold0 cand1 = 2,
-        # fold1 cand0 = 3, fold1 cand1 = 4
+        # fold1 cand0 = 3, fold1 cand1 = 4. fold0 is excluded from
+        # EVERY candidate's average (common-subset comparison): cand0
+        # averages {fold1}=3, cand1 averages {fold1}=4 — NOT (2+4)/2,
+        # which would score cand1 on a fold cand0 never saw.
         cv = CrossValidator(estimator=self._stub(),
                             estimatorParamMaps=[{}, {}],
                             evaluator=self._flaky_ev({1}), numFolds=2)
         with caplog.at_level(logging.WARNING):
             m = cv.fit(self._df())
-        assert m.avgMetrics == pytest.approx([3.0, 3.0])
+        assert m.avgMetrics == pytest.approx([3.0, 4.0])
         assert any("scored 0 rows" in r.message for r in caplog.records)
+        assert any("common" in r.message for r in caplog.records)
 
     def test_cv_all_empty_raises(self):
         from sparkdl_tpu.params.tuning import CrossValidator
@@ -1069,7 +1101,7 @@ class TestEmptyFoldHandling:
                             estimatorParamMaps=[{}, {}],
                             evaluator=self._flaky_ev(set(range(1, 20))),
                             numFolds=2)
-        with pytest.raises(ValueError, match="every fold"):
+        with pytest.raises(ValueError, match="no fold"):
             cv.fit(self._df())
 
     def test_tvs_empty_validation_raises_with_context(self):

@@ -318,6 +318,111 @@ class TestCrossValidator:
         assert abs(cvm.bestModel.mean - np.arange(30).mean()) < 1e-9
 
 
+    def test_cv_excludes_nan_fold_from_all_candidates(self, caplog):
+        """ADVICE r5: a fold one candidate nan-skipped (its transform
+        emptied the validation side) is excluded from EVERY candidate's
+        average — candidates are compared on the same fold subset, and
+        avgMetrics stays finite. The well-behaved candidate's average
+        must equal its mean over exactly the surviving folds."""
+        import logging
+
+        import pyarrow as pa
+
+        from sparkdl_tpu.params.pipeline import EmptyScoredFrameError
+
+        class StrictMAE(MAE):
+            def evaluate(self, dataset):
+                tab = dataset.collect()
+                if tab.num_rows == 0:
+                    raise EmptyScoredFrameError("validation side empty")
+                return float(np.abs(tab.column("m").to_numpy()
+                                    - tab.column("x").to_numpy()).mean())
+
+        class DroppingMeanModel(MeanModel):
+            def __init__(self, mean, inputCol, outputCol, drop):
+                super().__init__(mean, inputCol, outputCol)
+                self._drop = set(drop)
+
+            def _transform(self, dataset):
+                out = super()._transform(dataset)
+                drop = self._drop
+
+                def _filter(batch):
+                    x = batch.column(
+                        batch.schema.get_field_index("x")) \
+                        .to_numpy(zero_copy_only=False)
+                    keep = ~np.isin(x, sorted(drop))
+                    return batch.filter(pa.array(keep))
+
+                return out.map_batches(_filter, name="drop",
+                                       row_preserving=False)
+
+        class DropMeanEstimator(MeanEstimator):
+            dropRows = Param("DropMeanEstimator", "dropRows",
+                             "x values the fitted model's transform "
+                             "drops")
+
+            def _fit(self, dataset):
+                base = super()._fit(dataset)
+                drop = (self.getOrDefault("dropRows")
+                        if self.isDefined(self.dropRows) else ())
+                if drop:
+                    return DroppingMeanModel(
+                        base.mean, self.getInputCol(),
+                        self.getOutputCol(), drop)
+                return base
+
+        df = _df(60, parts=5)
+        e = DropMeanEstimator(inputCol="x", outputCol="m")
+        e._setDefault(dropRows=())
+        cv_probe = CrossValidator(estimator=e, estimatorParamMaps=[{}],
+                                  evaluator=StrictMAE(), numFolds=3,
+                                  seed=7)
+        # fold 1's validation x values, from the same deterministic
+        # seeded draw the fit will use
+        folds = list(cv_probe._kfold(df))
+        fold1_valid = folds[1][1].collect().column("x").to_pylist()
+        assert fold1_valid  # the engineered skip must be real
+
+        grid = [{e.shift: 0.0},
+                {e.shift: 50.0, e.dropRows: tuple(fold1_valid)}]
+        cv = CrossValidator(estimator=e, estimatorParamMaps=grid,
+                            evaluator=StrictMAE(), numFolds=3, seed=7)
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.params.tuning"):
+            cvm = cv.fit(df)
+        assert np.isfinite(cvm.avgMetrics).all(), cvm.avgMetrics
+        assert any("common" in r.getMessage() for r in caplog.records)
+
+        # candidate 0's average over exactly the surviving folds {0, 2}
+        expect = []
+        for fold, (train, valid) in enumerate(cv._kfold(df)):
+            if fold == 1:
+                continue
+            model = e.fit(train, {e.shift: 0.0})
+            expect.append(StrictMAE().evaluate(model.transform(valid)))
+        assert cvm.avgMetrics[0] == pytest.approx(
+            float(np.mean(expect)))
+        # shift=0 still wins on the common subset
+        assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
+        assert isinstance(cvm.bestModel, MeanModel)
+
+    def test_cv_all_folds_skipped_raises(self):
+        """When no fold is scored by every candidate there is no
+        common subset to compare on — the fit raises instead of
+        returning NaN averages."""
+        from sparkdl_tpu.params.pipeline import EmptyScoredFrameError
+
+        class AlwaysEmpty(MAE):
+            def evaluate(self, dataset):
+                raise EmptyScoredFrameError("empty")
+
+        e = MeanEstimator(inputCol="x", outputCol="m")
+        cv = CrossValidator(estimator=e, estimatorParamMaps=[{}],
+                            evaluator=AlwaysEmpty(), numFolds=3)
+        with pytest.raises(ValueError, match="no fold"):
+            cv.fit(_df(30))
+
     def test_cv_materializes_dataset_once(self):
         """A decode-bearing plan must run ONCE per fit — the old fold
         construction re-collected the frame on every filter_rows call,

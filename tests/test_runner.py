@@ -150,20 +150,27 @@ class TestBatchRunner:
         assert start_host_copies({"y": _CannotDo()}) is False
 
     def test_all_strategies_produce_identical_outputs(self):
-        """immediate / deferred / host_async are pure dispatch policies
-        — same results, same order, including the padded tail."""
-        x = np.arange(22 * 3, dtype=np.float32).reshape(22, 3)
-        expected = None
-        for strategy in ("immediate", "deferred", "host_async"):
-            r = BatchRunner(_double_fn(), batch_size=4,
-                            strategy=strategy)
-            out = r.run({"input": x})["output"]
-            assert out.shape == (22, 3)
-            if expected is None:
-                expected = out
-            else:
-                np.testing.assert_array_equal(out, expected)
-        np.testing.assert_allclose(expected, x * 2.0)
+        """immediate / deferred / host_async / prefetch are pure
+        dispatch policies — same results, same order, for aligned,
+        tail-padded, and N=0 inputs (the slab-output parity pin)."""
+        cases = {
+            "tail": np.arange(22 * 3, dtype=np.float32).reshape(22, 3),
+            "aligned": np.arange(8 * 3, dtype=np.float32).reshape(8, 3),
+            "empty": np.zeros((0, 3), np.float32),
+        }
+        for name, x in cases.items():
+            expected = None
+            for strategy in ("immediate", "deferred", "host_async",
+                             "prefetch"):
+                r = BatchRunner(_double_fn(), batch_size=4,
+                                strategy=strategy)
+                out = r.run({"input": x})["output"]
+                assert out.shape == x.shape, (name, strategy)
+                if expected is None:
+                    expected = out
+                else:
+                    np.testing.assert_array_equal(out, expected)
+            np.testing.assert_allclose(expected, x * 2.0)
 
     def test_host_backend(self):
         def host_apply(params, inputs):
@@ -188,6 +195,132 @@ class TestBatchRunner:
 
         mf.params = {"scale": np.float32(5.0)}
         np.testing.assert_allclose(r.run({"input": x})["output"], 5.0)
+
+    def test_aligned_run_is_zero_copy(self):
+        """The zero-copy hot path pinned by counters: a batch-aligned
+        contiguous input ships as plain views — RunnerMetrics reports
+        ZERO bytes staged and ZERO bytes copied. The input is marked
+        read-only so any staging write into it would raise."""
+        m = RunnerMetrics()
+        r = BatchRunner(_double_fn(), batch_size=4, metrics=m)
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        x.setflags(write=False)
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        assert m.bytes_staged == 0 and m.bytes_copied == 0, m
+        # a tail-padded run stages EXACTLY the tail rows, nothing more
+        y = np.arange(30, dtype=np.float32).reshape(10, 3)
+        y.setflags(write=False)
+        np.testing.assert_allclose(r.run({"input": y})["output"], y * 2)
+        assert m.bytes_staged == y[8:].nbytes, m
+        assert m.bytes_copied == 0, m
+
+    def test_non_contiguous_input_counts_copies(self):
+        """Non-contiguous rows (e.g. a strided column view) can't ship
+        as views — they are copied, and the copy is COUNTED: the
+        counters must not claim zero-copy for a path that copies."""
+        m = RunnerMetrics()
+        r = BatchRunner(_double_fn(), batch_size=4, metrics=m)
+        x = np.arange(8 * 6, dtype=np.float32).reshape(8, 6)[:, ::2]
+        assert not x.flags.c_contiguous
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        assert m.bytes_copied == x.nbytes, m
+        assert m.bytes_staged == 0, m
+
+    def test_iter_padded_chunks_views_and_persistent_staging(self):
+        """Full chunks are VIEWS of the input (zero host copies); the
+        tail stages through ONE persistent buffer reused across calls,
+        with the pad region re-zeroed when the next tail is shorter."""
+        from sparkdl_tpu.runtime.runner import (
+            CopyCounters,
+            PadStaging,
+            iter_padded_chunks,
+        )
+
+        x = np.arange(33, dtype=np.float32).reshape(11, 3)
+        x.setflags(write=False)
+        staging, counters = PadStaging(), CopyCounters()
+        chunks = list(iter_padded_chunks({"x": x}, 11, 4,
+                                         staging, counters))
+        assert [v for v, _ in chunks] == [4, 4, 3]
+        assert np.shares_memory(chunks[0][1]["x"], x)
+        assert np.shares_memory(chunks[1][1]["x"], x)
+        tail = chunks[2][1]["x"]
+        assert not np.shares_memory(tail, x)
+        assert tail.shape == (4, 3)
+        np.testing.assert_array_equal(tail[:3], x[8:])
+        np.testing.assert_array_equal(tail[3:], 0.0)
+        assert counters.bytes_copied == 0
+        assert counters.bytes_staged == x[8:].nbytes
+        # second call, shorter tail: SAME buffer object, stale rows
+        # from the previous tail re-zeroed
+        y = np.ones((6, 3), np.float32)
+        c2 = list(iter_padded_chunks({"x": y}, 6, 4, staging,
+                                     CopyCounters()))
+        assert c2[1][1]["x"] is tail  # persistent buffer reused
+        np.testing.assert_array_equal(tail[:2], 1.0)
+        np.testing.assert_array_equal(tail[2:], 0.0)
+
+    def test_prefetch_degrades_once_with_warning(self, monkeypatch,
+                                                 caplog):
+        """A backend whose device_put can't place ahead of dispatch
+        (NotImplementedError) degrades prefetch → host_async dispatch
+        EXACTLY ONCE per run, with the documented warning exactly once
+        per process; real runtime errors propagate instead."""
+        import logging
+
+        import sparkdl_tpu.runtime.runner as rmod
+
+        monkeypatch.setattr(rmod, "_warned_no_prefetch", False)
+        calls = []
+
+        def no_async_put(v, *a, **k):
+            calls.append(1)
+            raise NotImplementedError("no async placement")
+
+        monkeypatch.setattr(rmod.jax, "device_put", no_async_put)
+        x = np.arange(36, dtype=np.float32).reshape(12, 3)
+        with caplog.at_level(logging.WARNING,
+                             logger="sparkdl_tpu.runtime.runner"):
+            for _ in range(2):  # second run: no second warning
+                r = BatchRunner(_double_fn(), batch_size=4,
+                                strategy="prefetch")
+                out = r.run({"input": x})["output"]
+                np.testing.assert_allclose(out, x * 2.0)
+        # one probe per run — after the first NotImplementedError the
+        # run never retries device_put for its remaining chunks
+        assert len(calls) == 2, calls
+        warns = [r for r in caplog.records
+                 if "prefetch degrades" in r.getMessage()]
+        assert len(warns) == 1, caplog.records
+
+    def test_prefetch_propagates_real_device_put_errors(self,
+                                                        monkeypatch):
+        """Only NotImplementedError means 'backend can't' — a genuine
+        runtime failure inside device_put must surface, not silently
+        degrade the strategy (the start_host_copies discipline)."""
+        import sparkdl_tpu.runtime.runner as rmod
+
+        def broken_put(v, *a, **k):
+            raise RuntimeError("device OOM")
+
+        monkeypatch.setattr(rmod.jax, "device_put", broken_put)
+        r = BatchRunner(_double_fn(), batch_size=4,
+                        strategy="prefetch")
+        with pytest.raises(RuntimeError, match="device OOM"):
+            r.run({"input": np.zeros((8, 3), np.float32)})
+
+    def test_runner_pickles_without_lock_state(self):
+        """Device stage closures holding a runner ship to Spark
+        executors — the staging lock/buffers must drop on pickle and
+        come back fresh (the RunnerMetrics discipline)."""
+        cloudpickle = pytest.importorskip("cloudpickle")
+
+        r = BatchRunner(_double_fn(), batch_size=4)
+        x = np.arange(30, dtype=np.float32).reshape(10, 3)
+        r.run({"input": x})  # warm staging so there IS state to drop
+        r2 = cloudpickle.loads(cloudpickle.dumps(r))
+        np.testing.assert_allclose(r2.run({"input": x})["output"],
+                                   x * 2.0)
 
     def test_params_cache_purges_all_placements(self):
         """Reassigning .params purges every cached placement, not just

@@ -10,8 +10,11 @@
 #      executes without TPU hardware)
 #   3. compile-check + execute the multi-chip training/inference
 #      dryrun (__graft_entry__.dryrun_multichip)
-#   4. bench smoke: one tiny end-to-end featurize pass producing the
-#      driver-contract JSON line (CPU; the real bench runs on TPU)
+#   4. bench smoke: the REAL bench.py in its tiny shape
+#      (SPARKDL_TPU_BENCH_TINY=1, TestNet, CPU) with a schema gate —
+#      a bench refactor that drops pipeline_bound_by, a ceiling key,
+#      or the host-copy counters fails HERE instead of failing the
+#      next TPU round's driver parse
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -51,30 +54,50 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/4] bench smoke (CPU, tiny) =="
+echo "== [4/4] bench smoke (real bench.py, tiny shape, schema gate) =="
+SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
 python - <<'EOF'
 import json
-import time
 
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
+with open("/tmp/sparkdl_bench_smoke.json") as f:
+    d = json.loads(f.read().strip().splitlines()[-1])
 
-from sparkdl_tpu.models.zoo import getModelFunction
-from sparkdl_tpu.runtime.runner import BatchRunner
-
-mf = getModelFunction("TestNet", featurize=True)
-runner = BatchRunner(mf, batch_size=8)
-images = np.random.default_rng(0).integers(
-    0, 255, (16, 32, 32, 3), dtype=np.uint8)
-runner.run({"image": images[:8]})  # warmup
-t0 = time.perf_counter()
-out = runner.run({"image": images})
-ips = len(images) / (time.perf_counter() - t0)
-assert out["features"].shape == (16, 16), out["features"].shape
-print(json.dumps({"metric": "ci_smoke_testnet_featurize[cpu]",
-                  "value": round(ips, 1), "unit": "images/sec",
-                  "vs_baseline": None}))
+# Every key a round-over-round reader or the driver contract consumes.
+# Missing keys here mean the next TPU round's numbers silently lose a
+# column — fail the build instead.
+required = [
+    "metric", "value", "unit", "vs_baseline", "value_pipeline",
+    "value_fullres_transfer", "value_packed", "value_packed420",
+    "device_resident_ips", "device_tflops",
+    "link_h2d_MBps", "link_d2h_MBps",
+    "host_fed_ceiling_ips", "host_fed_ceiling_ips_packed",
+    "host_fed_ceiling_ips_packed420",
+    "host_decode_ips", "host_decode_ips_packed",
+    "host_decode_ips_packed420",
+    "pipeline_bound_by", "pipeline_stage_ceilings_ips",
+    "host_copy", "fidelity", "runner_strategy",
+]
+missing = [k for k in required if k not in d]
+assert not missing, f"bench smoke: missing JSON keys {missing}"
+hc = d["host_copy"]
+hc_required = ["aligned", "tail", "pipeline_bytes_staged",
+               "pipeline_bytes_copied", "pipeline_transfer_wait_s"]
+missing = [k for k in hc_required if k not in hc]
+assert not missing, f"bench smoke: missing host_copy keys {missing}"
+for shape in ("aligned", "tail"):
+    for k in ("ips", "bytes_staged", "bytes_copied",
+              "transfer_wait_s"):
+        assert k in hc[shape], f"host_copy[{shape!r}] missing {k!r}"
+# the zero-copy contract itself: batch-aligned runs stage and copy
+# NOTHING on the host ship path
+assert hc["aligned"]["bytes_copied"] == 0, hc["aligned"]
+assert hc["aligned"]["bytes_staged"] == 0, hc["aligned"]
+assert d["pipeline_bound_by"] in ("decode", "link", "compute"), d
+assert set(d["pipeline_stage_ceilings_ips"]) == \
+    {"decode", "link", "compute"}, d["pipeline_stage_ceilings_ips"]
+print(json.dumps({"metric": d["metric"], "value": d["value"],
+                  "unit": d["unit"], "vs_baseline": d["vs_baseline"],
+                  "schema": "ok"}))
 EOF
 
 echo "== ci.sh: ALL GREEN =="

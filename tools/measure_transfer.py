@@ -14,6 +14,14 @@ unreliable on the tunneled platform):
                 deferred   — enqueue all (bounded), drain at the end
                 prefetch   — explicit device_put of chunk i+1 during i
                 host_async — copy_to_host_async, gather at the end
+  runner_strategy_ips
+              the SAME four strategies measured through the production
+              BatchRunner (slab outputs + reusable pad staging + the
+              built-in depth-1 "prefetch" strategy) — what the library
+              actually ships, vs the hand-rolled loops above
+  host_copy   RunnerMetrics' bytes-staged/bytes-copied/transfer-wait
+              counters for batch-aligned vs tail-padded runs (the
+              aligned shape must report 0/0: zero-copy ship)
 
 Prints one JSON object; run on the real chip (no JAX_PLATFORMS
 override) or CPU. Results feed BatchRunner's strategy choice and
@@ -34,6 +42,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # the forced-sync methodology lives in ONE place, shared with bench.py
 from sparkdl_tpu.utils.measure import (  # noqa: E402
     measure_device_resident,
+    measure_host_copy,
     measure_link,
     sync_readback as _sync,
 )
@@ -125,8 +134,32 @@ def _strategies(batch_size: int, n_rows: int) -> dict:
     return out
 
 
+def _runner_strategies(batch_size: int, n_rows: int) -> dict:
+    """The four strategies measured through the PRODUCTION BatchRunner
+    (what the library ships: slab outputs, reusable pad staging, the
+    built-in depth-1 prefetch), not the hand-rolled loops above."""
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.runtime.runner import BatchRunner
+
+    mf = getModelFunction("InceptionV3", featurize=True)
+    images = np.random.default_rng(2).integers(
+        0, 255, size=(n_rows, 299, 299, 3), dtype=np.uint8)
+    out = {}
+    for name in ("immediate", "deferred", "host_async", "prefetch"):
+        runner = BatchRunner(mf, batch_size=batch_size, strategy=name)
+        runner.run({"image": images[:batch_size]})  # compile + warm
+        t0 = time.perf_counter()
+        feats = runner.run({"image": images})["features"]
+        dt = time.perf_counter() - t0
+        assert feats.shape == (n_rows, 2048)
+        out[name] = round(n_rows / dt, 1)
+    return out
+
+
 def main() -> None:
     import jax
+
+    from sparkdl_tpu.models.zoo import getModelFunction
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -137,6 +170,10 @@ def main() -> None:
         "link": measure_link(32 if on_tpu else 8),
         "compute": measure_compute(batch),
         "strategy_ips": _strategies(batch, rows),
+        "runner_strategy_ips": _runner_strategies(batch, rows),
+        "host_copy": measure_host_copy(
+            getModelFunction("InceptionV3", featurize=True), batch,
+            n_batches=4 if on_tpu else 2),
     }
     print(json.dumps(report))
 
