@@ -309,6 +309,7 @@ def main() -> None:
 
     from sparkdl_tpu.models.zoo import getModelFunction
     from sparkdl_tpu.runtime.runner import BatchRunner
+    from sparkdl_tpu.runtime.sanitize import armed_run_count, sanitize_enabled
     from sparkdl_tpu.utils.measure import (
         measure_device_resident,
         measure_host_copy,
@@ -561,6 +562,14 @@ def main() -> None:
         "pipeline_stage_ceilings_ips": {
             k: round(v, 1) for k, v in stage_ceilings.items()},
         "runner_strategy": runner.strategy,
+        # whether the runners' ship path ran under the runtime
+        # sanitizer's transfer guard (SPARKDL_TPU_SANITIZE=1 —
+        # runtime/sanitize.py): True means the zero-copy numbers above
+        # were enforced by the JAX runtime, not just counted. Requiring
+        # armed_run_count() > 0 (not just the env var) makes a
+        # degraded-guard backend report False — ci.sh's schema gate
+        # then fails instead of certifying unenforced numbers.
+        "sanitize": sanitize_enabled() and armed_run_count() > 0,
         "note": ("value IS the full measured pipeline (JPEG files -> "
                  "fused native DCT-prescaled decode/resize/pack to "
                  "planar YCbCr 4:2:0 (1.5 B/px, half the RGB payload; "

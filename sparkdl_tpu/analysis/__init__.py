@@ -1,0 +1,69 @@
+"""sparkdl-lint: repo-specific static analysis for the hot-path
+invariants.
+
+PR 1's zero-copy ship path claims "0 host staging copies on aligned
+runs"; RunnerMetrics counters and a handful of tests pin it, but
+nothing stops the next refactor from reintroducing an implicit
+device→host sync, an unlocked slab write, or a retracing hazard.
+tf.data (arXiv 2101.12127) and the TensorFlow system paper (arXiv
+1605.08695) both argue pipeline performance contracts must be checked
+by *tooling*, not convention — this package is that tooling, the
+static half of the enforcement pair (the dynamic half is
+``sparkdl_tpu.runtime.sanitize``, which puts ``jax.transfer_guard``
+under the ship path at runtime).
+
+Four rules, each an AST visitor over every module in the package:
+
+* **H1 — implicit host transfers**: ``jax.device_get`` /
+  ``.block_until_ready()`` / ``np.asarray(<jnp-producing call>)``
+  outside the allowlisted drain-path set (SlabSink's drain, the
+  measure tools). A stray sync on the ship path is exactly the
+  stale-buffer collapse round 1 measured.
+* **H2 — jit/retrace hazards**: Python side effects (``time.*``,
+  ``print``, stateful RNG) inside ``jax.jit``/``pjit``-compiled
+  functions — they run at trace time, not step time — and
+  unhashable ``static_argnums``/``static_argnames`` literals.
+* **H3 — concurrency discipline**: classes holding a
+  ``threading.Lock`` must define ``__getstate__``/``__reduce__``
+  (locks don't pickle; runner.py learned this the hard way), and
+  writes to fields a class declares in ``_lock_guards`` must sit
+  inside a ``with self._lock`` block.
+* **H4 — quiesce hygiene**: bare ``except:`` anywhere; silently
+  swallowed exceptions (``except ...: pass``) in cleanup paths
+  (``finally`` blocks, ``close``/``quiesce``/``__exit__``-shaped
+  functions) — a swallowed secondary error during quiesce masks
+  the drain the engine's effectful-source contract depends on.
+
+Findings suppress inline with a justification::
+
+    jax.device_get(x)  # sparkdl-lint: allow[H1] -- epoch-end drain
+
+or via the built-in allowlist (``sparkdl_tpu.analysis.suppress``).
+CLI: ``python -m sparkdl_tpu.analysis [paths...]`` (exit 1 on any
+unsuppressed finding); ``tools/lint.sh`` wraps it together with the
+generic ruff/mypy baseline from pyproject.toml. Rule reference:
+``docs/LINT.md``.
+"""
+
+from __future__ import annotations
+
+from sparkdl_tpu.analysis.findings import Finding, format_findings
+from sparkdl_tpu.analysis.rules import RULES, rule_doc
+from sparkdl_tpu.analysis.suppress import DEFAULT_ALLOWLIST, AllowEntry
+from sparkdl_tpu.analysis.walker import (
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+)
+
+__all__ = [
+    "AllowEntry",
+    "DEFAULT_ALLOWLIST",
+    "Finding",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "format_findings",
+    "iter_python_files",
+    "rule_doc",
+]

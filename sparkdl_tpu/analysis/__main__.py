@@ -1,0 +1,75 @@
+"""CLI: ``python -m sparkdl_tpu.analysis [paths...]``.
+
+Exit 0 when every finding is suppressed (inline annotation or
+allowlist), 1 when any unsuppressed finding remains, 2 on usage
+errors — the contract tools/ci.sh's static-analysis gate keys off.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from sparkdl_tpu.analysis.findings import format_findings
+from sparkdl_tpu.analysis.rules import RULES, rule_doc
+from sparkdl_tpu.analysis.walker import analyze_paths
+
+
+def _default_target() -> str:
+    """The installed package itself — `python -m sparkdl_tpu.analysis`
+    with no args lints the code that is actually importable."""
+    import sparkdl_tpu
+    return os.path.dirname(os.path.abspath(sparkdl_tpu.__file__))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkdl_tpu.analysis",
+        description="sparkdl-lint: enforce the hot-path invariants "
+                    "(H1 transfers, H2 retrace, H3 locks, H4 quiesce). "
+                    "Rule reference: docs/LINT.md")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the sparkdl_tpu "
+             "package)")
+    parser.add_argument(
+        "--rule", action="append", choices=sorted(RULES), dest="rules",
+        help="run only this rule (repeatable; default: all)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings with their justifications")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print(f"{rule}: {rule_doc(rule)}")
+        return 0
+
+    targets = args.paths or [_default_target()]
+    for t in targets:
+        if not os.path.exists(t):
+            print(f"sparkdl-lint: no such path: {t}", file=sys.stderr)
+            return 2
+
+    findings = analyze_paths(targets, rules=args.rules)
+    unsuppressed = [f for f in findings if not f.suppressed]
+    out = format_findings(findings,
+                          show_suppressed=args.show_suppressed,
+                          fmt=args.format)
+    if out:
+        print(out)
+    if args.format == "text":
+        suppressed = len(findings) - len(unsuppressed)
+        print(f"sparkdl-lint: {len(unsuppressed)} finding(s), "
+              f"{suppressed} suppressed", file=sys.stderr)
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

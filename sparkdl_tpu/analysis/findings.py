@@ -1,0 +1,58 @@
+"""Finding record + ``path:line`` formatter for sparkdl-lint."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Iterable, List
+
+
+@dataclass
+class Finding:
+    """One rule hit at one source location.
+
+    ``suppressed`` findings stay in the result set (the meta-test and
+    ``--show-suppressed`` render them) but don't fail the CLI;
+    ``suppression`` records WHY — the inline justification text or the
+    matching allowlist entry — so a suppression is never silent.
+    """
+
+    rule: str                 # "H1".."H4" (or "PARSE" for broken files)
+    path: str                 # as given to the walker (relative-friendly)
+    line: int                 # 1-indexed
+    col: int                  # 0-indexed, ast convention
+    message: str
+    qualname: str = ""        # dotted Class.method containing the hit
+    suppressed: bool = field(default=False)
+    suppression: str = field(default="")
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+    def render(self) -> str:
+        where = f" [{self.qualname}]" if self.qualname else ""
+        head = f"{self.location()}: {self.rule}{where} {self.message}"
+        if self.suppressed:
+            head += f"  (suppressed: {self.suppression})"
+        return head
+
+
+def format_findings(findings: Iterable[Finding],
+                    show_suppressed: bool = False,
+                    fmt: str = "text") -> str:
+    """Render findings for the CLI. ``text`` gives one ``path:line:col``
+    line per finding (editor/CI friendly); ``json`` gives a list of
+    dicts plus a summary object."""
+    findings = list(findings)  # may be a generator; iterated repeatedly
+    shown: List[Finding] = [
+        f for f in findings if show_suppressed or not f.suppressed]
+    if fmt == "json":
+        unsuppressed = [f for f in findings if not f.suppressed]
+        return json.dumps({
+            "findings": [asdict(f) for f in shown],
+            "unsuppressed": len(unsuppressed),
+            "suppressed": len([f for f in findings if f.suppressed]),
+        }, indent=2)
+    if fmt != "text":
+        raise ValueError(f"unknown format {fmt!r} (use 'text' or 'json')")
+    return "\n".join(f.render() for f in shown)

@@ -1,0 +1,508 @@
+"""The four sparkdl-lint rules (H1–H4), each an AST pass.
+
+Every rule is a function ``(tree, path) -> list[Finding]`` registered
+in :data:`RULES`; the walker runs all of them per file and then applies
+suppressions. Rules track the dotted ``Class.method`` qualname of each
+hit so the allowlist can scope to a single function.
+
+These are HEURISTIC checks tuned to this repo's idioms — they resolve
+names lexically, not by type inference. The contract is: zero false
+negatives on the patterns the repo actually writes (the fixtures in
+``tests/test_analysis.py`` pin them), and any false positive is cheap
+to suppress inline WITH a justification, which is itself documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from sparkdl_tpu.analysis.findings import Finding
+
+# ---------------------------------------------------------------------------
+# shared helpers
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains the dotted Class.method qualname."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self._stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack)
+
+    def _push(self, name: str, node: ast.AST):
+        self._stack.append(name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._push(node.name, node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._push(node.name, node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._push(node.name, node)
+
+    def flag(self, rule: str, node: ast.AST, message: str):
+        self.findings.append(Finding(
+            rule=rule, path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message, qualname=self.qualname))
+
+
+# ---------------------------------------------------------------------------
+# H1 — implicit host transfers on the ship path
+
+_H1_DEVICE_GET = {"jax.device_get", "jax.block_until_ready"}
+_H1_NP_WRAP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_H1_DEVICE_PRODUCERS = ("jnp.", "jax.numpy.", "jax.")
+
+
+class _H1Transfers(_ScopedVisitor):
+    """Host-transfer syncs outside the drain path. Each of these blocks
+    the calling thread until the device catches up — on the tunneled
+    link that is the exact stall the overlap strategies (deferred /
+    host_async / prefetch) exist to hide, and round 1 measured it as a
+    ~0.2 MB/s collapse when it hit a long-enqueued buffer."""
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name in _H1_DEVICE_GET:
+            self.flag(
+                "H1", node,
+                f"`{name}` forces a device→host sync; only the "
+                "allowlisted drain path (SlabSink.write, measure "
+                "tools) may block on the device — route results "
+                "through the runner's sink, or suppress with "
+                "`# sparkdl-lint: allow[H1] -- <why this drain is "
+                "legitimate>`")
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            self.flag(
+                "H1", node,
+                "`.block_until_ready()` forces a device sync (and on "
+                "the tunneled link returns at enqueue — it doesn't even "
+                "measure what it claims; use "
+                "utils.measure.sync_readback); suppress with "
+                "`# sparkdl-lint: allow[H1] -- <why>` if this drain "
+                "is deliberate")
+        elif name in _H1_NP_WRAP and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Call):
+                producer = _dotted(inner.func)
+                if producer and producer.startswith(_H1_DEVICE_PRODUCERS):
+                    self.flag(
+                        "H1", node,
+                        f"`{name}(...)` over a `{producer}` result "
+                        "implicitly copies device memory to host; "
+                        "keep device values device-resident or drain "
+                        "them through the runner sink (suppress: "
+                        "`# sparkdl-lint: allow[H1] -- <why>`)")
+        self.generic_visit(node)
+
+
+def check_h1(tree: ast.AST, path: str) -> List[Finding]:
+    v = _H1Transfers(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# H2 — jit / retrace hazards
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit",
+              "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_H2_SIDE_EFFECT_PREFIXES = ("time.", "np.random.", "numpy.random.",
+                            "random.")
+_H2_SIDE_EFFECT_CALLS = {"print", "input"}
+_STATIC_KWARGS = {"static_argnums", "static_argnames"}
+
+
+def _jit_target_of(call: ast.Call) -> Optional[ast.Call]:
+    """The jit-ish Call, unwrapping ``partial(jax.jit, ...)``."""
+    name = _dotted(call.func)
+    if name in _JIT_NAMES:
+        return call
+    if name in _PARTIAL_NAMES and call.args:
+        inner = _dotted(call.args[0])
+        if inner in _JIT_NAMES:
+            return call
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _dotted(dec) in _JIT_NAMES:
+        return True
+    return isinstance(dec, ast.Call) and _jit_target_of(dec) is not None
+
+
+class _H2SideEffects(ast.NodeVisitor):
+    """Scans the BODY of a traced function: anything here runs at trace
+    time, once per compilation — wall-clock reads read compile time,
+    prints fire once then vanish, stateful RNG freezes one sample into
+    the compiled program."""
+
+    def __init__(self, outer: "_H2Retrace", qualname: str):
+        self.outer = outer
+        self.qualname = qualname
+
+    def _flag(self, node: ast.AST, message: str):
+        self.outer.findings.append(Finding(
+            rule="H2", path=self.outer.path, line=node.lineno,
+            col=node.col_offset, message=message,
+            qualname=self.qualname))
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name in _H2_SIDE_EFFECT_CALLS:
+            self._flag(node, (
+                f"`{name}(...)` inside a jit-traced function executes "
+                "at TRACE time only (use jax.debug.print for per-step "
+                "output); suppress: `# sparkdl-lint: allow[H2] -- "
+                "<why>`"))
+        elif name and name.startswith(_H2_SIDE_EFFECT_PREFIXES):
+            if name.startswith("time."):
+                why = ("reads trace-time wall clock, frozen into the "
+                       "compiled program — time OUTSIDE the jit")
+            else:
+                why = ("stateful host RNG samples ONCE at trace time; "
+                       "thread a jax.random key instead")
+            self._flag(node, (
+                f"`{name}(...)` inside a jit-traced function: {why} "
+                "(suppress: `# sparkdl-lint: allow[H2] -- <why>`)"))
+        self.generic_visit(node)
+
+    # a nested def/lambda inside a jitted fn is traced too — keep
+    # walking (generic_visit covers them)
+
+
+class _H2Retrace(_ScopedVisitor):
+    def __init__(self, path: str, module_defs: Dict[str, ast.AST]):
+        super().__init__(path)
+        self._module_defs = module_defs
+        self._checked: Set[int] = set()
+
+    def _scan_traced(self, fn_node: ast.AST, qualname: str):
+        if id(fn_node) in self._checked:
+            return
+        self._checked.add(id(fn_node))
+        body = (fn_node.body if isinstance(fn_node.body, list)
+                else [fn_node.body])  # Lambda body is a single expr
+        scanner = _H2SideEffects(self, qualname)
+        for stmt in body:
+            scanner.visit(stmt)
+
+    def _check_static_kwargs(self, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg in _STATIC_KWARGS and isinstance(
+                    kw.value, (ast.List, ast.Set, ast.Dict,
+                               ast.ListComp, ast.SetComp, ast.DictComp)):
+                self.flag(
+                    "H2", kw.value,
+                    f"`{kw.arg}` given a mutable literal: static args "
+                    "are compilation-cache KEYS — spell it as an int "
+                    "or tuple literal so hashability is visible at the "
+                    "call site (suppress: `# sparkdl-lint: allow[H2] "
+                    "-- <why>`)")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            self._scan_traced(node, ".".join(self._stack + [node.name]))
+        self._push(node.name, node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call):
+        jit_call = _jit_target_of(node)
+        if jit_call is not None:
+            self._check_static_kwargs(node)
+            # jax.jit(f) / partial(jax.jit, ...)(f): resolve f when it
+            # is a lambda or a same-module def
+            args = node.args
+            if _dotted(node.func) in _PARTIAL_NAMES:
+                args = args[1:]
+            for arg in args:
+                if isinstance(arg, ast.Lambda):
+                    self._scan_traced(arg, self.qualname or "<lambda>")
+                elif isinstance(arg, ast.Name):
+                    target = self._module_defs.get(arg.id)
+                    if target is not None:
+                        self._scan_traced(target, arg.id)
+        self.generic_visit(node)
+
+
+def check_h2(tree: ast.AST, path: str) -> List[Finding]:
+    # name → def map for resolving jax.jit(fn_name); last def wins,
+    # names defined more than once with different nodes still resolve
+    # (both get scanned only if both are passed to jit)
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    v = _H2Retrace(path, defs)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# H3 — concurrency discipline
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_PICKLE_HOOKS = {"__getstate__", "__reduce__", "__reduce_ex__"}
+_H3_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__",
+                      "__setstate__", "__getstate__"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func) in _LOCK_CTORS)
+
+
+def _instance_lock_attrs(cls: ast.ClassDef) -> List[Tuple[str, int]]:
+    """``self.X = threading.Lock()`` assignments in methods, plus
+    dataclass ``field(default_factory=threading.Lock)`` declarations —
+    both become per-INSTANCE lock state that pickle chokes on (class-
+    body ``_lock = Lock()`` attributes are class state and exempt)."""
+    out: List[Tuple[str, int]] = []
+    for item in cls.body:
+        if isinstance(item, ast.AnnAssign) and isinstance(
+                item.value, ast.Call):
+            fn = _dotted(item.value.func)
+            if fn in ("field", "dataclasses.field"):
+                for kw in item.value.keywords:
+                    if kw.arg == "default_factory" and \
+                            _dotted(kw.value) in _LOCK_CTORS:
+                        name = (item.target.id if isinstance(
+                            item.target, ast.Name) else "?")
+                        out.append((name, item.lineno))
+        elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(item):
+                if isinstance(node, ast.Assign) and _is_lock_ctor(
+                        node.value):
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            out.append((tgt.attr, node.lineno))
+    return out
+
+
+def _guarded_fields(cls: ast.ClassDef) -> Tuple[Set[str], str]:
+    """The ``_lock_guards = ("field", ...)`` declaration: instance
+    fields whose WRITES must hold ``self._lock``. Returns (fields,
+    lock attr name) — the guarding lock is ``_lock`` by convention."""
+    for item in cls.body:
+        if isinstance(item, ast.Assign):
+            for tgt in item.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_lock_guards":
+                    if isinstance(item.value, (ast.Tuple, ast.List)):
+                        return ({e.value for e in item.value.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str)}, "_lock")
+    return (set(), "_lock")
+
+
+def _with_holds_lock(node: ast.With, lock_attr: str) -> bool:
+    for item in node.items:
+        ctx = item.context_expr
+        if (isinstance(ctx, ast.Attribute) and ctx.attr == lock_attr
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self"):
+            return True
+    return False
+
+
+class _H3Concurrency(_ScopedVisitor):
+    def visit_ClassDef(self, node: ast.ClassDef):
+        locks = _instance_lock_attrs(node)
+        if locks:
+            hooks = {item.name for item in node.body
+                     if isinstance(item, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+            if not (hooks & _PICKLE_HOOKS):
+                attrs = ", ".join(sorted({a for a, _ in locks}))
+                self._stack.append(node.name)
+                self.findings.append(Finding(
+                    rule="H3", path=self.path, line=node.lineno,
+                    col=node.col_offset, qualname=self.qualname,
+                    message=(
+                        f"class holds threading lock(s) [{attrs}] but "
+                        "defines no __getstate__/__reduce__ — locks "
+                        "don't pickle, and stage closures ship to "
+                        "Spark executors (see "
+                        "RunnerMetrics.__getstate__ for the drop-and-"
+                        "recreate discipline); suppress: "
+                        "`# sparkdl-lint: allow[H3] -- <why>`")))
+                self._stack.pop()
+        guards, lock_attr = _guarded_fields(node)
+        if guards:
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name not in _H3_EXEMPT_METHODS:
+                    self._stack.append(node.name)
+                    self._stack.append(item.name)
+                    self._check_guarded(item, guards, lock_attr,
+                                        in_lock=False)
+                    self._stack.pop()
+                    self._stack.pop()
+        self._push(node.name, node)
+
+    def _check_guarded(self, node: ast.AST, guards: Set[str],
+                       lock_attr: str, in_lock: bool):
+        for child in ast.iter_child_nodes(node):
+            child_in_lock = in_lock
+            if isinstance(child, ast.With) and _with_holds_lock(
+                    child, lock_attr):
+                child_in_lock = True
+            if isinstance(child, (ast.Assign, ast.AugAssign)) \
+                    and not child_in_lock:
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr in guards):
+                        self.flag(
+                            "H3", child,
+                            f"write to `self.{tgt.attr}` — declared "
+                            f"lock-guarded by `_lock_guards` — outside "
+                            f"a `with self.{lock_attr}` block "
+                            "(suppress: `# sparkdl-lint: allow[H3] "
+                            "-- <why>`)")
+            self._check_guarded(child, guards, lock_attr, child_in_lock)
+
+
+def check_h3(tree: ast.AST, path: str) -> List[Finding]:
+    v = _H3Concurrency(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# H4 — quiesce hygiene
+
+_CLEANUP_TOKENS = ("close", "cleanup", "quiesce", "shutdown", "stop",
+                   "release", "teardown", "__exit__", "__del__",
+                   "drain")
+
+
+def _is_cleanup_name(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _CLEANUP_TOKENS)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Body is only ``pass`` / ``...`` — the exception vanishes."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant):
+            continue  # docstring/ellipsis placeholder
+        return False
+    return True
+
+
+class _H4Quiesce(_ScopedVisitor):
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._finally_depth = 0
+
+    def visit_Try(self, node: ast.Try):
+        for part in (node.body, node.orelse):
+            for stmt in part:
+                self.visit(stmt)
+        for handler in node.handlers:
+            self._check_handler(handler)
+            self.visit(handler)
+        self._finally_depth += 1
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self._finally_depth -= 1
+
+    def visit_TryStar(self, node):  # pragma: no cover - py3.11 syntax
+        self.visit_Try(node)
+
+    def _check_handler(self, handler: ast.ExceptHandler):
+        if handler.type is None:
+            self.flag(
+                "H4", handler,
+                "bare `except:` also swallows KeyboardInterrupt/"
+                "SystemExit — a quiesce that can't be interrupted "
+                "hangs the engine's drain on shutdown; catch "
+                "`Exception` (and log it) instead (suppress: "
+                "`# sparkdl-lint: allow[H4] -- <why>`)")
+            return
+        if _swallows(handler) and (self._finally_depth > 0
+                                   or _is_cleanup_name(self.qualname)):
+            self.flag(
+                "H4", handler,
+                "silently swallowed exception in a cleanup/quiesce "
+                "path: a secondary failure here masks whether the "
+                "drain actually ran (the effectful-source contract) — "
+                "log it at debug level at minimum (suppress: "
+                "`# sparkdl-lint: allow[H4] -- <why>`)")
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        # reached only for handlers nested inside other visited bodies
+        # (visit_Try dispatches its own handlers through _check_handler
+        # before descending)
+        self.generic_visit(node)
+
+
+def check_h4(tree: ast.AST, path: str) -> List[Finding]:
+    v = _H4Quiesce(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
+    "H1": check_h1,
+    "H2": check_h2,
+    "H3": check_h3,
+    "H4": check_h4,
+}
+
+_RULE_DOCS = {
+    "H1": "implicit host transfers outside the allowlisted drain path "
+          "(jax.device_get / .block_until_ready() / np.asarray over a "
+          "jnp-producing call)",
+    "H2": "jit/retrace hazards: trace-time side effects (time.*, "
+          "print, stateful RNG) inside jit/pjit-compiled functions; "
+          "mutable static_argnums/static_argnames literals",
+    "H3": "concurrency discipline: lock-holding classes need "
+          "__getstate__/__reduce__; writes to _lock_guards-declared "
+          "fields must hold self._lock",
+    "H4": "quiesce hygiene: bare except; silently swallowed "
+          "exceptions in cleanup/finally paths",
+}
+
+
+def rule_doc(rule: str) -> str:
+    return _RULE_DOCS[rule.upper()]

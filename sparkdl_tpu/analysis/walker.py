@@ -1,0 +1,89 @@
+"""File walking + rule orchestration for sparkdl-lint."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from sparkdl_tpu.analysis.findings import Finding
+from sparkdl_tpu.analysis.rules import RULES
+from sparkdl_tpu.analysis.suppress import (
+    AllowEntry,
+    SuppressionIndex,
+    allowlisted,
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules",
+              "artifacts"}
+
+
+def iter_python_files(target: str) -> Iterator[str]:
+    """Yield ``.py`` files under ``target`` (or ``target`` itself),
+    skipping caches/VCS dirs, in sorted order for stable output."""
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def analyze_source(source: str, path: str,
+                   rules: Optional[Iterable[str]] = None,
+                   allowlist: Optional[Dict[str, Tuple[AllowEntry, ...]]]
+                   = None) -> List[Finding]:
+    """Run the rule set over one module's source. Findings covered by
+    an inline ``# sparkdl-lint: allow[..]`` annotation or the
+    allowlist come back with ``suppressed=True`` and the justification
+    attached — they are reported, not hidden."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            rule="PARSE", path=path, line=e.lineno or 1,
+            col=(e.offset or 1) - 1,
+            message=f"file does not parse: {e.msg} (sparkdl-lint "
+                    "cannot vouch for a module it cannot read)")]
+    wanted = ([r.upper() for r in rules] if rules is not None
+              else list(RULES))
+    findings: List[Finding] = []
+    for rule in wanted:
+        findings.extend(RULES[rule](tree, path))
+    index = SuppressionIndex(source)
+    for f in findings:
+        inline = index.lookup(f.rule, f.line)
+        if inline is not None:
+            f.suppressed = True
+            f.suppression = f"inline -- {inline}"
+            continue
+        listed = allowlisted(f.rule, f.path, f.qualname, allowlist)
+        if listed is not None:
+            f.suppressed = True
+            f.suppression = listed
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(targets: Sequence[str],
+                  rules: Optional[Iterable[str]] = None,
+                  allowlist: Optional[Dict[str, Tuple[AllowEntry, ...]]]
+                  = None) -> List[Finding]:
+    """Analyze every python file under each target path."""
+    findings: List[Finding] = []
+    for target in targets:
+        for path in iter_python_files(target):
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            # report paths relative to the invocation dir when possible
+            # (editor-clickable, stable across machines)
+            rel = os.path.relpath(path)
+            display = path if rel.startswith("..") else rel
+            findings.extend(analyze_source(source, display,
+                                           rules=rules,
+                                           allowlist=allowlist))
+    return findings

@@ -171,6 +171,24 @@ class LocalEngine:
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
 
+    # Locks and thread pools don't pickle; frames normally drop their
+    # engine before shipping (frame.Source pickles engine=None), but an
+    # engine reachable through any other closure must survive the wire
+    # the same way — fresh pool, fresh lock, zero in-flight state on
+    # arrival (the sparkdl-lint H3 contract).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_pool"]
+        del state["_device_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.num_workers,
+            thread_name_prefix="sparkdl-tpu-host")
+        self._device_lock = threading.Lock()
+
     def _run_stage(self, stage, batch, index, timings) -> pa.RecordBatch:
         if timings is None:
             return (stage.fn(batch, index) if stage.with_index
@@ -335,8 +353,13 @@ class LocalEngine:
                         if not fut.cancelled():
                             try:
                                 fut.result()
-                            except Exception:
-                                pass  # primary error already propagated
+                            except Exception as drain_err:
+                                # the primary error is already
+                                # propagating; record the secondary
+                                # one instead of masking the drain
+                                logger.debug(
+                                    "quiesce drain error: %s",
+                                    drain_err)
 
         return _gen()
 
@@ -404,8 +427,11 @@ class LocalEngine:
                     if not fut.cancelled():
                         try:
                             fut.result()
-                        except Exception:
-                            pass  # the primary error already propagated
+                        except Exception as drain_err:
+                            # primary error already propagating;
+                            # record, don't mask the drain outcome
+                            logger.debug("quiesce drain error: %s",
+                                         drain_err)
 
     def _stream_rechunk(self, stream, stage, inflight_box=None,
                         max_hint=None):

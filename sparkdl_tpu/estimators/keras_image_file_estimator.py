@@ -33,6 +33,7 @@ import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.parallel.mesh import collective_launch
 from sparkdl_tpu.params import (
     CanLoadImage,
     HasBatchSize,
@@ -387,7 +388,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         targets = self._prepare_targets(y, est.getKerasLoss(), n_out)
 
         step = _make_step(model, loss_fn, tx)
-        jitted, batch_size, _ = est._compile_step(step, batch_size)
+        jitted, batch_size, mesh = est._compile_step(step, batch_size)
+        # the step's gradient all-reduce makes this a collective
+        # program: concurrent trials must not interleave their
+        # per-device launches (parallel/mesh.py::collective_launch)
+        launch = collective_launch(mesh)
 
         n = len(X)
         if n == 0:
@@ -437,24 +442,35 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             losses = []
             for s in range(steps_per_epoch):
                 sel = order[s * batch_size:(s + 1) * batch_size]
-                trainable, non_trainable, opt_state, loss = jitted(
-                    trainable, non_trainable, opt_state,
-                    jnp.asarray(X[sel]), jnp.asarray(targets[sel]))
+                # stage the batch OUTSIDE the launch lock (the lock
+                # covers only the collective program's dispatch, so
+                # concurrent trials overlap host work with it)
+                xb, yb = jnp.asarray(X[sel]), jnp.asarray(targets[sel])
+                with launch:
+                    trainable, non_trainable, opt_state, loss = jitted(
+                        trainable, non_trainable, opt_state, xb, yb)
                 losses.append(loss)
+            # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
+            # epoch's async step chain must land before loss history
             history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
                 checkpointer.save(
                     len(history),
-                    {"trainable": jax.device_get(trainable),
-                     "non_trainable": jax.device_get(non_trainable),
-                     "opt_state": jax.device_get(opt_state),
+                    # sparkdl-lint: allow[H1] -- checkpoint snapshot:
+                    # saved state must be host bytes, synced at the
+                    # epoch boundary (not on the step path)
+                    {"trainable": jax.device_get(trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
+                     "non_trainable": jax.device_get(non_trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
+                     "opt_state": jax.device_get(opt_state),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
                      "history": np.asarray(history, np.float64)})
         if checkpointer is not None:
             checkpointer.close()
 
         trained = {
-            "trainable": jax.device_get(trainable),
-            "non_trainable": jax.device_get(non_trainable),
+            # sparkdl-lint: allow[H1] -- end-of-fit drain: the trained
+            # weights leave the device exactly once, here
+            "trainable": jax.device_get(trainable),  # sparkdl-lint: allow[H1] -- end-of-fit drain
+            "non_trainable": jax.device_get(non_trainable),  # sparkdl-lint: allow[H1] -- end-of-fit drain
         }
         mf = self._as_model_function(model, trained)
         return KerasImageFileModel(
@@ -809,6 +825,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         n_out = int(model.outputs[0].shape[-1])
         step = _make_step(model, loss_fn, tx)
         jitted, batch_size, mesh = est._compile_step(step, batch_size)
+        # collective program (gradient all-reduce): concurrent trials in
+        # THIS process must launch it in one global order
+        # (parallel/mesh.py::collective_launch); across processes
+        # fitMultiple already serializes trials
+        launch = collective_launch(mesh)
 
         if multihost:
             from sparkdl_tpu.parallel.mesh import data_sharding, replicated
@@ -907,9 +928,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     est.getKerasLoss(), epoch_seeds[epoch], shuffle,
                     num_steps=steps_per_epoch):
                 gx, gy = place(xb, yb)
-                trainable, non_trainable, opt_state, loss = jitted(
-                    trainable, non_trainable, opt_state, gx, gy)
+                with launch:
+                    trainable, non_trainable, opt_state, loss = jitted(
+                        trainable, non_trainable, opt_state, gx, gy)
                 losses.append(loss)
+            # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
+            # epoch's async step chain must land before loss history
             history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
                 # live arrays, not device_get copies: jax arrays are
@@ -928,8 +952,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             checkpointer.close()
 
         trained = {
-            "trainable": jax.device_get(trainable),
-            "non_trainable": jax.device_get(non_trainable),
+            # sparkdl-lint: allow[H1] -- end-of-fit drain: the trained
+            # weights leave the device exactly once, here
+            "trainable": jax.device_get(trainable),  # sparkdl-lint: allow[H1] -- end-of-fit drain
+            "non_trainable": jax.device_get(non_trainable),  # sparkdl-lint: allow[H1] -- end-of-fit drain
         }
         mf = self._as_model_function(model, trained)
         return KerasImageFileModel(

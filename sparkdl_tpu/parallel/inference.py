@@ -21,7 +21,13 @@ import numpy as np
 from jax.sharding import Mesh
 
 from sparkdl_tpu.graph.function import ModelFunction
-from sparkdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from sparkdl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshSpec,
+    collective_launch,
+    make_mesh,
+)
 from sparkdl_tpu.runtime.runner import (
     CopyCounters,
     PadStaging,
@@ -34,6 +40,7 @@ from sparkdl_tpu.runtime.runner import (
     empty_jax_outputs,
     iter_padded_chunks,
 )
+from sparkdl_tpu.runtime.sanitize import ship_guard
 
 
 class ShardedBatchRunner:
@@ -70,6 +77,36 @@ class ShardedBatchRunner:
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
         # persistent pad staging (BatchRunner's checkout discipline):
         # concurrent run() calls fall back to a throwaway stager
+        self._staging = PadStaging()
+        self._staging_lock = threading.Lock()
+
+    # Locks, warm staging buffers, and the mesh's device handles are
+    # process-local; a runner captured in a stage closure ships to
+    # Spark executors (spark_binding) — drop them on the wire and
+    # rebuild on arrival, the same discipline as BatchRunner /
+    # RunnerMetrics. The mesh's AXIS STRUCTURE (its model-axis width)
+    # does ship: the receiving process re-derives devices from ITS
+    # local topology but keeps the parallelism layout, so a
+    # model-parallel runner stays model-parallel (a host whose device
+    # count can't satisfy the layout fails loudly in MeshSpec.resolve
+    # rather than silently collapsing to pure DP). preferred_chunk may
+    # legitimately differ across hosts — each sizes global batches by
+    # its own data-axis width.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_staging", None)
+        state.pop("_staging_lock", None)
+        state.pop("mesh", None)
+        state.pop("_global_batch", None)
+        state["_mesh_model_axis"] = self.mesh.shape[MODEL_AXIS]
+        return state
+
+    def __setstate__(self, state):
+        model_axis = state.pop("_mesh_model_axis", 1)
+        self.__dict__.update(state)
+        self.mesh = make_mesh(MeshSpec(data=-1, model=model_axis),
+                              devices=jax.local_devices())
+        self._global_batch = self.batch_size * self.mesh.shape[DATA_AXIS]
         self._staging = PadStaging()
         self._staging_lock = threading.Lock()
 
@@ -121,10 +158,20 @@ class ShardedBatchRunner:
             chunks = iter_padded_chunks(inputs, n, self._global_batch,
                                         staging, counters)
             # the shared dispatch state machine (runtime/runner.py),
-            # with the mesh's data sharding for prefetched chunks
-            batches = dispatch_chunks(fn, params, chunks, self.strategy,
-                                      self.max_inflight, sink,
-                                      place=place, sharding=dat)
+            # with the mesh's data sharding for prefetched chunks;
+            # SPARKDL_TPU_SANITIZE=1 arms transfer_guard around it
+            # (runtime/sanitize.py — explicit place/drain stay legal).
+            # A model-parallel program carries collectives, so its
+            # launches must not interleave with another thread's
+            # (parallel/mesh.py::collective_launch); the pure-DP
+            # forward has no cross-device edges and stays lock-free.
+            launch = collective_launch(
+                self.mesh if self.mesh.shape[MODEL_AXIS] > 1 else None)
+            with launch, ship_guard():
+                batches = dispatch_chunks(fn, params, chunks,
+                                          self.strategy,
+                                          self.max_inflight, sink,
+                                          place=place, sharding=dat)
         finally:
             if locked:
                 self._staging_lock.release()

@@ -10,7 +10,9 @@ by the cluster manager; here it is an explicit, testable object
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -19,6 +21,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# Threads launching collective (multi-device) programs onto the same
+# local devices must enqueue them in ONE global order: each device's
+# execution queue is FIFO, so if thread A's program lands on device 0
+# ahead of thread B's but behind it on device 1, A's all-reduce waits
+# for device 1 (busy running B) while B's waits for device 0 (busy
+# running A) — both stall forever. This is the single-process analogue
+# of the multi-host launch-order rule fitMultiple enforces by
+# serializing trials across processes. In-process launchers take this
+# lock around the DISPATCH only; execution stays async, so concurrent
+# trials still overlap device compute with host work.
+_COLLECTIVE_LAUNCH_LOCK = threading.Lock()
+
+
+def collective_launch(mesh: Optional[Mesh]):
+    """Context manager for dispatching one program compiled against
+    ``mesh``: the process-wide launch lock when the program spans more
+    than one device (collectives possible), a no-op otherwise."""
+    if mesh is None or mesh.size <= 1:
+        return contextlib.nullcontext()
+    return _COLLECTIVE_LAUNCH_LOCK
 
 
 @dataclasses.dataclass(frozen=True)

@@ -59,6 +59,14 @@ pipeline throughput):
 Host-backend ModelFunctions (ingested TF SavedModels — see
 ``graph/ingest.py``) run synchronously on CPU, unpadded, exactly where
 the reference ran them.
+
+The copy discipline is ENFORCED, not just measured: statically by
+sparkdl-lint (``python -m sparkdl_tpu.analysis``, rule H1 — no host
+sync outside the allowlisted drain path) and dynamically by
+``SPARKDL_TPU_SANITIZE=1``, which arms ``jax.transfer_guard`` around
+the dispatch/drain loop below (``runtime/sanitize.py``) so any
+implicit device→host transfer a future refactor sneaks in raises at
+the offending line instead of silently re-serializing the ship path.
 """
 
 from __future__ import annotations
@@ -75,6 +83,7 @@ import jax
 import numpy as np
 
 from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.runtime.sanitize import ship_guard
 
 # In-flight device batches before the oldest result is fetched, for the
 # "deferred" strategy. 2 = classic double-buffering (one executing, one
@@ -467,6 +476,13 @@ class RunnerMetrics:
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
+    # sparkdl-lint H3 contract: one metrics object is shared by
+    # concurrent run() calls (the concurrent-transform safety test
+    # drives four threads through one runner) — every write to these
+    # counters must hold self._lock, and the analyzer checks it.
+    _lock_guards = ("rows", "batches", "seconds", "bytes_staged",
+                    "bytes_copied", "transfer_wait_seconds")
+
     def add(self, rows: int, batches: int, seconds: float,
             bytes_staged: int = 0, bytes_copied: int = 0,
             transfer_wait_seconds: float = 0.0):
@@ -607,8 +623,12 @@ class BatchRunner:
         try:
             chunks = iter_padded_chunks(inputs, n, self.batch_size,
                                         staging, counters)
-            dispatch_chunks(fn, params, chunks, self.strategy,
-                            self.max_inflight, sink)
+            # SPARKDL_TPU_SANITIZE=1: transfer_guard turns any
+            # implicit device→host sync inside dispatch/drain into an
+            # error (the sink's explicit device_get stays legal)
+            with ship_guard():
+                dispatch_chunks(fn, params, chunks, self.strategy,
+                                self.max_inflight, sink)
         finally:
             if locked:
                 self._staging_lock.release()

@@ -52,6 +52,20 @@ class StageMetrics:
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
+    # Locks don't pickle; an engine carrying metrics can ship inside a
+    # stage closure (spark_binding) — drop the lock on the wire and
+    # recreate on arrival, like RunnerMetrics. Counts collected on the
+    # remote side stay remote (same boundary as RunnerMetrics: driver
+    # metrics are a LocalEngine feature).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def add(self, stage_name: str, seconds: float, rows: int):
         with self._lock:
             st = self._stats.setdefault(stage_name, _StageStat())

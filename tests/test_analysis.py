@@ -1,0 +1,523 @@
+"""sparkdl-lint (sparkdl_tpu.analysis) + runtime sanitizer tests.
+
+Per rule: a positive fixture (deliberately broken code trips it), a
+negative fixture (idiomatic clean code passes), and a suppressed
+fixture (inline annotation downgrades without hiding). Plus the
+meta-test: the shipped package itself must analyze to ZERO unsuppressed
+findings — the gate tools/ci.sh step [5/5] enforces, pinned here so a
+regressing module fails the suite before it fails CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import sparkdl_tpu
+from sparkdl_tpu.analysis import (
+    DEFAULT_ALLOWLIST,
+    analyze_paths,
+    analyze_source,
+    format_findings,
+)
+
+PKG_DIR = os.path.dirname(os.path.abspath(sparkdl_tpu.__file__))
+
+
+def _hits(source, rule, path="fixture.py"):
+    return [f for f in analyze_source(source, path)
+            if f.rule == rule and not f.suppressed]
+
+
+def _suppressed(source, rule, path="fixture.py"):
+    return [f for f in analyze_source(source, path)
+            if f.rule == rule and f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# H1 — implicit host transfers
+
+
+class TestH1Transfers:
+    def test_device_get_trips(self):
+        hits = _hits("import jax\n"
+                     "def ship(res):\n"
+                     "    return jax.device_get(res)\n", "H1")
+        assert len(hits) == 1
+        assert hits[0].line == 3
+        assert "device_get" in hits[0].message
+        assert hits[0].qualname == "ship"
+
+    def test_block_until_ready_trips(self):
+        hits = _hits("def wait(arr):\n"
+                     "    arr.block_until_ready()\n", "H1")
+        assert len(hits) == 1
+
+    def test_np_asarray_on_jnp_call_trips(self):
+        hits = _hits("import numpy as np\n"
+                     "import jax.numpy as jnp\n"
+                     "def f(x):\n"
+                     "    return np.asarray(jnp.dot(x, x))\n", "H1")
+        assert len(hits) == 1
+
+    def test_np_asarray_on_host_value_clean(self):
+        assert _hits("import numpy as np\n"
+                     "def f(rows):\n"
+                     "    return np.asarray(rows)\n", "H1") == []
+
+    def test_trailing_suppression(self):
+        src = ("import jax\n"
+               "def drain(res):\n"
+               "    return jax.device_get(res)"
+               "  # sparkdl-lint: allow[H1] -- test drain\n")
+        assert _hits(src, "H1") == []
+        sup = _suppressed(src, "H1")
+        assert len(sup) == 1
+        assert "test drain" in sup[0].suppression
+
+    def test_standalone_suppression_covers_next_line(self):
+        src = ("import jax\n"
+               "def drain(res):\n"
+               "    # sparkdl-lint: allow[H1] -- standalone note\n"
+               "    return jax.device_get(res)\n")
+        assert _hits(src, "H1") == []
+        assert len(_suppressed(src, "H1")) == 1
+
+    def test_wrong_rule_suppression_does_not_apply(self):
+        src = ("import jax\n"
+               "def drain(res):\n"
+               "    return jax.device_get(res)"
+               "  # sparkdl-lint: allow[H2] -- wrong rule\n")
+        assert len(_hits(src, "H1")) == 1
+
+    def test_allowlist_scopes_by_qualname(self):
+        src = ("import jax\n"
+               "class SlabSink:\n"
+               "    def write(self, valid, res):\n"
+               "        return jax.device_get(res)\n"
+               "    def other(self, res):\n"
+               "        return jax.device_get(res)\n")
+        found = analyze_source(
+            src, "sparkdl_tpu/runtime/runner.py",
+            allowlist=DEFAULT_ALLOWLIST)
+        by_qual = {f.qualname: f.suppressed for f in found
+                   if f.rule == "H1"}
+        assert by_qual["SlabSink.write"] is True
+        assert by_qual["SlabSink.other"] is False
+
+
+# ---------------------------------------------------------------------------
+# H2 — jit/retrace hazards
+
+
+class TestH2Retrace:
+    def test_time_call_in_jitted_decorator(self):
+        hits = _hits("import jax, time\n"
+                     "@jax.jit\n"
+                     "def step(x):\n"
+                     "    t = time.perf_counter()\n"
+                     "    return x * t\n", "H2")
+        assert len(hits) == 1
+        assert "trace" in hits[0].message.lower()
+
+    def test_print_in_jit_call_form_named_fn(self):
+        hits = _hits("import jax\n"
+                     "def step(x):\n"
+                     "    print(x)\n"
+                     "    return x\n"
+                     "jitted = jax.jit(step)\n", "H2")
+        assert len(hits) == 1
+
+    def test_np_random_in_partial_jit(self):
+        hits = _hits("import jax\n"
+                     "import numpy as np\n"
+                     "from functools import partial\n"
+                     "@partial(jax.jit, donate_argnums=(0,))\n"
+                     "def step(x):\n"
+                     "    return x + np.random.rand()\n", "H2")
+        assert len(hits) == 1
+
+    def test_jax_random_is_clean(self):
+        assert _hits("import jax\n"
+                     "@jax.jit\n"
+                     "def step(key, x):\n"
+                     "    return x + jax.random.normal(key, x.shape)\n",
+                     "H2") == []
+
+    def test_unjitted_time_is_clean(self):
+        assert _hits("import time\n"
+                     "def outer():\n"
+                     "    return time.perf_counter()\n", "H2") == []
+
+    def test_unhashable_static_argnums(self):
+        hits = _hits("import jax\n"
+                     "def f(x, n):\n"
+                     "    return x\n"
+                     "jitted = jax.jit(f, static_argnums=[1])\n", "H2")
+        assert len(hits) == 1
+        assert "static" in hits[0].message
+
+    def test_tuple_static_argnums_clean(self):
+        assert _hits("import jax\n"
+                     "def f(x, n):\n"
+                     "    return x\n"
+                     "jitted = jax.jit(f, static_argnums=(1,))\n",
+                     "H2") == []
+
+    def test_suppressed(self):
+        src = ("import jax, time\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    t = time.time()"
+               "  # sparkdl-lint: allow[H2] -- trace-time stamp wanted\n"
+               "    return x * t\n")
+        assert _hits(src, "H2") == []
+        assert len(_suppressed(src, "H2")) == 1
+
+
+# ---------------------------------------------------------------------------
+# H3 — concurrency discipline
+
+
+class TestH3Concurrency:
+    def test_lock_without_getstate_trips(self):
+        hits = _hits("import threading\n"
+                     "class Runner:\n"
+                     "    def __init__(self):\n"
+                     "        self._lock = threading.Lock()\n", "H3")
+        assert len(hits) == 1
+        assert "__getstate__" in hits[0].message
+
+    def test_dataclass_field_lock_trips(self):
+        hits = _hits("import threading\n"
+                     "from dataclasses import dataclass, field\n"
+                     "@dataclass\n"
+                     "class Metrics:\n"
+                     "    rows: int = 0\n"
+                     "    _lock: threading.Lock = field(\n"
+                     "        default_factory=threading.Lock)\n", "H3")
+        assert len(hits) == 1
+
+    def test_lock_with_getstate_clean(self):
+        assert _hits("import threading\n"
+                     "class Runner:\n"
+                     "    def __init__(self):\n"
+                     "        self._lock = threading.Lock()\n"
+                     "    def __getstate__(self):\n"
+                     "        s = self.__dict__.copy()\n"
+                     "        del s['_lock']\n"
+                     "        return s\n", "H3") == []
+
+    def test_class_body_lock_exempt(self):
+        # class attributes aren't pickled per-instance
+        assert _hits("import threading\n"
+                     "class Manifest:\n"
+                     "    _lock = threading.Lock()\n", "H3") == []
+
+    def test_guarded_write_outside_lock_trips(self):
+        src = ("import threading\n"
+               "class Metrics:\n"
+               "    _lock_guards = ('rows',)\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.rows = 0\n"          # __init__ exempt
+               "    def __getstate__(self):\n"
+               "        return {}\n"
+               "    def add(self, n):\n"
+               "        self.rows += n\n")        # unlocked write
+        hits = _hits(src, "H3")
+        assert len(hits) == 1
+        assert hits[0].line == 10
+        assert "_lock_guards" in hits[0].message
+
+    def test_guarded_write_inside_lock_clean(self):
+        assert _hits("import threading\n"
+                     "class Metrics:\n"
+                     "    _lock_guards = ('rows',)\n"
+                     "    def __init__(self):\n"
+                     "        self._lock = threading.Lock()\n"
+                     "        self.rows = 0\n"
+                     "    def __getstate__(self):\n"
+                     "        return {}\n"
+                     "    def add(self, n):\n"
+                     "        with self._lock:\n"
+                     "            self.rows += n\n", "H3") == []
+
+    def test_suppressed(self):
+        src = ("import threading\n"
+               "# sparkdl-lint: allow[H3] -- never ships to executors\n"
+               "class Local:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n")
+        assert _hits(src, "H3") == []
+        assert len(_suppressed(src, "H3")) == 1
+
+
+# ---------------------------------------------------------------------------
+# H4 — quiesce hygiene
+
+
+class TestH4Quiesce:
+    def test_bare_except_trips(self):
+        hits = _hits("def load():\n"
+                     "    try:\n"
+                     "        return open('x')\n"
+                     "    except:\n"
+                     "        return None\n", "H4")
+        assert len(hits) == 1
+        assert "bare" in hits[0].message
+
+    def test_swallow_in_finally_trips(self):
+        hits = _hits("def run(pending):\n"
+                     "    try:\n"
+                     "        yield 1\n"
+                     "    finally:\n"
+                     "        for fut in pending:\n"
+                     "            try:\n"
+                     "                fut.result()\n"
+                     "            except Exception:\n"
+                     "                pass\n", "H4")
+        assert len(hits) == 1
+        assert "swallow" in hits[0].message
+
+    def test_swallow_in_close_trips(self):
+        hits = _hits("class Src:\n"
+                     "    def close(self):\n"
+                     "        try:\n"
+                     "            self.f.close()\n"
+                     "        except OSError:\n"
+                     "            pass\n", "H4")
+        assert len(hits) == 1
+
+    def test_logged_handler_clean(self):
+        assert _hits("import logging\n"
+                     "def close(f):\n"
+                     "    try:\n"
+                     "        f.close()\n"
+                     "    except OSError as e:\n"
+                     "        logging.debug('close: %s', e)\n",
+                     "H4") == []
+
+    def test_swallow_outside_cleanup_clean(self):
+        # a probe in a hot-path helper may legitimately swallow
+        assert _hits("def probe(x):\n"
+                     "    try:\n"
+                     "        return x.copy_to_host_async()\n"
+                     "    except NotImplementedError:\n"
+                     "        pass\n", "H4") == []
+
+    def test_suppressed(self):
+        src = ("def close(f):\n"
+               "    try:\n"
+               "        f.close()\n"
+               "    # sparkdl-lint: allow[H4] -- double-close is fine\n"
+               "    except OSError:\n"
+               "        pass\n")
+        assert _hits(src, "H4") == []
+        assert len(_suppressed(src, "H4")) == 1
+
+
+# ---------------------------------------------------------------------------
+# walker / CLI / formatter
+
+
+class TestHarness:
+    def test_syntax_error_reports_parse_finding(self):
+        found = analyze_source("def broken(:\n", "bad.py")
+        assert [f.rule for f in found] == ["PARSE"]
+        assert not found[0].suppressed
+
+    def test_format_text_has_path_line_col(self):
+        found = analyze_source(
+            "import jax\nx = jax.device_get(1)\n", "mod.py")
+        text = format_findings(found)
+        assert text.startswith("mod.py:2:")
+
+    def test_format_json(self):
+        found = analyze_source(
+            "import jax\nx = jax.device_get(1)\n", "mod.py")
+        d = json.loads(format_findings(found, fmt="json"))
+        assert d["unsuppressed"] == 1
+        assert d["findings"][0]["rule"] == "H1"
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\nx = jax.device_get(1)\n")
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        env = {**os.environ,
+               "PYTHONPATH": os.path.dirname(PKG_DIR)}
+        r = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.analysis", str(bad)],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 1
+        assert "H1" in r.stdout
+        r = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.analysis", str(ok)],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 0
+
+    def test_meta_package_is_clean(self):
+        """THE gate: the shipped package analyzes to zero unsuppressed
+        findings — every legitimate drain/swallow carries an inline
+        justification or a scoped allowlist entry."""
+        found = analyze_paths([PKG_DIR])
+        unsuppressed = [f for f in found if not f.suppressed]
+        assert unsuppressed == [], format_findings(unsuppressed)
+        # and the suppressions that exist all carry a justification
+        for f in found:
+            if f.suppressed:
+                assert f.suppression, f.render()
+
+    def test_meta_known_drains_are_suppressed_not_invisible(self):
+        """The drain path is allowlisted, not skipped: SlabSink.write's
+        device_get must APPEAR as a suppressed finding."""
+        found = analyze_paths([PKG_DIR])
+        quals = {f.qualname for f in found
+                 if f.rule == "H1" and f.suppressed}
+        assert "SlabSink.write" in quals
+
+
+# ---------------------------------------------------------------------------
+# the real findings the first analyzer run surfaced — pinned fixed
+
+
+class TestFirstRunFindingsFixed:
+    """H3 hits from the analyzer's first pass over the repo: three
+    lock-holding classes with no pickle hooks. Spark ships stage
+    closures with cloudpickle; each must survive the wire."""
+
+    def test_sharded_runner_ships(self):
+        import cloudpickle as cp
+        from sparkdl_tpu.graph.function import ModelFunction
+        from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+        mf = ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                      input_shape=(3,))
+        r = cp.loads(cp.dumps(ShardedBatchRunner(mf, batch_size=1)))
+        n = r.preferred_chunk  # re-derived from local devices
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+
+    def test_local_engine_ships(self):
+        import cloudpickle as cp
+        from sparkdl_tpu.data.engine import LocalEngine
+        e = cp.loads(cp.dumps(LocalEngine(num_workers=2)))
+        assert list(e.execute([], [])) == []
+        e.shutdown()
+
+    def test_stage_metrics_ships(self):
+        import cloudpickle as cp
+        from sparkdl_tpu.utils.profiling import StageMetrics
+        m = StageMetrics()
+        m.add("decode", 0.5, 10)
+        m2 = cp.loads(cp.dumps(m))
+        m2.add("decode", 0.5, 10)
+        assert m2.as_dict()["decode"]["rows"] == 20
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+
+
+class TestSanitizer:
+    def _model_and_input(self):
+        from sparkdl_tpu.graph.function import ModelFunction
+        mf = ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                      input_shape=(3,))
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        return mf, x
+
+    def test_aligned_run_sanitized_matches_unsanitized(self, monkeypatch):
+        from sparkdl_tpu.runtime.runner import BatchRunner, RunnerMetrics
+        mf, x = self._model_and_input()
+        monkeypatch.delenv("SPARKDL_TPU_SANITIZE", raising=False)
+        base = BatchRunner(mf, batch_size=4).run({"input": x})["output"]
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        m = RunnerMetrics()
+        out = BatchRunner(mf, batch_size=4, metrics=m).run(
+            {"input": x})["output"]
+        np.testing.assert_array_equal(base, out)
+        # the aligned zero-copy contract holds under the guard
+        assert m.bytes_staged == 0
+        assert m.bytes_copied == 0
+
+    @pytest.mark.parametrize("strategy", ["immediate", "deferred",
+                                          "host_async", "prefetch"])
+    def test_every_strategy_completes_sanitized(self, monkeypatch,
+                                                strategy):
+        from sparkdl_tpu.runtime.runner import BatchRunner
+        mf, x = self._model_and_input()
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        out = BatchRunner(mf, batch_size=4, strategy=strategy).run(
+            {"input": x})["output"]
+        np.testing.assert_allclose(out, x * 2)
+
+    def test_tail_run_sanitized(self, monkeypatch):
+        from sparkdl_tpu.runtime.runner import BatchRunner
+        mf, x = self._model_and_input()
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        out = BatchRunner(mf, batch_size=4).run(
+            {"input": x[:7]})["output"]
+        np.testing.assert_allclose(out, x[:7] * 2)
+
+    def test_sharded_runner_sanitized(self, monkeypatch):
+        import jax
+        if len(jax.local_devices()) < 2:
+            pytest.skip("needs >1 device (ci.sh forces 8 virtual)")
+        from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+        mf, x = self._model_and_input()
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        runner = ShardedBatchRunner(mf, batch_size=1)
+        n = runner.preferred_chunk
+        xs = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        out = runner.run({"input": xs})["output"]
+        np.testing.assert_allclose(out, xs * 2)
+
+    def test_guard_arms_or_degrades_once(self, monkeypatch):
+        from sparkdl_tpu.runtime import sanitize
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        before = sanitize.armed_run_count()
+        with sanitize.ship_guard() as armed:
+            # jax>=0.4 has the API: the guard must actually arm
+            assert armed is True
+        # the armed counter is what bench.py's "sanitize" key reports —
+        # env-on alone must not count (degraded guard ≠ enforced)
+        assert sanitize.armed_run_count() == before + 1
+
+    def test_guard_off_by_default(self, monkeypatch):
+        from sparkdl_tpu.runtime import sanitize
+        monkeypatch.delenv("SPARKDL_TPU_SANITIZE", raising=False)
+        with sanitize.ship_guard() as armed:
+            assert armed is False
+
+    def test_degrades_with_single_warning_when_api_missing(
+            self, monkeypatch, caplog):
+        import jax
+        from sparkdl_tpu.runtime import sanitize
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        monkeypatch.setattr(sanitize, "_warned_no_guard", False)
+        monkeypatch.delattr(jax, "transfer_guard_device_to_host")
+        with caplog.at_level("WARNING",
+                             logger="sparkdl_tpu.runtime.sanitize"):
+            with sanitize.ship_guard() as armed:
+                assert armed is False
+            with sanitize.ship_guard() as armed:
+                assert armed is False
+        warnings = [r for r in caplog.records
+                    if "unguarded" in r.getMessage()]
+        assert len(warnings) == 1  # probe-and-degrade warns ONCE
+
+    def test_guard_blocks_implicit_transfer_when_backend_supports(
+            self, monkeypatch):
+        """On CPU, arrays are host-resident and a d2h guard has nothing
+        to catch — but the guard plumbing must still reject implicit
+        transfers wherever jax reports them. Exercise the context
+        directly: entering must not swallow real errors raised inside."""
+        from sparkdl_tpu.runtime import sanitize
+        monkeypatch.setenv("SPARKDL_TPU_SANITIZE", "1")
+        with pytest.raises(RuntimeError, match="boom"):
+            with sanitize.ship_guard():
+                raise RuntimeError("boom")

@@ -36,6 +36,54 @@ def test_mesh_shapes():
         MeshSpec(data=3, model=2).resolve(8)
 
 
+def test_collective_launch_lock_scoping():
+    """Multi-device mesh programs can carry collectives, so concurrent
+    in-process launchers must share ONE launch lock (interleaved
+    per-device enqueues from two threads deadlock the all-reduce —
+    the hang test_fit_multiple_parallel_trials used to hit); no mesh
+    or a 1-device mesh needs no lock at all."""
+    import threading
+
+    from sparkdl_tpu.parallel.mesh import collective_launch
+
+    multi = collective_launch(make_mesh())
+    assert isinstance(multi, type(threading.Lock()))
+    # one process-wide lock, not one per call
+    assert collective_launch(make_mesh()) is multi
+    single = collective_launch(
+        make_mesh(devices=jax.devices()[:1]))
+    assert not isinstance(single, type(threading.Lock()))
+    none = collective_launch(None)
+    with none:
+        pass  # usable as a context manager
+    # the lock is reusable across steps
+    with multi:
+        pass
+    with multi:
+        pass
+
+
+def test_sharded_runner_pickle_keeps_model_axis():
+    """Shipping a model-parallel runner must preserve the parallelism
+    LAYOUT: devices are re-derived on the receiving host, but the
+    model-axis width travels (a silent collapse to pure DP would
+    recompile the program against the wrong sharding)."""
+    import cloudpickle as cp
+
+    from sparkdl_tpu.graph.function import ModelFunction
+
+    mf = ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                  input_shape=(4,))
+    r = ShardedBatchRunner(mf, mesh=make_mesh(MeshSpec(data=-1, model=2)),
+                           batch_size=1)
+    r2 = cp.loads(cp.dumps(r))
+    assert r2.mesh.shape["model"] == 2
+    assert r2.mesh.shape["data"] == 4
+    n = r2.preferred_chunk
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    np.testing.assert_allclose(r2.run({"input": x})["output"], x * 2)
+
+
 def test_param_shardings_model_axis():
     mesh = make_mesh(MeshSpec(data=-1, model=2))
     params = {"w": jnp.zeros((6, 4)), "b": jnp.zeros((3,)),

@@ -14,11 +14,17 @@
 #      (SPARKDL_TPU_BENCH_TINY=1, TestNet, CPU) with a schema gate —
 #      a bench refactor that drops pipeline_bound_by, a ceiling key,
 #      or the host-copy counters fails HERE instead of failing the
-#      next TPU round's driver parse
+#      next TPU round's driver parse. Runs under
+#      SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard enforces the
+#      aligned ship path's zero-copy claim at runtime, not just in
+#      the counters.
+#   5. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
+#      H2 retrace, H3 locks, H4 quiesce) must report ZERO
+#      unsuppressed findings, plus the ruff baseline when installed
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
-# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4)
+# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4/5)
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -30,7 +36,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/4] native shim build =="
+echo "== [1/5] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -39,13 +45,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/4] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/5] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/4] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/5] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/4] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/5] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -54,8 +60,8 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/4] bench smoke (real bench.py, tiny shape, schema gate) =="
-SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
+echo "== [4/5] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
 python - <<'EOF'
 import json
 
@@ -75,7 +81,7 @@ required = [
     "host_decode_ips", "host_decode_ips_packed",
     "host_decode_ips_packed420",
     "pipeline_bound_by", "pipeline_stage_ceilings_ips",
-    "host_copy", "fidelity", "runner_strategy",
+    "host_copy", "fidelity", "runner_strategy", "sanitize",
 ]
 missing = [k for k in required if k not in d]
 assert not missing, f"bench smoke: missing JSON keys {missing}"
@@ -95,9 +101,15 @@ assert hc["aligned"]["bytes_staged"] == 0, hc["aligned"]
 assert d["pipeline_bound_by"] in ("decode", "link", "compute"), d
 assert set(d["pipeline_stage_ceilings_ips"]) == \
     {"decode", "link", "compute"}, d["pipeline_stage_ceilings_ips"]
+# step 4 exports SPARKDL_TPU_SANITIZE=1: the runners must have run
+# their ship path under the transfer guard (runtime/sanitize.py)
+assert d["sanitize"] is True, d.get("sanitize")
 print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "unit": d["unit"], "vs_baseline": d["vs_baseline"],
                   "schema": "ok"}))
 EOF
+
+echo "== [5/5] static analysis (sparkdl-lint + ruff baseline) =="
+tools/lint.sh sparkdl_tpu
 
 echo "== ci.sh: ALL GREEN =="

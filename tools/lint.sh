@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Static-analysis entry point: sparkdl-lint (the repo-specific
+# hot-path rules, docs/LINT.md) plus the generic ruff/mypy baseline
+# from pyproject.toml when those tools are installed (they are NOT
+# hard deps — the lint gate must be green from a fresh clone with no
+# network, so missing tools skip with a notice instead of failing).
+#
+# Usage: tools/lint.sh [paths...]        # default: sparkdl_tpu/
+# Exit: non-zero iff sparkdl-lint finds an unsuppressed finding or an
+#       installed ruff/mypy reports errors.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+targets=("${@:-sparkdl_tpu}")
+
+echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce) =="
+python -m sparkdl_tpu.analysis "${targets[@]}"
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff (pyproject baseline) =="
+  ruff check "${targets[@]}"
+else
+  echo "== ruff: not installed, skipped (pip install ruff to enable) =="
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+  echo "== mypy (pyproject baseline, loose) =="
+  mypy "${targets[@]}"
+else
+  echo "== mypy: not installed, skipped (pip install mypy to enable) =="
+fi
+
+echo "== lint.sh: GREEN =="
