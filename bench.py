@@ -54,6 +54,11 @@ attribute any gap to link vs compute.
 
 Sync methodology: ``jax.block_until_ready`` returns at enqueue on the
 tunneled platform, so timing forces a tiny dependent readback instead.
+
+The ``"obs"`` block carries the unified observability layer's output
+(docs/OBSERVABILITY.md): the metrics-registry snapshot always, plus
+the exported Perfetto trace path/span count when ``SPARKDL_TPU_TRACE=1``
+armed the run (``SPARKDL_TPU_TRACE_EXPORT`` names the path).
 """
 
 from __future__ import annotations
@@ -178,6 +183,10 @@ def measure_pipeline(mf, packed_src, batch_size: int,
             assert n == n_images, (n, n_images)
             rates.append(n / elapsed)
         m = t.metrics
+        # the measured pipeline's ship counters also land in the obs
+        # registry so the bench "obs" block carries them
+        from sparkdl_tpu.obs import default_registry
+        m.publish(default_registry())
         return {"ips": float(max(rates)),
                 "bytes_staged": int(m.bytes_staged),
                 "bytes_copied": int(m.bytes_copied),
@@ -490,6 +499,26 @@ def main() -> None:
                       "link": ceiling_420,
                       "compute": device["ips"]}
     pipeline_bound_by = min(stage_ceilings, key=stage_ceilings.get)
+
+    # unified observability (sparkdl_tpu/obs, docs/OBSERVABILITY.md):
+    # the registry snapshot always ships; when SPARKDL_TPU_TRACE=1
+    # armed the run, the span timeline exports as Perfetto trace-event
+    # JSON (SPARKDL_TPU_TRACE_EXPORT names the path) and ci.sh's obs
+    # gate schema-checks it (≥1 span per engine/ship/device lane)
+    from sparkdl_tpu.obs import default_registry, tracer
+    trc = tracer()
+    obs_block = {
+        "trace_armed": bool(trc.armed),
+        "trace_events": None,
+        "trace_export": None,
+        "trace_dropped": trc.dropped,
+        "registry": default_registry().snapshot(),
+    }
+    if trc.armed:
+        trace_path = os.environ.get("SPARKDL_TPU_TRACE_EXPORT",
+                                    "/tmp/sparkdl_tpu_trace.json")
+        obs_block["trace_events"] = trc.export(trace_path)
+        obs_block["trace_export"] = trace_path
     print(json.dumps({
         "metric": (f"images_per_sec_per_chip_testnet_featurize"
                    f"[{platform},tiny]" if BENCH_TINY else
@@ -570,6 +599,7 @@ def main() -> None:
         # degraded-guard backend report False — ci.sh's schema gate
         # then fails instead of certifying unenforced numbers.
         "sanitize": sanitize_enabled() and armed_run_count() > 0,
+        "obs": obs_block,
         "note": ("value IS the full measured pipeline (JPEG files -> "
                  "fused native DCT-prescaled decode/resize/pack to "
                  "planar YCbCr 4:2:0 (1.5 B/px, half the RGB payload; "

@@ -135,6 +135,11 @@ _PARTIAL_NAMES = {"partial", "functools.partial"}
 _H2_SIDE_EFFECT_PREFIXES = ("time.", "np.random.", "numpy.random.",
                             "random.")
 _H2_SIDE_EFFECT_CALLS = {"print", "input"}
+# obs tracing spans read the host wall clock (time.perf_counter) on
+# enter/exit — inside a traced function that happens ONCE, at trace
+# time, freezing compile-time timestamps into the program and recording
+# nothing per step. Matches `span(...)` and any `<obj>.span(...)`.
+_H2_TRACE_SPAN = "span"
 _STATIC_KWARGS = {"static_argnums", "static_argnames"}
 
 
@@ -180,6 +185,15 @@ class _H2SideEffects(ast.NodeVisitor):
                 "at TRACE time only (use jax.debug.print for per-step "
                 "output); suppress: `# sparkdl-lint: allow[H2] -- "
                 "<why>`"))
+        elif name and (name == _H2_TRACE_SPAN
+                       or name.endswith("." + _H2_TRACE_SPAN)):
+            self._flag(node, (
+                f"`{name}(...)` inside a jit-traced function: obs "
+                "spans read the host wall clock at TRACE time — the "
+                "compiled program would carry one frozen timestamp "
+                "and record nothing per step; trace around the jit "
+                "call, not inside it (suppress: `# sparkdl-lint: "
+                "allow[H2] -- <why>`)"))
         elif name and name.startswith(_H2_SIDE_EFFECT_PREFIXES):
             if name.startswith("time."):
                 why = ("reads trace-time wall clock, frozen into the "
@@ -494,8 +508,9 @@ _RULE_DOCS = {
           "(jax.device_get / .block_until_ready() / np.asarray over a "
           "jnp-producing call)",
     "H2": "jit/retrace hazards: trace-time side effects (time.*, "
-          "print, stateful RNG) inside jit/pjit-compiled functions; "
-          "mutable static_argnums/static_argnames literals",
+          "print, stateful RNG, obs tracing spans) inside "
+          "jit/pjit-compiled functions; mutable "
+          "static_argnums/static_argnames literals",
     "H3": "concurrency discipline: lock-holding classes need "
           "__getstate__/__reduce__; writes to _lock_guards-declared "
           "fields must hold self._lock",

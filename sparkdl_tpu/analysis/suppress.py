@@ -57,9 +57,11 @@ class AllowEntry:
 DEFAULT_ALLOWLIST: Dict[str, Tuple[AllowEntry, ...]] = {
     "H1": (
         AllowEntry(
-            "sparkdl_tpu/runtime/runner.py", "SlabSink.write",
-            "THE drain: every strategy funnels results to host through "
-            "this one device_get, timed into transfer_wait_seconds"),
+            "sparkdl_tpu/obs/trace.py", "timed_device_get",
+            "THE drain, relocated from SlabSink.write so the sync is "
+            "observable: every strategy funnels results to host "
+            "through this one device_get, spanned on the 'device' "
+            "lane and timed into transfer_wait_seconds"),
         AllowEntry(
             "sparkdl_tpu/utils/measure.py", "",
             "measurement tools: forcing + timing transfers is their "

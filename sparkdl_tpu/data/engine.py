@@ -34,6 +34,8 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
+from sparkdl_tpu.obs import span
+
 logger = logging.getLogger(__name__)
 
 
@@ -190,22 +192,27 @@ class LocalEngine:
         self._device_lock = threading.Lock()
 
     def _run_stage(self, stage, batch, index, timings) -> pa.RecordBatch:
-        if timings is None:
-            return (stage.fn(batch, index) if stage.with_index
-                    else stage.fn(batch))
-        import time
-        t0 = time.perf_counter()
-        out = (stage.fn(batch, index) if stage.with_index
-               else stage.fn(batch))
-        timings.append((stage.name, time.perf_counter() - t0,
-                        batch.num_rows))
-        return out
+        # every stage call lands on the tracer's "engine" lane
+        # (obs/trace.py — a no-op when SPARKDL_TPU_TRACE is unset)
+        with span(f"stage:{stage.name}", lane="engine",
+                  rows=batch.num_rows, kind=stage.kind):
+            if timings is None:
+                return (stage.fn(batch, index) if stage.with_index
+                        else stage.fn(batch))
+            import time
+            t0 = time.perf_counter()
+            out = (stage.fn(batch, index) if stage.with_index
+                   else stage.fn(batch))
+            timings.append((stage.name, time.perf_counter() - t0,
+                            batch.num_rows))
+            return out
 
     def _run_once(self, source, plan, index) -> pa.RecordBatch:
         # Buffer stage timings locally and flush only on success, so a
         # retried partition doesn't double-count its completed stages.
         timings = [] if self.stage_metrics is not None else None
-        batch = source.load()
+        with span("source.load", lane="engine", partition=index):
+            batch = source.load()
         for stage in plan:
             if stage.kind == "device":
                 with self._device_lock:
@@ -465,7 +472,8 @@ class LocalEngine:
                     n = head.num_rows
                 else:
                     n = total
-                chunk = _take_rows(in_frags, n)
+                with span("rechunk.cut", lane="engine", rows=n):
+                    chunk = _take_rows(in_frags, n)
                 in_rows -= n
                 total -= n
                 out = self._apply_stream_stage(stage, chunk, -1)

@@ -33,6 +33,7 @@ import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import span
 from sparkdl_tpu.parallel.mesh import collective_launch
 from sparkdl_tpu.params import (
     CanLoadImage,
@@ -431,38 +432,44 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     if shuffle:
                         rng.permutation(n)
 
-        for _ in range(start_epoch, epochs):
-            order = rng.permutation(n) if shuffle else np.arange(n)
-            # wrap indices so every step sees a full static-shape batch
-            # (XLA: no dynamic shapes; a padded+masked tail costs more
-            # than repeating a few rows at epoch boundaries); np.resize
-            # tiles the permutation as often as needed when batch_size > n
-            if n % batch_size:
-                order = np.resize(order, steps_per_epoch * batch_size)
-            losses = []
-            for s in range(steps_per_epoch):
-                sel = order[s * batch_size:(s + 1) * batch_size]
-                # stage the batch OUTSIDE the launch lock (the lock
-                # covers only the collective program's dispatch, so
-                # concurrent trials overlap host work with it)
-                xb, yb = jnp.asarray(X[sel]), jnp.asarray(targets[sel])
-                with launch:
-                    trainable, non_trainable, opt_state, loss = jitted(
-                        trainable, non_trainable, opt_state, xb, yb)
-                losses.append(loss)
-            # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
-            # epoch's async step chain must land before loss history
-            history.append(float(np.mean(jax.device_get(losses))))
-            if checkpointer is not None:
-                checkpointer.save(
-                    len(history),
-                    # sparkdl-lint: allow[H1] -- checkpoint snapshot:
-                    # saved state must be host bytes, synced at the
-                    # epoch boundary (not on the step path)
-                    {"trainable": jax.device_get(trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
-                     "non_trainable": jax.device_get(non_trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
-                     "opt_state": jax.device_get(opt_state),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
-                     "history": np.asarray(history, np.float64)})
+        for epoch in range(start_epoch, epochs):
+            with span("epoch", lane="estimator", epoch=epoch):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                # wrap indices so every step sees a full static-shape
+                # batch (XLA: no dynamic shapes; a padded+masked tail
+                # costs more than repeating a few rows at epoch
+                # boundaries); np.resize tiles the permutation as often
+                # as needed when batch_size > n
+                if n % batch_size:
+                    order = np.resize(order,
+                                      steps_per_epoch * batch_size)
+                losses = []
+                for s in range(steps_per_epoch):
+                    sel = order[s * batch_size:(s + 1) * batch_size]
+                    # stage the batch OUTSIDE the launch lock (the lock
+                    # covers only the collective program's dispatch, so
+                    # concurrent trials overlap host work with it)
+                    xb = jnp.asarray(X[sel])
+                    yb = jnp.asarray(targets[sel])
+                    with span("step", lane="estimator",
+                              rows=batch_size), launch:
+                        trainable, non_trainable, opt_state, loss = \
+                            jitted(trainable, non_trainable, opt_state,
+                                   xb, yb)
+                    losses.append(loss)
+                # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
+                # epoch's async step chain must land before loss history
+                history.append(float(np.mean(jax.device_get(losses))))
+                if checkpointer is not None:
+                    checkpointer.save(
+                        len(history),
+                        # sparkdl-lint: allow[H1] -- checkpoint snapshot:
+                        # saved state must be host bytes, synced at the
+                        # epoch boundary (not on the step path)
+                        {"trainable": jax.device_get(trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
+                         "non_trainable": jax.device_get(non_trainable),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
+                         "opt_state": jax.device_get(opt_state),  # sparkdl-lint: allow[H1] -- checkpoint snapshot
+                         "history": np.asarray(history, np.float64)})
         if checkpointer is not None:
             checkpointer.close()
 
@@ -922,19 +929,24 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                        rng.integers(0, 2**63 - 1, size=epochs)]
 
         for epoch in range(start_epoch, epochs):
-            losses = []
-            for xb, yb in self._epoch_stream(
-                    loaded_local, label_col, rows_per_step, n_out,
-                    est.getKerasLoss(), epoch_seeds[epoch], shuffle,
-                    num_steps=steps_per_epoch):
-                gx, gy = place(xb, yb)
-                with launch:
-                    trainable, non_trainable, opt_state, loss = jitted(
-                        trainable, non_trainable, opt_state, gx, gy)
-                losses.append(loss)
-            # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
-            # epoch's async step chain must land before loss history
-            history.append(float(np.mean(jax.device_get(losses))))
+            with span("epoch", lane="estimator", epoch=epoch,
+                      streaming=True):
+                losses = []
+                for xb, yb in self._epoch_stream(
+                        loaded_local, label_col, rows_per_step, n_out,
+                        est.getKerasLoss(), epoch_seeds[epoch], shuffle,
+                        num_steps=steps_per_epoch):
+                    gx, gy = place(xb, yb)
+                    with span("step", lane="estimator",
+                              rows=rows_per_step), launch:
+                        trainable, non_trainable, opt_state, loss = \
+                            jitted(trainable, non_trainable, opt_state,
+                                   gx, gy)
+                    losses.append(loss)
+                # sparkdl-lint: allow[H1] -- epoch-boundary drain: the
+                # epoch's async step chain must land before loss
+                # history
+                history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
                 # live arrays, not device_get copies: jax arrays are
                 # immutable and the step doesn't donate, so the async

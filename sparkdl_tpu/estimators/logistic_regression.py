@@ -15,6 +15,7 @@ from typing import List, Optional
 import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
+from sparkdl_tpu.obs import span
 from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
 from sparkdl_tpu.params.pipeline import Estimator, Model
 from sparkdl_tpu.params.shared import HasLabelCol
@@ -417,9 +418,11 @@ class LogisticRegression(Estimator, HasLabelCol):
             return optax.apply_updates(params, updates), opt_state, loss
 
         history = []
-        for _ in range(self.getOrDefault("maxIter")):
-            params, opt_state, loss = step(params, opt_state)
-            history.append(float(loss))
+        for it in range(self.getOrDefault("maxIter")):
+            with span("step", lane="estimator", iteration=it,
+                      rows=len(X)):
+                params, opt_state, loss = step(params, opt_state)
+                history.append(float(loss))
         return params, history
 
     def _run_streaming(self, dataset, feat: str, bs: int):
@@ -512,9 +515,11 @@ class LogisticRegression(Estimator, HasLabelCol):
                                 opt_state, loss)
 
                     step = _step
-                params, opt_state, loss = step(params, opt_state,
-                                               xb, yb, wb)
-                losses.append(float(loss))
+                with span("step", lane="estimator", rows=len(xb),
+                          streaming=True):
+                    params, opt_state, loss = step(params, opt_state,
+                                                   xb, yb, wb)
+                    losses.append(float(loss))
 
             for batch in frame.stream():
                 if batch.num_rows == 0:
@@ -585,22 +590,27 @@ class LogisticRegression(Estimator, HasLabelCol):
         n = len(X)
         rng = np.random.default_rng(self.getOrDefault("seed"))
         history = []
-        for _ in range(self.getOrDefault("maxIter")):
-            perm = rng.permutation(n)
-            losses = []
-            for lo in range(0, n, bs):
-                idx = perm[lo:lo + bs]
-                xb, yb = X[idx], onehot[idx]
-                wb = np.ones(len(idx), np.float32)
-                if len(idx) < bs:
-                    pad = bs - len(idx)
-                    xb = np.concatenate(
-                        [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
-                    yb = np.concatenate(
-                        [yb, np.zeros((pad,) + yb.shape[1:], yb.dtype)])
-                    wb = np.concatenate([wb, np.zeros(pad, np.float32)])
-                params, opt_state, loss = step(params, opt_state,
-                                               xb, yb, wb)
-                losses.append(float(loss))
-            history.append(float(np.mean(losses)))
+        for epoch in range(self.getOrDefault("maxIter")):
+            with span("epoch", lane="estimator", epoch=epoch):
+                perm = rng.permutation(n)
+                losses = []
+                for lo in range(0, n, bs):
+                    idx = perm[lo:lo + bs]
+                    xb, yb = X[idx], onehot[idx]
+                    wb = np.ones(len(idx), np.float32)
+                    if len(idx) < bs:
+                        pad = bs - len(idx)
+                        xb = np.concatenate(
+                            [xb,
+                             np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                        yb = np.concatenate(
+                            [yb,
+                             np.zeros((pad,) + yb.shape[1:], yb.dtype)])
+                        wb = np.concatenate(
+                            [wb, np.zeros(pad, np.float32)])
+                    with span("step", lane="estimator", rows=len(idx)):
+                        params, opt_state, loss = step(params, opt_state,
+                                                       xb, yb, wb)
+                        losses.append(float(loss))
+                history.append(float(np.mean(losses)))
         return params, history

@@ -1,0 +1,44 @@
+"""Unified pipeline observability: spans, a metrics registry, and
+Perfetto-exportable timelines across engine → ship → device.
+
+Three pieces (docs/OBSERVABILITY.md):
+
+* :mod:`sparkdl_tpu.obs.trace` — ``span(name, lane=...)`` recording
+  into one process-wide bounded ring buffer on a single clock, armed by
+  ``SPARKDL_TPU_TRACE=1`` (near-zero overhead disarmed), exported as
+  Chrome/Perfetto trace-event JSON;
+* :mod:`sparkdl_tpu.obs.registry` — named counters/gauges with ONE
+  ``snapshot() -> dict`` (bench's ``"obs"`` block, throughput_report);
+* :mod:`sparkdl_tpu.obs.report` — ``python -m sparkdl_tpu.obs report
+  <trace.json>``: per-lane busy %, top spans, stall breakdown.
+
+Import-light on purpose: nothing here pulls jax (the report CLI works
+on any machine); :func:`timed_device_get` imports it lazily at the
+drain.
+"""
+
+from sparkdl_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    default_registry,
+)
+from sparkdl_tpu.obs.trace import (
+    SpanRecord,
+    Tracer,
+    span,
+    timed_device_get,
+    tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "SpanRecord",
+    "Tracer",
+    "default_registry",
+    "span",
+    "timed_device_get",
+    "tracer",
+]

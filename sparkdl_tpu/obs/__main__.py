@@ -1,0 +1,5 @@
+import sys
+
+from sparkdl_tpu.obs.report import main
+
+sys.exit(main(sys.argv[1:]))

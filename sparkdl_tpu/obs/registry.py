@@ -1,0 +1,157 @@
+"""Process-wide metrics registry: named counters and gauges with ONE
+``snapshot() -> dict`` for bench/CI.
+
+The counters the pipeline already kept were siloed per object
+(``RunnerMetrics`` on each runner, ``StageMetrics`` on each engine) and
+the events that matter most to the link-bound north star — collective
+launch-lock waits, prefetch queue depth, strategy degrades, sanitizer
+arms — were counted nowhere. The registry is the single sink:
+
+* hot-path events record directly into :func:`default_registry`
+  (``collective.lock_wait_seconds``, ``ship.inflight`` /
+  ``ship.inflight_peak``, ``ship.degrade_events``,
+  ``sanitize.armed_runs`` / ``sanitize.degrade_events``);
+* the existing per-object metrics publish INTO a registry on demand
+  (``RunnerMetrics.publish`` → ``ship.*`` gauges,
+  ``StageMetrics.publish`` → ``engine.stage.*`` gauges), which is how
+  ``throughput_report`` and bench's ``"obs"`` block render — one
+  snapshot, no second bookkeeping path.
+
+Naming convention: dotted ``<lane>.<what>`` keys, lanes matching the
+tracer's (``engine`` / ``ship`` / ``device`` / ``estimator`` plus
+``collective`` / ``sanitize`` / ``obs``).
+
+Counters are monotonic accumulators (``add``); gauges are
+last-write-wins levels (``set``, plus ``set_max`` for high-water
+marks). Both are thread-safe and both follow the ``StageMetrics``
+pickle precedent: the lock drops on the wire and is recreated on
+arrival, values travel.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+
+class Counter:
+    """Monotonic named accumulator."""
+
+    # sparkdl-lint H3 contract: one counter is hit from every worker
+    # thread — writes to value hold self._lock
+    _lock_guards = ("value",)
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    # locks don't pickle; values travel (StageMetrics precedent)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+class Gauge:
+    """Last-write-wins named level (queue depth, cumulative totals
+    published from per-object metrics)."""
+
+    _lock_guards = ("value",)
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def set_max(self, value: float) -> None:
+        """High-water-mark update: keep the larger of current/new."""
+        with self._lock:
+            self.value = max(self.value, float(value))
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+class MetricsRegistry:
+    """Thread-safe name → Counter/Gauge table with one flat
+    ``snapshot()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Union[Counter, Gauge]] = {}
+
+    def counter(self, name: str) -> Counter:
+        """The counter registered under ``name`` (created on first
+        use). A name is one kind forever — re-requesting it as a gauge
+        raises instead of silently forking the metric."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name)
+            elif not isinstance(m, Counter):
+                raise TypeError(
+                    f"metric {name!r} is a {type(m).__name__}, "
+                    "requested as Counter")
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name)
+            elif not isinstance(m, Gauge):
+                raise TypeError(
+                    f"metric {name!r} is a {type(m).__name__}, "
+                    "requested as Gauge")
+            return m
+
+    def snapshot(self) -> Dict[str, float]:
+        """One flat {name: value} dict, sorted by name — the bench/CI
+        contract (and what ``throughput_report`` renders from)."""
+        with self._lock:
+            return {name: self._metrics[name].value
+                    for name in sorted(self._metrics)}
+
+    def clear(self) -> None:
+        """Drop every metric (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # locks don't pickle; the metric objects carry their own
+    # drop-and-recreate hooks, so values travel
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """THE process-wide registry the instrumented hot paths record
+    into."""
+    return _REGISTRY

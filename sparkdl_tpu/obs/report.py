@@ -1,0 +1,120 @@
+"""Critical-path summary of an exported trace.
+
+``python -m sparkdl_tpu.obs report <trace.json>`` reads a
+Chrome/Perfetto trace-event file (what ``Tracer.export`` writes — a
+bare event list, or a ``{"traceEvents": [...]}`` wrapper) and prints
+where the run's microseconds went without opening a UI:
+
+* per-lane busy % — the union of each lane's span intervals over the
+  run's wall span: a link-bound pipeline shows the ship lane near 100%
+  while engine/device idle, a decode-bound one the reverse;
+* top spans by total time — the aggregate cost of each span name;
+* stalls — the wait-shaped spans (``device_get``,
+  ``collective_lock_wait``, ``device_put``, ``pad_stage``) broken out,
+  because those are the seconds a perf PR can actually claw back.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+#: span names that are waits, not work — the claw-back targets
+STALL_NAMES = ("device_get", "collective_lock_wait", "device_put",
+               "pad_stage")
+
+
+def load_events(path: str) -> List[dict]:
+    """Read a trace-event file: a bare JSON list or the
+    ``{"traceEvents": [...]}`` wrapper both formats allow."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("traceEvents")
+    if not isinstance(data, list):
+        raise ValueError(
+            f"{path}: not a trace-event list (expected a JSON array "
+            "or {'traceEvents': [...]})")
+    return data
+
+
+def _merged_length(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals."""
+    total = 0.0
+    cur_lo = cur_hi = None
+    for lo, hi in sorted(intervals):
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                total += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        total += cur_hi - cur_lo
+    return total
+
+
+def summarize(events: Sequence[dict]) -> str:
+    """The text report (also unit-testable without the CLI)."""
+    lane_of_pid = {e["pid"]: e["args"]["name"] for e in events
+                   if e.get("ph") == "M"
+                   and e.get("name") == "process_name"}
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        return "(no spans in trace)"
+    t0 = min(e["ts"] for e in spans)
+    t1 = max(e["ts"] + e["dur"] for e in spans)
+    wall_us = max(t1 - t0, 1e-9)
+
+    by_lane: Dict[str, List[Tuple[float, float]]] = {}
+    by_name: Dict[Tuple[str, str], List[float]] = {}
+    for e in spans:
+        lane = lane_of_pid.get(e["pid"], e.get("cat", "?"))
+        by_lane.setdefault(lane, []).append(
+            (e["ts"], e["ts"] + e["dur"]))
+        by_name.setdefault((lane, e["name"]), []).append(e["dur"])
+
+    lines = [f"trace: {len(spans)} spans over {wall_us / 1e3:.3f} ms "
+             f"across lanes {', '.join(sorted(by_lane))}",
+             "",
+             "lane        busy_ms   busy%   spans"]
+    for lane in sorted(by_lane):
+        busy = _merged_length(by_lane[lane])
+        lines.append(f"{lane.ljust(10)}  {busy / 1e3:8.3f}  "
+                     f"{100.0 * busy / wall_us:5.1f}%  "
+                     f"{len(by_lane[lane]):5d}")
+
+    agg = sorted(((sum(durs), len(durs), max(durs), lane, name)
+                  for (lane, name), durs in by_name.items()),
+                 reverse=True)
+    lines += ["", "top spans by total time (lane/name, calls, "
+                  "total_ms, max_ms)"]
+    for total, calls, mx, lane, name in agg[:12]:
+        lines.append(f"  {lane}/{name}: {calls} calls, "
+                     f"{total / 1e3:.3f} ms total, {mx / 1e3:.3f} ms max")
+
+    stalls = [(total, calls, lane, name)
+              for total, calls, _mx, lane, name in agg
+              if any(name == s or name.startswith(s) for s in STALL_NAMES)]
+    lines += ["", "stalls (wait-shaped spans — the claw-back targets)"]
+    if stalls:
+        for total, calls, lane, name in stalls:
+            lines.append(f"  {lane}/{name}: {total / 1e3:.3f} ms over "
+                         f"{calls} calls ({100.0 * total / wall_us:.1f}% "
+                         "of wall)")
+    else:
+        lines.append("  (none recorded)")
+    return "\n".join(lines)
+
+
+def main(argv: Sequence[str]) -> int:
+    if len(argv) != 2 or argv[0] != "report":
+        print("usage: python -m sparkdl_tpu.obs report <trace.json>")
+        return 2
+    try:
+        events = load_events(argv[1])
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}")
+        return 2
+    print(summarize(events))
+    return 0

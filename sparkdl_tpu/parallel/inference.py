@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import span
 from sparkdl_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -167,7 +168,11 @@ class ShardedBatchRunner:
             # forward has no cross-device edges and stays lock-free.
             launch = collective_launch(
                 self.mesh if self.mesh.shape[MODEL_AXIS] > 1 else None)
-            with launch, ship_guard():
+            with span("runner.run_sharded", lane="ship", rows=n,
+                      strategy=self.strategy,
+                      mesh=f"{self.mesh.shape[DATA_AXIS]}x"
+                           f"{self.mesh.shape[MODEL_AXIS]}"), \
+                    launch, ship_guard():
                 batches = dispatch_chunks(fn, params, chunks,
                                           self.strategy,
                                           self.max_inflight, sink,

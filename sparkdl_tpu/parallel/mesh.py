@@ -13,11 +13,14 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkdl_tpu.obs import default_registry, span
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -35,13 +38,80 @@ MODEL_AXIS = "model"
 _COLLECTIVE_LAUNCH_LOCK = threading.Lock()
 
 
+class _CollectiveLaunch:
+    """The launch lock with its contention made visible: entering
+    times the acquire into a ``collective_lock_wait`` span (ship lane)
+    and the ``collective.*`` registry counters — the PR-2 deadlock
+    fix's serialization cost, previously unmeasurable. The span is
+    recorded on EVERY entry (dur ≈ 0 uncontended) so an armed trace
+    always shows the launch-ordering points; ``collective.lock_waits``
+    counts only genuinely contended acquires.
+
+    One instance wraps THE process lock; no per-entry state lives on
+    the instance, so concurrent threads enter the same object safely —
+    each blocks in ``acquire`` exactly as they did on the raw lock.
+    """
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+
+    def __enter__(self):
+        t0 = time.perf_counter()
+        held = False
+        # anything that raises WHILE the lock is held (span recording,
+        # a registry kind collision, an async KeyboardInterrupt) must
+        # release it before propagating — __exit__ never runs when
+        # __enter__ raises, and a leaked hold here deadlocks every
+        # future collective launch; hence both acquires sit inside the
+        # release-on-failure block
+        try:
+            held = self._lock.acquire(blocking=False)
+            contended = not held
+            with span("collective_lock_wait", lane="ship",
+                      contended=contended):
+                if contended:
+                    self._lock.acquire()
+                    held = True
+            wait = time.perf_counter() - t0
+            reg = default_registry()
+            reg.counter("collective.launches").add()
+            reg.counter("collective.lock_wait_seconds").add(wait)
+            if contended:
+                reg.counter("collective.lock_waits").add()
+            return self
+        except BaseException:
+            if held:
+                self._lock.release()
+            raise
+
+    def __exit__(self, exc_type, exc, tb):
+        self._lock.release()
+        return False
+
+    # The wrapped lock doesn't pickle, and the wrapper IS process-wide
+    # state: a closure that captured it deserializes to the RECEIVING
+    # process's singleton (whose lock guards that process's devices) —
+    # the H3 drop-and-recreate discipline, in __reduce__ form because
+    # identity, not field values, is what must survive the wire.
+    def __reduce__(self):
+        return (_collective_launch_singleton, ())
+
+
+_COLLECTIVE_LAUNCH = _CollectiveLaunch(_COLLECTIVE_LAUNCH_LOCK)
+
+
+def _collective_launch_singleton() -> _CollectiveLaunch:
+    return _COLLECTIVE_LAUNCH
+
+
 def collective_launch(mesh: Optional[Mesh]):
     """Context manager for dispatching one program compiled against
-    ``mesh``: the process-wide launch lock when the program spans more
-    than one device (collectives possible), a no-op otherwise."""
+    ``mesh``: the (instrumented) process-wide launch lock when the
+    program spans more than one device (collectives possible), a no-op
+    otherwise."""
     if mesh is None or mesh.size <= 1:
         return contextlib.nullcontext()
-    return _COLLECTIVE_LAUNCH_LOCK
+    return _COLLECTIVE_LAUNCH
 
 
 @dataclasses.dataclass(frozen=True)

@@ -83,6 +83,7 @@ import jax
 import numpy as np
 
 from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry, span, timed_device_get
 from sparkdl_tpu.runtime.sanitize import ship_guard
 
 # In-flight device batches before the oldest result is fetched, for the
@@ -215,16 +216,19 @@ class PadStaging:
         """Copy ``rows`` into the persistent ``[chunk_size, *row]``
         buffer for ``name``, zero the pad region, return the buffer."""
         shape = (chunk_size,) + rows.shape[1:]
-        buf = self._bufs.get(name)
-        if buf is None or buf.shape != shape or buf.dtype != rows.dtype:
-            buf = np.zeros(shape, rows.dtype)
-            self._bufs[name] = buf
-        valid = len(rows)
-        buf[:valid] = rows
-        # the buffer is reused: rows beyond this call's valid count may
-        # hold a previous tail's data and must be re-zeroed
-        if valid < chunk_size:
-            buf[valid:] = 0
+        with span("pad_stage", lane="ship", rows=len(rows),
+                  input=name):
+            buf = self._bufs.get(name)
+            if buf is None or buf.shape != shape \
+                    or buf.dtype != rows.dtype:
+                buf = np.zeros(shape, rows.dtype)
+                self._bufs[name] = buf
+            valid = len(rows)
+            buf[:valid] = rows
+            # the buffer is reused: rows beyond this call's valid count
+            # may hold a previous tail's data and must be re-zeroed
+            if valid < chunk_size:
+                buf[valid:] = 0
         if counters is not None:
             counters.bytes_staged += rows.nbytes
             if not rows.flags.c_contiguous:
@@ -303,9 +307,11 @@ class SlabSink:
         self._slabs: Optional[Dict[str, np.ndarray]] = None
 
     def write(self, valid: int, res) -> None:
-        t0 = time.perf_counter()
-        host = jax.device_get(res)
-        self.transfer_wait += time.perf_counter() - t0
+        # the ONE blessed device→host sync (obs/trace.py — spanned on
+        # the "device" lane and H1-allowlisted there); the span and
+        # this counter share the same clock reads
+        host, wait = timed_device_get(res)
+        self.transfer_wait += wait
         if self._slabs is None:
             self._slabs = {
                 k: np.empty((self.n,) + np.shape(v)[1:],
@@ -359,24 +365,39 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     limit = max_inflight
     pending: collections.deque = collections.deque()
     batches = 0
+    # queue-depth gauges, process-global: ship.inflight is the LAST
+    # observed depth (concurrent runners overwrite each other — per-run
+    # depth over time lives in the armed trace's dispatch/device_get
+    # spans), ship.inflight_peak the process-LIFETIME high-water mark
+    depth = default_registry().gauge("ship.inflight")
+    depth_peak = default_registry().gauge("ship.inflight_peak")
     nxt = next(chunks, None)
     placed = None
     if prefetch and nxt is not None:
-        placed = start_device_prefetch(nxt[1], sharding)
+        with span("device_put", lane="ship", rows=nxt[0],
+                  prefetch=True):
+            placed = start_device_prefetch(nxt[1], sharding)
         prefetch = placed is not None
     while nxt is not None:
         valid, chunk = nxt
         if placed is not None:
             chunk, placed = placed, None
         elif place is not None:
-            chunk = place(chunk)
+            with span("device_put", lane="ship", rows=valid):
+                chunk = place(chunk)
         nxt = next(chunks, None)
         if prefetch and nxt is not None:
             # start chunk i+1's host→device transfer BEFORE dispatching
             # chunk i: the transfer proceeds while the device computes i
-            placed = start_device_prefetch(nxt[1], sharding)
+            with span("device_put", lane="ship", rows=nxt[0],
+                      prefetch=True):
+                placed = start_device_prefetch(nxt[1], sharding)
             prefetch = placed is not None
-        res = fn(params, chunk)
+        # NOTE: on async backends this span times the ENQUEUE of the
+        # jitted call, not device compute — device-side time is only
+        # host-observable at the drain (the device_get span)
+        with span("dispatch", lane="ship", rows=valid):
+            res = fn(params, chunk)
         if host_async and not start_host_copies(res):
             # missing API: the deep uncopied queue would recreate the
             # stale-buffer collapse — shallow queue instead
@@ -384,8 +405,12 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
             limit = min(limit, MAX_INFLIGHT_BATCHES)
         pending.append((valid, res))
         batches += 1
+        depth.set(len(pending))
+        depth_peak.set_max(len(pending))
         drain_bounded(pending, sink, limit)
+        depth.set(len(pending))
     drain_bounded(pending, sink, 0)
+    depth.set(0)
     return batches
 
 
@@ -418,6 +443,7 @@ def start_host_copies(res: Dict[str, jax.Array]) -> bool:
             logging.getLogger(__name__).warning(
                 "backend lacks copy_to_host_async; host_async "
                 "degrades to a shallow deferred queue")
+        default_registry().counter("ship.degrade_events").add()
         return False
     return True
 
@@ -450,6 +476,7 @@ def start_device_prefetch(chunk: Dict[str, np.ndarray], sharding=None
             logging.getLogger(__name__).warning(
                 "backend lacks async device_put; prefetch degrades to "
                 "host_async dispatch")
+        default_registry().counter("ship.degrade_events").add()
         return None
 
 
@@ -514,6 +541,22 @@ class RunnerMetrics:
     @property
     def rows_per_second(self) -> float:
         return self.rows / self.seconds if self.seconds else 0.0
+
+    def publish(self, registry) -> None:
+        """Set this runner's cumulative counters as ``ship.*`` gauges
+        in an :class:`~sparkdl_tpu.obs.registry.MetricsRegistry` —
+        idempotent (gauges, not counter adds), so reports can publish
+        on every render without double counting."""
+        with self._lock:
+            vals = {"ship.rows": self.rows,
+                    "ship.batches": self.batches,
+                    "ship.seconds": self.seconds,
+                    "ship.bytes_staged": self.bytes_staged,
+                    "ship.bytes_copied": self.bytes_copied,
+                    "ship.transfer_wait_seconds":
+                        self.transfer_wait_seconds}
+        for name, value in vals.items():
+            registry.gauge(name).set(value)
 
 
 class BatchRunner:
@@ -626,7 +669,8 @@ class BatchRunner:
             # SPARKDL_TPU_SANITIZE=1: transfer_guard turns any
             # implicit device→host sync inside dispatch/drain into an
             # error (the sink's explicit device_get stays legal)
-            with ship_guard():
+            with span("runner.run", lane="ship", rows=n,
+                      strategy=self.strategy), ship_guard():
                 dispatch_chunks(fn, params, chunks, self.strategy,
                                 self.max_inflight, sink)
         finally:

@@ -8,7 +8,7 @@ their dispatch/drain loop. Inside it,
 device→host transfer — an ``np.asarray`` on a device value, a
 ``float()``/``bool()`` materialization, a library helper quietly
 syncing — into an immediate error at the offending line. The explicit
-drain (``SlabSink.write``'s ``jax.device_get``) and the explicit
+drain (``SlabSink.write`` → ``obs.timed_device_get``) and the explicit
 input-side ``jax.device_put`` (prefetch/sharded placement) stay legal:
 the guard bans the transfers nobody *meant* to write, which is exactly
 the class of regression sparkdl-lint's H1 rule hunts statically — this
@@ -30,6 +30,8 @@ import contextlib
 import logging
 import os
 from typing import Iterator
+
+from sparkdl_tpu.obs import default_registry
 
 _TRUE = ("1", "true", "yes", "on")
 
@@ -90,6 +92,7 @@ def ship_guard() -> Iterator[bool]:
                 "SPARKDL_TPU_SANITIZE=1 but this jax lacks "
                 "transfer_guard_device_to_host; ship path runs "
                 "unguarded")
+        default_registry().counter("sanitize.degrade_events").add()
         yield False
         return
     guard = guard_factory("disallow")
@@ -103,10 +106,12 @@ def ship_guard() -> Iterator[bool]:
             logging.getLogger(__name__).warning(
                 "SPARKDL_TPU_SANITIZE=1 but transfer_guard failed to "
                 "arm (%s); ship path runs unguarded", e)
+        default_registry().counter("sanitize.degrade_events").add()
         yield False
         return
     global _armed_runs
     _armed_runs += 1
+    default_registry().counter("sanitize.armed_runs").add()
     try:
         yield True
     finally:

@@ -9,6 +9,13 @@ north-star number"). Two tools:
 * :class:`StageMetrics` — cumulative wall-time/row counters per plan
   stage, collected by the engine when attached, so a pipeline run can
   report where its time went (decode vs resize vs device apply).
+
+Both publish into the unified observability layer
+(:mod:`sparkdl_tpu.obs`): ``StageMetrics.publish`` /
+``RunnerMetrics.publish`` set registry gauges and
+:func:`throughput_report` renders from the registry snapshot; for
+TIMELINES (who waited on whom, one shared clock) arm
+``SPARKDL_TPU_TRACE=1`` and see docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -86,32 +93,94 @@ class StageMetrics:
                 for name, st in self._stats.items()
             }
 
+    def publish(self, registry) -> None:
+        """Set the cumulative per-stage counters as
+        ``engine.stage.<name>.<field>`` gauges in an
+        :class:`~sparkdl_tpu.obs.registry.MetricsRegistry` —
+        idempotent (gauges, not counter adds), so reports can publish
+        on every render without double counting."""
+        for name, st in self.as_dict().items():
+            for field_name in ("seconds", "calls", "rows"):
+                registry.gauge(
+                    f"engine.stage.{name}.{field_name}"
+                ).set(st[field_name])
+
     def report(self) -> str:
         """Human-readable table, slowest stage first."""
-        rows = sorted(self.as_dict().items(),
-                      key=lambda kv: -kv[1]["seconds"])
-        if not rows:
-            return "(no stages recorded)"
-        width = max(len(n) for n, _ in rows)
-        lines = [f"{'stage'.ljust(width)}  seconds  calls    rows   rows/s"]
-        for name, st in rows:
-            lines.append(
-                f"{name.ljust(width)}  {st['seconds']:7.3f}  "
-                f"{st['calls']:5d}  {st['rows']:6d}  "
-                f"{st['rows_per_second']:7.0f}")
-        return "\n".join(lines)
+        return _format_stage_table(self.as_dict())
+
+
+def _format_stage_table(stats: Dict[str, Dict[str, float]]) -> str:
+    rows = sorted(stats.items(), key=lambda kv: -kv[1]["seconds"])
+    if not rows:
+        return "(no stages recorded)"
+    width = max(len(n) for n, _ in rows)
+    lines = [f"{'stage'.ljust(width)}  seconds  calls    rows   rows/s"]
+    for name, st in rows:
+        rps = (st["rows"] / st["seconds"] if st["seconds"] else 0.0)
+        lines.append(
+            f"{name.ljust(width)}  {st['seconds']:7.3f}  "
+            f"{int(st['calls']):5d}  {int(st['rows']):6d}  "
+            f"{rps:7.0f}")
+    return "\n".join(lines)
+
+
+def _stage_stats_from_snapshot(snap: Dict[str, float]
+                               ) -> Dict[str, Dict[str, float]]:
+    """Invert ``StageMetrics.publish``: ``engine.stage.<name>.<field>``
+    snapshot keys back into per-stage stat dicts (stage names may
+    themselves contain dots — the field is always the LAST segment)."""
+    prefix = "engine.stage."
+    stats: Dict[str, Dict[str, float]] = {}
+    for key, value in snap.items():
+        if not key.startswith(prefix):
+            continue
+        name, _, field_name = key[len(prefix):].rpartition(".")
+        if name and field_name in ("seconds", "calls", "rows"):
+            stats.setdefault(
+                name, {"seconds": 0.0, "calls": 0, "rows": 0}
+            )[field_name] = value
+    return stats
 
 
 def throughput_report(stage_metrics: Optional[StageMetrics] = None,
-                      runner_metrics=None) -> str:
-    """Combined engine-stage + device-runner report."""
+                      runner_metrics=None, registry=None) -> str:
+    """Combined engine-stage + device-runner report, routed through the
+    obs registry: both inputs publish into ``registry`` (a fresh
+    :class:`~sparkdl_tpu.obs.registry.MetricsRegistry` when not given)
+    and the text renders FROM its ``snapshot()``, so the printed
+    numbers and the machine-readable ones can never diverge. The
+    device line carries the host-copy proof counters
+    (``bytes_staged`` / ``bytes_copied`` / ``transfer_wait_seconds``),
+    not just throughput."""
+    from sparkdl_tpu.obs import MetricsRegistry
+    reg = registry if registry is not None else MetricsRegistry()
+    if stage_metrics is not None:
+        stage_metrics.publish(reg)
+    if runner_metrics is not None:
+        runner_metrics.publish(reg)
+    snap = reg.snapshot()
     parts = []
     if stage_metrics is not None:
-        parts.append(stage_metrics.report())
+        # values come from the snapshot, but only for the stages THIS
+        # StageMetrics holds — a reused registry (default_registry())
+        # keeps gauges from earlier runs, and a report must not list a
+        # stage the current run never executed
+        current = set(stage_metrics.as_dict())
+        stats = {name: st for name, st
+                 in _stage_stats_from_snapshot(snap).items()
+                 if name in current}
+        parts.append(_format_stage_table(stats))
     if runner_metrics is not None:
+        rows = snap.get("ship.rows", 0.0)
+        secs = snap.get("ship.seconds", 0.0)
+        rps = rows / secs if secs else 0.0
         parts.append(
-            f"device: {runner_metrics.rows} rows in "
-            f"{runner_metrics.seconds:.3f}s = "
-            f"{runner_metrics.rows_per_second:.0f} rows/s "
-            f"({runner_metrics.batches} batches)")
+            f"device: {int(rows)} rows in {secs:.3f}s = "
+            f"{rps:.0f} rows/s "
+            f"({int(snap.get('ship.batches', 0))} batches, "
+            f"{int(snap.get('ship.bytes_staged', 0))} B staged, "
+            f"{int(snap.get('ship.bytes_copied', 0))} B copied, "
+            f"{snap.get('ship.transfer_wait_seconds', 0.0):.3f}s "
+            "transfer wait)")
     return "\n".join(parts) if parts else "(no metrics)"

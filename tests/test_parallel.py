@@ -41,26 +41,34 @@ def test_collective_launch_lock_scoping():
     in-process launchers must share ONE launch lock (interleaved
     per-device enqueues from two threads deadlock the all-reduce —
     the hang test_fit_multiple_parallel_trials used to hit); no mesh
-    or a 1-device mesh needs no lock at all."""
-    import threading
-
+    or a 1-device mesh needs no lock at all. Since the obs PR the
+    multi-device case returns the instrumented wrapper around THE
+    process lock (parallel/mesh.py::_CollectiveLaunch) — entering it
+    must still hold the real lock."""
+    from sparkdl_tpu.parallel import mesh as mesh_mod
     from sparkdl_tpu.parallel.mesh import collective_launch
 
     multi = collective_launch(make_mesh())
-    assert isinstance(multi, type(threading.Lock()))
-    # one process-wide lock, not one per call
+    # one process-wide instrumented lock, not one per call
+    assert multi is mesh_mod._COLLECTIVE_LAUNCH
     assert collective_launch(make_mesh()) is multi
     single = collective_launch(
         make_mesh(devices=jax.devices()[:1]))
-    assert not isinstance(single, type(threading.Lock()))
+    assert single is not multi
     none = collective_launch(None)
     with none:
-        pass  # usable as a context manager
-    # the lock is reusable across steps
+        # the 1-device/no-mesh paths never touch the launch lock
+        assert not mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
+    with single:
+        assert not mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
+    # entering the wrapper takes the REAL process lock; it is
+    # reusable across steps and releases on exit
     with multi:
-        pass
+        assert mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
+    assert not mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
     with multi:
-        pass
+        assert mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
+    assert not mesh_mod._COLLECTIVE_LAUNCH_LOCK.locked()
 
 
 def test_sharded_runner_pickle_keeps_model_axis():

@@ -18,13 +18,20 @@
 #      SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard enforces the
 #      aligned ship path's zero-copy claim at runtime, not just in
 #      the counters.
-#   5. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
+#   5. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
+#      (SPARKDL_TPU_TRACE=1) and its exported Perfetto trace is
+#      schema-checked (valid trace-event list, ≥1 span per lane:
+#      engine/ship/device), then an end-to-end armed run (engine
+#      stages → runner dispatch/drain → estimator steps → a
+#      collective launch) must produce a trace carrying a
+#      collective_lock_wait span, and the report CLI must read it
+#   6. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
 #      H2 retrace, H3 locks, H4 quiesce) must report ZERO
 #      unsuppressed findings, plus the ruff baseline when installed
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
-# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4/5)
+# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4/5/6)
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -36,7 +43,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/5] native shim build =="
+echo "== [1/6] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -45,13 +52,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/5] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/6] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/5] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/6] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/5] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/6] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -60,7 +67,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/5] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/6] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
 python - <<'EOF'
 import json
@@ -109,7 +116,93 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/5] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [5/6] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
+  SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_obs.json
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_bench_obs.json") as f:
+    d = json.loads(f.read().strip().splitlines()[-1])
+obs = d["obs"]
+assert obs["trace_armed"] is True, obs
+assert isinstance(obs["trace_events"], int) and obs["trace_events"] > 0, obs
+assert isinstance(obs["registry"], dict) and obs["registry"], \
+    "bench obs block: empty registry snapshot"
+
+# the exported trace must be a valid Chrome/Perfetto trace-event list
+# with at least one span on every pipeline lane
+with open(obs["trace_export"]) as f:
+    events = json.load(f)
+assert isinstance(events, list) and events, "trace export: not a list"
+lanes = {}
+for e in events:
+    assert isinstance(e, dict) and "ph" in e and "name" in e, e
+    if e["ph"] == "M" and e["name"] == "process_name":
+        lanes[e["pid"]] = e["args"]["name"]
+spans = [e for e in events if e["ph"] == "X"]
+for e in spans:
+    for k in ("ts", "dur", "pid", "tid"):
+        assert k in e, (k, e)
+got = {lanes.get(e["pid"]) for e in spans}
+for lane in ("engine", "ship", "device"):
+    assert lane in got, \
+        f"lane {lane!r} missing from armed bench trace (got {sorted(l for l in got if l)})"
+print(json.dumps({"obs_bench_trace": "ok", "spans": len(spans),
+                  "lanes": sorted(l for l in got if l)}))
+EOF
+# end-to-end armed run in ONE process: engine stages -> runner
+# dispatch/drain -> estimator epoch/steps -> a collective launch; its
+# trace must carry all four lanes plus the collective_lock_wait span
+python - <<'EOF'
+import os
+os.environ["SPARKDL_TPU_TRACE"] = "1"
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.data import DataFrame
+from sparkdl_tpu.data.tensors import append_tensor_column
+from sparkdl_tpu.estimators import LogisticRegression
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.transformers.tensor_transform import TensorTransformer
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(24, 4)).astype(np.float32)
+mf = ModelFunction.fromSingle(lambda v: v * 2.0, None, input_shape=(4,))
+df = DataFrame.from_table(pa.table({"id": np.arange(24)}), 3) \
+    .with_column("x", lambda b, x=x: x[
+        b.column(0).to_numpy(zero_copy_only=False).astype(int)])
+t = TensorTransformer(modelFunction=mf, inputMapping={"x": "input"},
+                      outputMapping={"output": "y"}, batchSize=8)
+t.transform(df).collect()                      # engine -> ship -> device
+
+y = np.arange(24) % 2
+b = pa.RecordBatch.from_pylist([{"label": int(v)} for v in y])
+b = append_tensor_column(b, "features",
+                         x + 3.0 * y[:, None].astype(np.float32))
+LogisticRegression(maxIter=3).fit(DataFrame.from_batches([b]))  # estimator
+
+from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+r = ShardedBatchRunner(mf, mesh=make_mesh(MeshSpec(data=-1, model=2)),
+                       batch_size=1)
+n = r.preferred_chunk
+r.run({"input": np.arange(n * 4, dtype=np.float32).reshape(n, 4)})
+
+from sparkdl_tpu.obs import tracer
+trc = tracer()
+lanes = {s.lane for s in trc.spans()}
+names = {s.name for s in trc.spans()}
+for lane in ("engine", "ship", "device", "estimator"):
+    assert lane in lanes, (lane, sorted(lanes))
+assert "collective_lock_wait" in names, sorted(names)
+n_spans = trc.export("/tmp/sparkdl_obs_e2e_trace.json")
+assert n_spans > 0
+print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
+EOF
+python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
+
+echo "== [6/6] static analysis (sparkdl-lint + ruff baseline) =="
 tools/lint.sh sparkdl_tpu
 
 echo "== ci.sh: ALL GREEN =="
