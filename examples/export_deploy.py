@@ -1,4 +1,5 @@
-"""Export a model as StableHLO and serve it from a fresh process.
+"""Export a model as StableHLO, serve it from a fresh process, then
+put it behind an online ModelServer.
 
 The reference broadcast frozen GraphDef bytes inside Spark task closures
 to every executor (SURVEY §2.5); the TPU-era deploy form is serialized
@@ -6,6 +7,11 @@ StableHLO from ``jax.export``: params baked in, computation portable,
 loadable without the model's Python code. This example exports on the
 "driver", then loads and serves in a NEW python process that never
 imports the zoo — exactly what a worker that only has the bytes does.
+
+The last act is the ONLINE shape (docs/SERVING.md): the same deployed
+bytes behind a ``ModelServer`` — concurrent sub-batch requests from
+several threads, dynamically micro-batched into the export's fixed
+device batch, with the serve counters printed at the end.
 
 Run on CPU:
   JAX_PLATFORMS=cpu python examples/export_deploy.py
@@ -73,6 +79,51 @@ def main():
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
     print(f"worker output matches driver oracle "
           f"(max abs diff {np.abs(got - expected).max():.2e})")
+
+    # -- online serving: the deployed bytes behind a ModelServer ------------
+    # The export is fixed-batch (batch=4); the server's micro-batcher
+    # pads every dispatch to exactly that shape, so single-row requests
+    # from many threads are served by the same compiled program.
+    import threading
+
+    from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+    served = ModelFunction.deserialize(blob, name="deployed")
+    server = ModelServer(ServeConfig(max_wait_s=0.02))
+    server.register("deployed", served, batch_size=batch)
+    server.warmup()   # pre-trace: no user request pays the compile
+
+    results = {}
+
+    def client(tid):
+        # each client fires single-row requests cut from the oracle
+        # batch, so every response is checkable row-for-row
+        futs = [(i, server.submit(
+            {served.input_names[0]: x[i:i + 1]}))
+            for i in range(batch)]
+        results[tid] = [(i, f.result(timeout=120)) for i, f in futs]
+
+    clients = [threading.Thread(target=client, args=(t,))
+               for t in range(3)]
+    for t in clients:
+        t.start()
+    for t in clients:
+        t.join()
+    server.close()
+
+    out_name = served.output_names[0]
+    for tid, rows in results.items():
+        for i, out in rows:
+            np.testing.assert_allclose(out[out_name], expected[i:i + 1],
+                                       rtol=1e-5, atol=1e-5)
+    m = server.metrics.as_dict()
+    print(f"serve: {m['requests']} concurrent requests -> "
+          f"{m['batches']} micro-batches, "
+          f"fill {m['batch_fill_ratio']:.2f}, "
+          f"p99 {m['latency_p99_ms']:.1f} ms, "
+          f"rejections {m['rejections']}, "
+          f"deadline_misses {m['deadline_misses']}")
 
 
 if __name__ == "__main__":

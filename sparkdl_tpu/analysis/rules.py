@@ -281,7 +281,11 @@ def check_h2(tree: ast.AST, path: str) -> List[Finding]:
 # ---------------------------------------------------------------------------
 # H3 — concurrency discipline
 
-_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+# Condition counts: it wraps (or owns) a mutex, so a class keeping one
+# per instance has exactly the same pickle problem as a raw Lock — the
+# serve layer's RequestQueue is the canonical case.
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
+               "threading.Condition", "Condition"}
 _PICKLE_HOOKS = {"__getstate__", "__reduce__", "__reduce_ex__"}
 _H3_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__",
                       "__setstate__", "__getstate__"}

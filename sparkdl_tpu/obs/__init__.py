@@ -21,6 +21,7 @@ from sparkdl_tpu.obs.registry import (
     Counter,
     Gauge,
     MetricsRegistry,
+    Reservoir,
     default_registry,
 )
 from sparkdl_tpu.obs.trace import (
@@ -35,6 +36,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "MetricsRegistry",
+    "Reservoir",
     "SpanRecord",
     "Tracer",
     "default_registry",

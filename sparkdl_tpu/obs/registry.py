@@ -23,13 +23,18 @@ tracer's (``engine`` / ``ship`` / ``device`` / ``estimator`` plus
 
 Counters are monotonic accumulators (``add``); gauges are
 last-write-wins levels (``set``, plus ``set_max`` for high-water
-marks). Both are thread-safe and both follow the ``StageMetrics``
-pickle precedent: the lock drops on the wire and is recreated on
-arrival, values travel.
+marks); reservoirs are bounded sliding windows of observations with
+quantile readout (``observe`` / ``quantile``) — the latency-shaped
+metric the serve lane needs (p50/p99) that neither a counter nor a
+gauge can express. All three are thread-safe and all follow the
+``StageMetrics`` pickle precedent: the lock drops on the wire and is
+recreated on arrival, values travel.
 """
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
 from typing import Dict, Union
 
@@ -91,13 +96,80 @@ class Gauge:
         self._lock = threading.Lock()
 
 
+#: default Reservoir window (observations) — enough for a stable p99
+#: under sustained load without unbounded growth
+DEFAULT_RESERVOIR_CAPACITY = 4096
+
+
+class Reservoir:
+    """Bounded sliding window of observations with nearest-rank
+    quantile readout (request latencies, batch fill samples). Keeps the
+    most recent ``capacity`` observations; ``count`` stays the lifetime
+    total so a snapshot distinguishes "few samples" from "few
+    retained"."""
+
+    # sparkdl-lint H3 contract: observations arrive from every caller
+    # thread at once — writes to count hold self._lock
+    _lock_guards = ("count",)
+
+    def __init__(self, name: str,
+                 capacity: int = DEFAULT_RESERVOIR_CAPACITY):
+        if capacity <= 0:
+            raise ValueError(
+                f"capacity must be positive, got {capacity}")
+        self.name = name
+        self.capacity = capacity
+        self.count = 0
+        self._window: collections.deque = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._window.append(float(value))
+            self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile over the retained window; 0.0 when no
+        observations have been recorded (a snapshot must never raise)."""
+        return self.quantiles((q,))[0]
+
+    def quantiles(self, qs) -> tuple:
+        """Several nearest-rank quantiles from ONE sorted snapshot of
+        the window — readout paths that want p50 AND p99 (every
+        publish) must not pay two O(n log n) sorts."""
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(
+                    f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            vals = sorted(self._window)
+        if not vals:
+            return tuple(0.0 for _ in qs)
+        last = len(vals) - 1
+        return tuple(
+            vals[min(last, max(0, math.ceil(q * len(vals)) - 1))]
+            for q in qs)
+
+    # locks don't pickle; the retained window and lifetime count travel
+    # (StageMetrics precedent)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
 class MetricsRegistry:
-    """Thread-safe name → Counter/Gauge table with one flat
+    """Thread-safe name → Counter/Gauge/Reservoir table with one flat
     ``snapshot()``."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, Union[Counter, Gauge]] = {}
+        self._metrics: Dict[str, Union[Counter, Gauge, Reservoir]] = {}
 
     def counter(self, name: str) -> Counter:
         """The counter registered under ``name`` (created on first
@@ -124,12 +196,43 @@ class MetricsRegistry:
                     "requested as Gauge")
             return m
 
+    def reservoir(self, name: str,
+                  capacity: int = DEFAULT_RESERVOIR_CAPACITY
+                  ) -> Reservoir:
+        """The reservoir registered under ``name`` (created on first
+        use; ``capacity`` applies only at creation). Same
+        one-kind-forever contract as counter/gauge."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Reservoir(name, capacity)
+            elif not isinstance(m, Reservoir):
+                raise TypeError(
+                    f"metric {name!r} is a {type(m).__name__}, "
+                    "requested as Reservoir")
+            return m
+
     def snapshot(self) -> Dict[str, float]:
         """One flat {name: value} dict, sorted by name — the bench/CI
-        contract (and what ``throughput_report`` renders from)."""
+        contract (and what ``throughput_report`` renders from).
+        Reservoirs flatten to ``<name>.count`` / ``.p50`` / ``.p99``
+        derived keys so the snapshot stays one level deep."""
         with self._lock:
-            return {name: self._metrics[name].value
-                    for name in sorted(self._metrics)}
+            metrics = [self._metrics[name]
+                       for name in sorted(self._metrics)]
+        out: Dict[str, float] = {}
+        for m in metrics:
+            if isinstance(m, Reservoir):
+                # quantiles() takes the reservoir's own lock — computed
+                # OUTSIDE the registry lock so a concurrent observe()
+                # never waits on a snapshot render
+                p50, p99 = m.quantiles((0.5, 0.99))
+                out[f"{m.name}.count"] = float(m.count)
+                out[f"{m.name}.p50"] = p50
+                out[f"{m.name}.p99"] = p99
+            else:
+                out[m.name] = m.value
+        return out
 
     def clear(self) -> None:
         """Drop every metric (test isolation)."""

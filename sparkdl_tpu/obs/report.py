@@ -7,11 +7,14 @@ where the run's microseconds went without opening a UI:
 
 * per-lane busy % — the union of each lane's span intervals over the
   run's wall span: a link-bound pipeline shows the ship lane near 100%
-  while engine/device idle, a decode-bound one the reverse;
+  while engine/device idle, a decode-bound one the reverse; server
+  traces (docs/SERVING.md) land on the ``serve`` lane through the same
+  machinery — no special-casing;
 * top spans by total time — the aggregate cost of each span name;
 * stalls — the wait-shaped spans (``device_get``,
-  ``collective_lock_wait``, ``device_put``, ``pad_stage``) broken out,
-  because those are the seconds a perf PR can actually claw back.
+  ``collective_lock_wait``, ``device_put``, ``pad_stage``, and the
+  serve lane's ``coalesce`` window) broken out, because those are the
+  seconds a perf PR can actually claw back.
 """
 
 from __future__ import annotations
@@ -19,9 +22,12 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Sequence, Tuple
 
-#: span names that are waits, not work — the claw-back targets
+#: span names that are waits, not work — the claw-back targets.
+#: ``coalesce`` is the serve lane's batching window: time spent
+#: holding admitted requests open for more arrivals (docs/SERVING.md)
+#: — latency deliberately traded for batch fill, but still a wait.
 STALL_NAMES = ("device_get", "collective_lock_wait", "device_put",
-               "pad_stage")
+               "pad_stage", "coalesce")
 
 
 def load_events(path: str) -> List[dict]:

@@ -28,6 +28,7 @@ from sparkdl_tpu.parallel.mesh import (
     MeshSpec,
     collective_launch,
     make_mesh,
+    mesh_has_collectives,
 )
 from sparkdl_tpu.runtime.runner import (
     CopyCounters,
@@ -40,6 +41,7 @@ from sparkdl_tpu.runtime.runner import (
     dispatch_chunks,
     empty_jax_outputs,
     iter_padded_chunks,
+    warmup_runner,
 )
 from sparkdl_tpu.runtime.sanitize import ship_guard
 
@@ -118,6 +120,15 @@ class ShardedBatchRunner:
         stage's plan batch_hint."""
         return self._global_batch
 
+    def warmup(self) -> bool:
+        """Pre-trace/compile the sharded program at the global mesh
+        batch shape (one zeros run of ``preferred_chunk`` rows) so the
+        first real ``run()`` pays no compile — the warmup goes through
+        :meth:`run`, so a model-parallel program's first launch already
+        holds the collective launch lock. See
+        :func:`~sparkdl_tpu.runtime.runner.warmup_runner`."""
+        return warmup_runner(self)
+
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]};
         N is cut into global batches, the tail padded then truncated."""
@@ -165,9 +176,11 @@ class ShardedBatchRunner:
             # A model-parallel program carries collectives, so its
             # launches must not interleave with another thread's
             # (parallel/mesh.py::collective_launch); the pure-DP
-            # forward has no cross-device edges and stays lock-free.
+            # forward has no cross-device edges and stays lock-free
+            # (the policy lives in mesh_has_collectives — the serve
+            # layer reads the same predicate).
             launch = collective_launch(
-                self.mesh if self.mesh.shape[MODEL_AXIS] > 1 else None)
+                self.mesh if mesh_has_collectives(self.mesh) else None)
             with span("runner.run_sharded", lane="ship", rows=n,
                       strategy=self.strategy,
                       mesh=f"{self.mesh.shape[DATA_AXIS]}x"

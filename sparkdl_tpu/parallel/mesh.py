@@ -114,6 +114,20 @@ def collective_launch(mesh: Optional[Mesh]):
     return _COLLECTIVE_LAUNCH
 
 
+def mesh_has_collectives(mesh: Optional[Mesh]) -> bool:
+    """THE policy for whether an inference program compiled against
+    ``mesh`` carries cross-device edges and therefore must dispatch
+    under :func:`collective_launch`: only a real model axis introduces
+    them (weight-shard all-gathers/reduce-scatters); the pure-DP
+    forward splits the batch with no collective and stays lock-free.
+    Centralized here so ShardedBatchRunner and the serve layer
+    (serve/server.py session dispatch accounting) agree — training
+    steps are different: their grad psum is a collective at ANY mesh
+    size > 1, which is why they pass the mesh to collective_launch
+    unconditionally."""
+    return mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Declarative mesh request: how many devices along each axis.

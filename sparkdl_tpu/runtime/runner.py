@@ -235,6 +235,44 @@ class PadStaging:
                 counters.bytes_copied += rows.nbytes
         return buf
 
+    def stage_parts(self, name: str, parts: List[np.ndarray],
+                    chunk_size: int,
+                    counters: Optional["CopyCounters"] = None
+                    ) -> np.ndarray:
+        """Write several row arrays into CONSECUTIVE ranges of the
+        persistent ``[chunk_size, *row]`` buffer for ``name``, zero the
+        pad tail, return the buffer — the serve layer's multi-request
+        coalesce analogue of :meth:`stage` (one request = one part).
+        The same reuse-safety argument applies: the caller must fully
+        drain the dispatched batch before staging the next one (the
+        server's dispatcher does — ``runner.run`` returns drained)."""
+        if not parts:
+            raise ValueError("stage_parts needs at least one part")
+        total = sum(len(p) for p in parts)
+        if total > chunk_size:
+            raise ValueError(
+                f"parts hold {total} rows > chunk_size {chunk_size}")
+        shape = (chunk_size,) + parts[0].shape[1:]
+        with span("pad_stage", lane="ship", rows=total, input=name,
+                  parts=len(parts)):
+            buf = self._bufs.get(name)
+            if buf is None or buf.shape != shape \
+                    or buf.dtype != parts[0].dtype:
+                buf = np.zeros(shape, parts[0].dtype)
+                self._bufs[name] = buf
+            lo = 0
+            for rows in parts:
+                buf[lo:lo + len(rows)] = rows
+                lo += len(rows)
+            if lo < chunk_size:
+                buf[lo:] = 0
+        if counters is not None:
+            for rows in parts:
+                counters.bytes_staged += rows.nbytes
+                if not rows.flags.c_contiguous:
+                    counters.bytes_copied += rows.nbytes
+        return buf
+
 
 @dataclass
 class CopyCounters:
@@ -608,6 +646,13 @@ class BatchRunner:
         for lo in range(0, n, self.batch_size):
             yield lo, min(lo + self.batch_size, n)
 
+    def warmup(self) -> bool:
+        """Pre-trace/compile the jitted program at the device batch
+        shape (one zeros run of ``preferred_chunk`` rows) so the first
+        real ``run()`` pays no compile; no-op (False) for host
+        backends. See :func:`warmup_runner`."""
+        return warmup_runner(self)
+
     def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]}."""
         n = check_row_counts(inputs)
@@ -712,3 +757,31 @@ def empty_jax_outputs(model_fn: ModelFunction) -> Dict[str, np.ndarray]:
     sig = model_fn.output_signature()
     return {k: np.zeros((0,) + tuple(shape), dtype)
             for k, (shape, dtype) in sig.items()}
+
+
+def warmup_runner(runner) -> bool:
+    """Pre-trace + compile ``runner``'s jitted program at its device
+    batch shape by running one zeros batch of ``preferred_chunk`` rows
+    — so the FIRST real request never pays the jit trace/compile
+    (the serve layer's warmup contract, docs/SERVING.md; shared by
+    BatchRunner.warmup and ShardedBatchRunner.warmup).
+
+    Every runner dispatch uses exactly one device shape (chunks are
+    padded to ``preferred_chunk``), so one zeros run covers it. Returns
+    False without running for host backends (no jit to warm) and for
+    signatures with unknown (None) dims, where no concrete warmup batch
+    exists."""
+    model_fn = runner.model_fn
+    if model_fn.backend != "jax":
+        return False
+    sig = model_fn.input_signature
+    if any(d is None for shape, _ in sig.values() for d in shape):
+        logging.getLogger(__name__).debug(
+            "warmup skipped for %s: unknown dims in signature",
+            model_fn.name)
+        return False
+    n = runner.preferred_chunk
+    zeros = {k: np.zeros((n,) + tuple(shape), dtype)
+             for k, (shape, dtype) in sig.items()}
+    runner.run(zeros)
+    return True

@@ -1,0 +1,43 @@
+"""Online serving: dynamic micro-batching over the batch runners.
+
+The offline entry points (``BatchRunner`` / ``ShardedBatchRunner`` /
+the transformer paths) take one big materialized batch; online traffic
+is many small concurrent requests. This package is the front-end that
+converts one shape into the other without giving up the hot-path
+invariants the offline layers enforce (docs/SERVING.md):
+
+* :class:`ModelServer` — thread-safe ``submit(inputs, deadline=...)``
+  → ``Future``, a model session registry with jit warmup, graceful
+  drain/shutdown;
+* :mod:`sparkdl_tpu.serve.batching` — the bounded row queue, typed
+  backpressure (:class:`ServerOverloaded`), deadline-aware coalescing
+  into ``preferred_chunk``-aligned micro-batches
+  (:class:`DeadlineExceeded` for requests that expire queued);
+* :class:`ServeConfig` — the operator's latency/throughput knobs;
+* :class:`ServeMetrics` — fill ratio / p50/p99 latency / rejections,
+  published as ``serve.*`` registry gauges, spans on the ``serve``
+  obs lane.
+"""
+
+from sparkdl_tpu.serve.batching import (
+    DeadlineExceeded,
+    Request,
+    RequestQueue,
+    ServerClosed,
+    ServerOverloaded,
+)
+from sparkdl_tpu.serve.config import ServeConfig
+from sparkdl_tpu.serve.metrics import ServeMetrics
+from sparkdl_tpu.serve.server import ModelServer, ModelSession
+
+__all__ = [
+    "DeadlineExceeded",
+    "ModelServer",
+    "ModelSession",
+    "Request",
+    "RequestQueue",
+    "ServeConfig",
+    "ServeMetrics",
+    "ServerClosed",
+    "ServerOverloaded",
+]

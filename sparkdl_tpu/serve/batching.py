@@ -1,0 +1,278 @@
+"""Request admission + dynamic micro-batching: the serve layer's core.
+
+The unit of work is the ROW, not the request: a request is admitted as
+``n`` rows against the bounded queue, the dispatcher drains rows off
+the queue head into ``preferred_chunk``-sized micro-batches, and a
+request's future resolves when ALL its rows have come back. That one
+choice gives every behavior the online contract needs for free:
+
+* many small requests coalesce into one full device batch (the
+  tf.data-style amortization, applied on the request axis);
+* one request LARGER than the device batch splits across consecutive
+  micro-batches and reassembles in submission order — it never stalls
+  the queue behind a single oversized dispatch;
+* admission control is exact: ``queue rows + request rows`` against
+  ``max_queue_rows``, rejected with the typed
+  :class:`ServerOverloaded` BEFORE enqueue (backpressure, not growth).
+
+Deadlines are absolute ``time.perf_counter()`` instants computed at
+submit. The collector fails expired requests when it pops them —
+BEFORE dispatch, so an already-dead request never spends device time —
+and clips its coalescing wait to the earliest deadline in the batch so
+waiting for fill can't itself kill an admitted request.
+
+Single-consumer discipline: exactly ONE dispatcher thread per session
+calls :meth:`RequestQueue.collect` / delivers results, so request
+completion needs no lock of its own; producers (submit callers) only
+touch the queue under its condition. The queue's lock is therefore the
+only lock in the hot path, and it is never held across a dispatch.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.obs import span
+
+
+class ServerOverloaded(RuntimeError):
+    """The bounded queue cannot admit this request — the caller sheds
+    load or retries later; the server never grows the queue instead."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed while it was queued; it was failed
+    BEFORE dispatch (no device time was spent on it)."""
+
+
+class ServerClosed(RuntimeError):
+    """submit() after close(), or the request was still queued when a
+    non-draining shutdown discarded the queue."""
+
+
+class Request:
+    """One submitted inference call: validated inputs, an absolute
+    deadline, and the Future its caller is waiting on.
+
+    ``taken`` (rows already placed into micro-batches) is dispatcher
+    state, mutated only under the queue condition; result reassembly
+    (:meth:`write`) runs only on the single dispatcher thread, so the
+    output slabs need no lock."""
+
+    __slots__ = ("inputs", "n", "deadline", "submitted", "future",
+                 "taken", "_slabs", "_done_rows")
+
+    def __init__(self, inputs: Dict[str, np.ndarray], n: int,
+                 deadline: Optional[float]):
+        self.inputs = inputs
+        self.n = n
+        self.deadline = deadline          # absolute perf_counter instant
+        self.submitted = time.perf_counter()
+        self.future: Future = Future()
+        self.taken = 0
+        self._slabs: Optional[Dict[str, np.ndarray]] = None
+        self._done_rows = 0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def fail(self, exc: BaseException) -> bool:
+        """Resolve the future exceptionally (idempotent — a request
+        failed at expiry must not be failed again at shutdown)."""
+        if self.future.done():
+            return False
+        self.future.set_exception(exc)
+        return True
+
+    def write(self, outputs: Dict[str, np.ndarray], batch_lo: int,
+              req_lo: int, rows: int) -> bool:
+        """Copy ``rows`` result rows from a dispatched batch's outputs
+        (at ``batch_lo``) into this request's row range ``req_lo`` —
+        the reassembly half of splitting; resolves the future (and
+        returns True) when the last row lands."""
+        if self.future.done():      # failed meanwhile (shutdown race)
+            return False
+        if self._slabs is None:
+            self._slabs = {
+                k: np.empty((self.n,) + v.shape[1:], v.dtype)
+                for k, v in outputs.items()}
+        for k, v in outputs.items():
+            self._slabs[k][req_lo:req_lo + rows] = \
+                v[batch_lo:batch_lo + rows]
+        self._done_rows += rows
+        if self._done_rows == self.n:
+            self.future.set_result(self._slabs)
+            return True
+        return False
+
+
+#: one placed slice of a request inside a micro-batch:
+#: (request, request-row offset, row count)
+Part = Tuple[Request, int, int]
+
+
+class MicroBatch:
+    """What one :meth:`RequestQueue.collect` produced: the placed
+    parts (in batch-row order, offset 0 upward), the valid row count,
+    and the requests that expired while queued (to be failed by the
+    caller BEFORE dispatch)."""
+
+    __slots__ = ("parts", "valid", "expired", "waited_s")
+
+    def __init__(self, parts: List[Part], valid: int,
+                 expired: List[Request], waited_s: float):
+        self.parts = parts
+        self.valid = valid
+        self.expired = expired
+        self.waited_s = waited_s
+
+
+class RequestQueue:
+    """Bounded multi-producer / single-consumer row queue with
+    deadline-aware micro-batch collection.
+
+    ``rows`` counts rows admitted but not yet placed into a
+    micro-batch — the admission bound's denominator. The lock is a
+    plain mutex wrapped by a condition; both drop on pickle (a shipped
+    server re-creates empty queues — in-flight futures are
+    process-local by nature, the StageMetrics precedent)."""
+
+    # sparkdl-lint H3 contract: producers and the dispatcher mutate the
+    # queue concurrently — writes to these hold self._lock (the
+    # condition wraps the SAME mutex, so wait/notify work under it)
+    _lock_guards = ("rows", "closing")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q: collections.deque = collections.deque()
+        self.rows = 0
+        self.closing = False
+
+    # -- producers -----------------------------------------------------------
+
+    def offer(self, req: Request, max_rows: int) -> int:
+        """Admit ``req`` or raise the typed rejection; returns the
+        post-admission queue depth in rows (for the gauge)."""
+        with self._lock:
+            if self.closing:
+                raise ServerClosed("server is closed to new requests")
+            if self.rows + req.n > max_rows:
+                raise ServerOverloaded(
+                    f"queue holds {self.rows} rows; admitting "
+                    f"{req.n} more would exceed max_queue_rows="
+                    f"{max_rows} — shed load or retry")
+            self._q.append(req)
+            self.rows += req.n
+            self._cond.notify()
+            return self.rows
+
+    def depth(self) -> int:
+        with self._lock:
+            return self.rows
+
+    # -- the single consumer -------------------------------------------------
+
+    def collect(self, chunk_rows: int, max_wait_s: float
+                ) -> Optional[MicroBatch]:
+        """Block until work arrives, then coalesce up to ``chunk_rows``
+        rows into one micro-batch, waiting at most ``max_wait_s`` (from
+        first pop, clipped to the earliest deadline in the batch) for
+        more arrivals. Returns None exactly once: when the queue is
+        closing and fully drained — the dispatcher's exit signal."""
+        with self._lock:
+            while not self._q and not self.closing:
+                self._cond.wait()
+            if not self._q:
+                return None     # closing + drained
+            start = time.perf_counter()
+            wait_until = start + max_wait_s
+            parts: List[Part] = []
+            valid = 0
+            expired: List[Request] = []
+            # the span opens AFTER the idle wait: an idle server must
+            # not render as a saturated serve lane — only the batching
+            # window (the latency deliberately traded for fill) is the
+            # wait-shaped "coalesce" stall the report breaks out
+            with span("coalesce", lane="serve", chunk=chunk_rows):
+                while True:
+                    now = time.perf_counter()
+                    while self._q and valid < chunk_rows:
+                        req = self._q[0]
+                        if req.expired(now):
+                            # fail BEFORE dispatch: remaining rows
+                            # leave the queue; already-placed parts (an
+                            # earlier micro-batch of a split request)
+                            # are moot — the future fails either way
+                            self._q.popleft()
+                            self.rows -= req.n - req.taken
+                            expired.append(req)
+                            continue
+                        take = min(chunk_rows - valid,
+                                   req.n - req.taken)
+                        parts.append((req, req.taken, take))
+                        req.taken += take
+                        self.rows -= take
+                        valid += take
+                        if req.taken == req.n:
+                            self._q.popleft()
+                        if req.deadline is not None:
+                            # waiting for fill must not kill what we
+                            # already hold
+                            wait_until = min(wait_until, req.deadline)
+                    if valid >= chunk_rows or self.closing:
+                        break
+                    if expired:
+                        # deadline pressure: return at once so the
+                        # caller fails the expired futures promptly —
+                        # holding a detected failure through the fill
+                        # wait would deliver it up to max_wait_s late.
+                        # Any live parts dispatch as a partial batch
+                        # (expiry means latency already lost the race
+                        # with fill).
+                        break
+                    remaining = wait_until - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            waited = time.perf_counter() - start
+            return MicroBatch(parts, valid, expired, waited)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: bool) -> List[Request]:
+        """Stop admissions. ``drain=True`` leaves queued requests for
+        the dispatcher to finish; ``drain=False`` empties the queue and
+        returns the abandoned requests for the caller to fail (the
+        caller owns the typed error + accounting)."""
+        with self._lock:
+            self.closing = True
+            abandoned: List[Request] = []
+            if not drain:
+                abandoned = list(self._q)
+                self._q.clear()
+                self.rows = 0
+            self._cond.notify_all()
+            return abandoned
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        del state["_cond"]
+        del state["_q"]         # in-flight futures are process-local
+        state["rows"] = 0
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = collections.deque()

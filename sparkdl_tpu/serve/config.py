@@ -1,0 +1,61 @@
+"""Server tuning knobs, in one validated frozen dataclass.
+
+Every number here is a latency/throughput/robustness trade the
+operator owns (docs/SERVING.md "the queueing model"):
+
+* ``max_wait_s`` — the dynamic micro-batching window: how long the
+  dispatcher holds an admitted request open for more arrivals before
+  dispatching a partial batch. 0 disables coalescing-by-waiting
+  (batches still merge whatever is ALREADY queued). Larger windows buy
+  batch fill (device efficiency) with tail latency.
+* ``max_queue_rows`` — the admission bound, measured in ROWS (the unit
+  the device actually consumes; counting requests would let a few huge
+  requests occupy unbounded memory behind a small "request" number).
+  A full queue rejects with :class:`ServerOverloaded` instead of
+  growing — backpressure is the contract, not best-effort.
+* ``default_deadline_s`` — applied to submissions that don't pass
+  their own ``deadline``; ``None`` means accepted requests wait as
+  long as the queue takes.
+* ``drain_timeout_s`` — how long graceful shutdown waits for the
+  dispatcher to finish the queued work before giving up (with a
+  warning — never a hang).
+
+Frozen + lock-free, so the config pickles as-is: a shipped
+:class:`~sparkdl_tpu.serve.server.ModelServer` carries its config
+across the wire while workers/locks/queues drop (the StageMetrics
+precedent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Validated server knobs; see the module docstring for the
+    semantics of each."""
+
+    max_wait_s: float = 0.002
+    max_queue_rows: int = 4096
+    default_deadline_s: Optional[float] = None
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.max_wait_s < 0:
+            raise ValueError(
+                f"max_wait_s must be >= 0, got {self.max_wait_s}")
+        if self.max_queue_rows <= 0:
+            raise ValueError(
+                f"max_queue_rows must be positive, got "
+                f"{self.max_queue_rows}")
+        if self.default_deadline_s is not None \
+                and self.default_deadline_s <= 0:
+            raise ValueError(
+                f"default_deadline_s must be positive (or None), got "
+                f"{self.default_deadline_s}")
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain_timeout_s must be positive, got "
+                f"{self.drain_timeout_s}")

@@ -1,0 +1,450 @@
+"""ModelServer: the in-process online inference front-end.
+
+Every entry point before this layer was offline — one caller hands a
+full materialized batch to ``BatchRunner.run`` / ``ShardedBatchRunner
+.run`` and blocks. Online traffic is the opposite shape: many small
+concurrent requests, each wanting an answer soon. The server sits
+between the two (docs/SERVING.md):
+
+* :meth:`ModelServer.submit` is the thread-safe front door: validate
+  against the model signature, admit against the bounded row queue
+  (or reject with the typed ``ServerOverloaded`` — backpressure, never
+  unbounded growth), return a ``concurrent.futures.Future``.
+* one dispatcher thread per registered model session drains the queue
+  into ``preferred_chunk``-aligned micro-batches (serve/batching.py)
+  and runs them through the session's runner — so every device
+  dispatch is full-shaped, the jit cache sees ONE shape forever, and
+  the existing zero-copy ship path does the actual work. A coalesced
+  multi-request batch stages through the session's persistent
+  :class:`PadStaging` buffers (``stage_parts``); a single
+  full-chunk request passes through as plain views — zero copies.
+* mesh-backed sessions dispatch through ``ShardedBatchRunner.run``,
+  which already takes ``collective_launch()`` for model-parallel
+  programs — the serve layer inherits the launch-ordering discipline
+  rather than re-implementing it (``ModelSession.collective`` exposes
+  the ``mesh_has_collectives`` policy for observability).
+* :meth:`ModelServer.warmup` pre-traces every session's jitted
+  program at its device batch shape, so the first user request never
+  pays the compile.
+* :meth:`ModelServer.close` follows the engine quiesce discipline:
+  graceful drain by default (finish the admitted queue, bounded by
+  ``drain_timeout_s``, warn — never hang), or fail-fast with the
+  typed ``ServerClosed`` when ``drain=False``.
+
+Observability rides the ``serve`` obs lane (``enqueue`` / ``coalesce``
+/ ``dispatch`` / ``warmup`` spans) plus ``serve.*`` registry metrics
+(docs/OBSERVABILITY.md): live queue depth gauges set on the hot path,
+cumulative counters published from :class:`ServeMetrics` after every
+dispatch/rejection.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sparkdl_tpu.obs import default_registry, span
+from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+from sparkdl_tpu.parallel.mesh import mesh_has_collectives
+from sparkdl_tpu.runtime.runner import (
+    BatchRunner,
+    PadStaging,
+    check_against_signature,
+    check_row_counts,
+)
+from sparkdl_tpu.serve.batching import (
+    DeadlineExceeded,
+    MicroBatch,
+    Request,
+    RequestQueue,
+    ServerClosed,
+    ServerOverloaded,
+)
+from sparkdl_tpu.serve.config import ServeConfig
+from sparkdl_tpu.serve.metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class ModelSession:
+    """One registered model behind the server: its runner, its bounded
+    queue, its dispatcher thread, its persistent coalesce staging.
+
+    Created via :meth:`ModelServer.register`; the dispatcher starts
+    lazily on first submit (so a pickled/shipped server needs no
+    explicit restart). All result reassembly happens on the single
+    dispatcher thread — the queue's condition is the only lock between
+    submitters and the dispatcher, and it is never held across a
+    dispatch."""
+
+    def __init__(self, name: str, runner, config: ServeConfig,
+                 metrics: ServeMetrics):
+        self.name = name
+        self.runner = runner
+        self.config = config
+        self.metrics = metrics
+        self.chunk = int(runner.preferred_chunk)
+        self._queue = RequestQueue()
+        self._staging = PadStaging()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def collective(self) -> bool:
+        """Whether this session's dispatches carry cross-device
+        collectives and therefore serialize under the process-wide
+        launch lock inside ``ShardedBatchRunner.run`` (the
+        ``mesh_has_collectives`` policy, parallel/mesh.py)."""
+        return mesh_has_collectives(getattr(self.runner, "mesh", None))
+
+    # -- submission (any thread) ---------------------------------------------
+
+    def submit(self, inputs: Dict[str, np.ndarray],
+               deadline: Optional[float] = None) -> Future:
+        """Validate, admit, enqueue; returns the Future the dispatcher
+        will resolve. Raises ``ServerOverloaded`` (queue full),
+        ``ServerClosed``, or ``ValueError`` (signature mismatch) —
+        all BEFORE enqueue, so a rejected caller holds nothing.
+
+        Buffer ownership: the queued request BORROWS the caller's
+        arrays until its future resolves (copying at admission would
+        re-pay exactly the ship-side byte tax the zero-copy fast path
+        exists to avoid) — a caller that reuses an input buffer must
+        wait for the future first, or pass a copy. A dtype-mismatched
+        input is cast (copied) at admission and is safe to reuse."""
+        mf = self.runner.model_fn
+        sig = mf.input_signature
+        raw = {k: np.asarray(v) for k, v in inputs.items()}
+        n = check_row_counts(raw)
+        if n == 0:
+            # zero-row submissions resolve immediately — they must not
+            # occupy a batch slot or wait out the window. The runner's
+            # own N=0 path supplies the schema-correct empties
+            # (empty_jax_outputs for jax backends, the probe batch for
+            # host models). The close() contract still applies
+            # (nothing resolves after shutdown), and all declared
+            # inputs must be present — only the per-row shape check is
+            # moot at N=0 (empty variable-list columns arrive flat,
+            # the runner contract).
+            if self._queue.closing:
+                raise ServerClosed("server is closed to new requests")
+            missing = [k for k in sig if k not in raw]
+            if missing:
+                raise ValueError(
+                    f"model {mf.name!r} inputs {missing} missing "
+                    f"from request inputs {sorted(raw)}")
+            fut: Future = Future()
+            fut.set_result(self.runner.run(raw))
+            self.metrics.add_request(0)
+            self.metrics.publish(default_registry())
+            return fut
+        check_against_signature(raw, mf)
+        # cast to the signature dtype at admission (no copy when it
+        # already matches): every staged/coalesced batch then has ONE
+        # dtype, so the warmed jit cache is never invalidated by a
+        # caller handing in float64
+        cast = {k: np.asarray(raw[k], np.dtype(dtype))
+                for k, (_shape, dtype) in sig.items()}
+
+        if deadline is None:
+            deadline = self.config.default_deadline_s
+        abs_deadline = None
+        if deadline is not None:
+            if deadline <= 0:
+                # deadline-aware admission: a request that is already
+                # dead is failed up front, not queued
+                self.metrics.add_request(n)
+                self.metrics.add_deadline_miss()
+                fut = Future()
+                fut.set_exception(DeadlineExceeded(
+                    f"deadline {deadline}s is not in the future"))
+                self.metrics.publish(default_registry())
+                return fut
+            abs_deadline = time.perf_counter() + deadline
+
+        reg = default_registry()
+        if n > self.config.max_queue_rows:
+            self.metrics.add_rejection()
+            self.metrics.publish(reg)
+            raise ServerOverloaded(
+                f"request of {n} rows can never be admitted: "
+                f"max_queue_rows={self.config.max_queue_rows}")
+        req = Request(cast, n, abs_deadline)
+        try:
+            with span("enqueue", lane="serve", rows=n,
+                      model=self.name):
+                depth = self._queue.offer(req,
+                                          self.config.max_queue_rows)
+        except ServerOverloaded:
+            self.metrics.add_rejection()
+            self.metrics.publish(reg)
+            raise
+        # AFTER a successful admission: a submit that can only be
+        # rejected (closed/overloaded) must not churn a fresh
+        # short-lived dispatcher thread per call. The queued request
+        # is not orphaned by the ordering — a close() racing into the
+        # gap either fails it from the abandoned list (drain=False) or
+        # leaves it queued for the worker started here, which drains
+        # it and exits on the closed empty queue (its future resolves;
+        # only a submit that RACED close can resolve after close
+        # returns, and a racing submit has no ordering claim).
+        self._ensure_worker()
+        self.metrics.add_request(n)
+        reg.gauge("serve.queue_rows").set(depth)
+        reg.gauge("serve.queue_rows_peak").set_max(depth)
+        return req.future
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self) -> bool:
+        """Pre-trace/compile at the device batch shape so the first
+        submitted request never pays the jit (runner.warmup: one zeros
+        run of ``preferred_chunk`` rows — the only shape the server
+        ever dispatches)."""
+        with span("warmup", lane="serve", model=self.name,
+                  rows=self.chunk):
+            return self.runner.warmup()
+
+    # -- the dispatcher thread -----------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._serve_loop,
+                    name=f"sparkdl-serve-{self.name}", daemon=True)
+                self._worker.start()
+
+    def _serve_loop(self) -> None:
+        reg = default_registry()
+        while True:
+            batch = self._queue.collect(self.chunk,
+                                        self.config.max_wait_s)
+            if batch is None:
+                return          # closed and drained
+            for req in batch.expired:
+                # failed BEFORE dispatch: no device time for the dead
+                if req.fail(DeadlineExceeded(
+                        f"deadline passed after {time.perf_counter() - req.submitted:.3f}s queued "
+                        f"(model {self.name!r})")):
+                    self.metrics.add_deadline_miss()
+            reg.gauge("serve.queue_rows").set(self._queue.depth())
+            if batch.parts:
+                try:
+                    self._dispatch(batch)
+                except Exception as e:
+                    # a failed dispatch fails ITS requests; the
+                    # dispatcher keeps serving the rest of the queue
+                    logger.exception(
+                        "serve dispatch failed for model %r",
+                        self.name)
+                    for req, _lo, _rows in batch.parts:
+                        req.fail(e)
+            self.metrics.publish(reg)
+
+    def _dispatch(self, batch: MicroBatch) -> None:
+        valid = batch.valid
+        inputs = self._assemble(batch.parts, valid)
+        fill = valid / self.chunk
+        with span("dispatch", lane="serve", rows=valid,
+                  requests=len(batch.parts), fill=round(fill, 3),
+                  model=self.name):
+            out = self.runner.run(inputs)
+        batch_lo = 0
+        completed: List[Request] = []
+        for req, req_lo, rows in batch.parts:
+            if req.write(out, batch_lo, req_lo, rows):
+                completed.append(req)
+            batch_lo += rows
+        done_t = time.perf_counter()
+        for req in completed:
+            self.metrics.observe_latency(done_t - req.submitted)
+        self.metrics.add_batch(valid, self.chunk)
+
+    def _assemble(self, parts, valid: int) -> Dict[str, np.ndarray]:
+        """The micro-batch's device inputs: a single request already
+        spanning the full chunk passes through as plain views (the
+        zero-copy fast path — the runner ships contiguous full chunks
+        without staging); everything else coalesces through the
+        session's persistent ``PadStaging`` buffers (``stage_parts``
+        writes each request's rows consecutively and zero-pads the
+        tail), so steady-state serving allocates nothing per batch."""
+        sig = self.runner.model_fn.input_signature
+        if len(parts) == 1 and valid == self.chunk:
+            req, lo, rows = parts[0]
+            views = {k: req.inputs[k][lo:lo + rows] for k in sig}
+            if all(v.flags.c_contiguous for v in views.values()):
+                return views
+        return {
+            k: self._staging.stage_parts(
+                k, [req.inputs[k][lo:lo + rows]
+                    for req, lo, rows in parts], self.chunk)
+            for k in sig}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions; drain (default) or discard the queue; join
+        the dispatcher. The engine quiesce discipline: bounded by
+        ``drain_timeout_s`` and LOUD on failure, never a hang or a
+        silent swallow."""
+        abandoned = self._queue.close(drain)
+        for req in abandoned:
+            req.fail(ServerClosed(
+                f"server closed before this request was dispatched "
+                f"(model {self.name!r})"))
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(self.config.drain_timeout_s)
+            if worker.is_alive():
+                logger.warning(
+                    "serve session %r did not drain within %.1fs; "
+                    "dispatcher left running (daemon)", self.name,
+                    self.config.drain_timeout_s)
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        # the dispatcher thread, lock, and warm staging buffers are
+        # process-local; the runner/config/metrics carry their own
+        # drop-and-recreate hooks. The queue ships empty (in-flight
+        # futures are process-local by nature) but keeps its closing
+        # flag — a closed server stays closed across the wire.
+        state = self.__dict__.copy()
+        del state["_lock"]
+        del state["_worker"]
+        del state["_staging"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._worker = None     # restarts lazily on first submit
+        self._staging = PadStaging()
+
+
+class ModelServer:
+    """Thread-safe online inference server over registered model
+    sessions (module docstring; user guide: docs/SERVING.md)."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self.metrics = ServeMetrics()
+        self._sessions: Dict[str, ModelSession] = {}
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- registry ------------------------------------------------------------
+
+    def register(self, name: str, model_fn=None, *, runner=None,
+                 batch_size: int = 64, mesh=None,
+                 strategy: Optional[str] = None,
+                 max_inflight: Optional[int] = None) -> ModelSession:
+        """Register a model under ``name``: either a ``ModelFunction``
+        (a ``BatchRunner`` is built; pass ``mesh`` for a data-parallel
+        ``ShardedBatchRunner`` — ``batch_size`` is then PER-CHIP) or a
+        prebuilt runner. Returns the session (for per-model warmup /
+        introspection)."""
+        if (model_fn is None) == (runner is None):
+            raise ValueError(
+                "register() takes exactly one of model_fn= or runner=")
+        if runner is None:
+            if mesh is not None:
+                runner = ShardedBatchRunner(
+                    model_fn, mesh=mesh, batch_size=batch_size,
+                    strategy=strategy, max_inflight=max_inflight)
+            else:
+                runner = BatchRunner(
+                    model_fn, batch_size=batch_size, strategy=strategy,
+                    max_inflight=max_inflight)
+        elif mesh is not None:
+            raise ValueError(
+                "pass mesh= with model_fn=, not with a prebuilt "
+                "runner (build the ShardedBatchRunner yourself)")
+        session = ModelSession(name, runner, self.config, self.metrics)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed(
+                    "cannot register on a closed server")
+            if name in self._sessions:
+                raise ValueError(f"model {name!r} already registered")
+            self._sessions[name] = session
+        return session
+
+    def session(self, model: Optional[str] = None) -> ModelSession:
+        """The named session; with one registered model, the default."""
+        with self._lock:
+            if not self._sessions:
+                raise ValueError("no models registered")
+            if model is None:
+                if len(self._sessions) > 1:
+                    raise ValueError(
+                        f"multiple models registered "
+                        f"({sorted(self._sessions)}); pass model=")
+                return next(iter(self._sessions.values()))
+            try:
+                return self._sessions[model]
+            except KeyError:
+                raise ValueError(
+                    f"unknown model {model!r}; registered: "
+                    f"{sorted(self._sessions)}") from None
+
+    # -- the front door ------------------------------------------------------
+
+    def submit(self, inputs: Dict[str, np.ndarray],
+               deadline: Optional[float] = None,
+               model: Optional[str] = None) -> Future:
+        """Submit one request: ``{name: [n, *row_shape]}`` host arrays
+        → Future resolving to ``{name: [n, *out_shape]}``. ``deadline``
+        is seconds from now; a request still queued past it fails with
+        ``DeadlineExceeded`` BEFORE any device time is spent. A full
+        queue raises ``ServerOverloaded`` immediately (backpressure —
+        the caller sheds or retries)."""
+        return self.session(model).submit(inputs, deadline)
+
+    def warmup(self) -> Dict[str, bool]:
+        """Pre-trace every registered session at its device batch
+        shape (per-session result: False = nothing to warm, e.g. host
+        backend) so no first request pays a compile."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return {s.name: s.warmup() for s in sessions}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions on every session, then drain (default) or
+        discard their queues and join the dispatchers — idempotent."""
+        with self._lock:
+            self._closed = True
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            s.close(drain)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close(drain=exc_type is None)
+        return False
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        # workers/locks/queue contents drop (inside each session's own
+        # hooks); config, registered runners, and cumulative metrics
+        # values travel
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()

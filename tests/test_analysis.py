@@ -254,6 +254,37 @@ class TestH3Concurrency:
         assert _hits(src, "H3") == []
         assert len(_suppressed(src, "H3")) == 1
 
+    def test_condition_holding_server_class_trips(self):
+        """A Condition wraps (or owns) a mutex — a server-shaped class
+        keeping one per instance has exactly the raw-Lock pickle
+        problem (the serve layer's RequestQueue shape), and must not
+        slip past H3 because it never says the word Lock."""
+        src = ("import threading\n"
+               "class RequestQueue:\n"
+               "    def __init__(self):\n"
+               "        self._cond = threading.Condition()\n"
+               "    def offer(self, req):\n"
+               "        with self._cond:\n"
+               "            self._cond.notify()\n")
+        hits = _hits(src, "H3")
+        assert len(hits) == 1
+        assert "_cond" in hits[0].message
+
+    def test_condition_with_getstate_clean(self):
+        """The serve queue's own discipline: drop-and-recreate hooks
+        make a Condition-holding class clean."""
+        assert _hits("import threading\n"
+                     "class RequestQueue:\n"
+                     "    def __init__(self):\n"
+                     "        self._lock = threading.Lock()\n"
+                     "        self._cond = threading.Condition("
+                     "self._lock)\n"
+                     "    def __getstate__(self):\n"
+                     "        s = self.__dict__.copy()\n"
+                     "        del s['_lock']\n"
+                     "        del s['_cond']\n"
+                     "        return s\n", "H3") == []
+
 
 # ---------------------------------------------------------------------------
 # H4 — quiesce hygiene
@@ -370,6 +401,15 @@ class TestHarness:
         for f in found:
             if f.suppressed:
                 assert f.suppression, f.render()
+
+    def test_meta_serve_package_is_clean(self):
+        """The serve layer is the newest lock-heavy subsystem — pin it
+        by name (zero unsuppressed H1–H4) so a refactor that breaks its
+        lock-pickle/quiesce discipline names the right package instead
+        of hiding in the whole-tree gate above."""
+        found = analyze_paths([os.path.join(PKG_DIR, "serve")])
+        unsuppressed = [f for f in found if not f.suppressed]
+        assert unsuppressed == [], format_findings(unsuppressed)
 
     def test_meta_known_drains_are_suppressed_not_invisible(self):
         """The drain path is allowlisted, not skipped: the single

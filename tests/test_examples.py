@@ -28,6 +28,19 @@ def test_hpo_example_runs(capsys):
     assert "accuracies" in capsys.readouterr().out
 
 
+def test_export_deploy_example_serves_online(capsys):
+    """The deploy example's last act (docs/SERVING.md): the exported
+    bytes behind a ModelServer under concurrent clients — the printed
+    serve counters prove the requests really went through the
+    micro-batcher (full batches, nothing rejected) rather than a
+    per-request fallback path."""
+    runpy.run_path("examples/export_deploy.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "serve: 12 concurrent requests" in out, out
+    assert "micro-batches" in out and "fill" in out, out
+    assert "rejections 0" in out, out
+
+
 def test_migration_guide_api_claims():
     """Every API shape docs/MIGRATION.md shows must exist as written —
     a stale migration guide misleads exactly the user it exists for."""

@@ -281,6 +281,54 @@ class TestRegistry:
         assert snap["ship.inflight"] == 0.0  # fully drained
         assert snap["ship.inflight_peak"] >= 1.0
 
+    def test_reservoir_quantiles_and_snapshot_keys(self):
+        reg = MetricsRegistry()
+        r = reg.reservoir("t.latency")
+        assert r.quantile(0.5) == 0.0    # empty never raises
+        for v in range(1, 101):
+            r.observe(float(v))
+        assert r.quantile(0.5) == 50.0
+        assert r.quantile(0.99) == 99.0
+        assert r.quantile(1.0) == 100.0
+        snap = reg.snapshot()
+        # reservoirs flatten to derived keys, one level deep
+        assert snap["t.latency.count"] == 100.0
+        assert snap["t.latency.p50"] == 50.0
+        assert snap["t.latency.p99"] == 99.0
+        with pytest.raises(ValueError, match="quantile"):
+            r.quantile(1.5)
+
+    def test_reservoir_window_bounded_count_lifetime(self):
+        from sparkdl_tpu.obs import Reservoir
+        r = Reservoir("t.win", capacity=4)
+        for v in range(10):
+            r.observe(float(v))
+        assert r.count == 10               # lifetime total
+        assert r.quantile(0.0) == 6.0      # window kept the newest 4
+        with pytest.raises(ValueError, match="capacity"):
+            Reservoir("t.bad", capacity=0)
+
+    def test_reservoir_kind_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.reservoir("t.r")
+        with pytest.raises(TypeError, match="Reservoir"):
+            reg.counter("t.r")
+        reg.gauge("t.g")
+        with pytest.raises(TypeError, match="Gauge"):
+            reg.reservoir("t.g")
+
+    def test_reservoir_round_trip_keeps_window(self):
+        import pickle
+
+        from sparkdl_tpu.obs import Reservoir
+        r = Reservoir("t.p")
+        r.observe(1.0)
+        r.observe(3.0)
+        r2 = pickle.loads(pickle.dumps(r))
+        assert r2.count == 2 and r2.quantile(1.0) == 3.0
+        r2.observe(5.0)                    # lock recreated, still works
+        assert r2.quantile(1.0) == 5.0
+
 
 # ---------------------------------------------------------------------------
 # collective launch observability

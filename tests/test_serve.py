@@ -1,0 +1,579 @@
+"""Online serving layer tests (docs/SERVING.md).
+
+The contract under test, per the serving spec:
+
+* dynamic micro-batching — small concurrent requests coalesce into
+  ``preferred_chunk``-aligned device batches; a request larger than
+  the chunk splits across micro-batches and reassembles in order;
+* admission control — a saturated bounded queue rejects with the
+  typed ``ServerOverloaded`` (no unbounded growth, no deadlock), and
+  requests whose deadline passes while queued fail with
+  ``DeadlineExceeded`` BEFORE dispatch;
+* warmup — after ``warmup()`` the first submit performs no new jit
+  trace (pinned by a trace-count test);
+* quiesce — graceful drain completes everything admitted; a
+  non-draining close fails the queue with ``ServerClosed``;
+* observability — ``serve``-lane spans + ``serve.*`` registry
+  metrics that MATCH observed outcomes;
+* pickle — the server follows the StageMetrics drop-and-recreate
+  discipline (workers/locks/queues dropped; config/runners travel).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry, tracer
+from sparkdl_tpu.runtime.runner import BatchRunner
+from sparkdl_tpu.serve import (
+    DeadlineExceeded,
+    ModelServer,
+    ServeConfig,
+    ServerClosed,
+    ServerOverloaded,
+)
+
+
+def _double_fn():
+    return ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                    input_shape=(3,))
+
+
+def _slow_host_fn(delay_s):
+    """Host-backend model sleeping per chunk — a deterministic
+    capacity knob for saturation/deadline tests (no jit, no device)."""
+    def apply(params, inputs):
+        time.sleep(delay_s)
+        return {"y": np.asarray(inputs["x"], np.float32) + 1.0}
+    return ModelFunction(apply, None, {"x": ((3,), np.float32)},
+                         output_names=["y"], backend="host")
+
+
+def _server(mf=None, *, batch_size=8, **cfg):
+    server = ModelServer(ServeConfig(**cfg))
+    server.register("m", mf or _double_fn(), batch_size=batch_size)
+    return server
+
+
+class TestSubmitBasics:
+    def test_roundtrip_single_full_chunk(self):
+        with _server(batch_size=4) as server:
+            x = np.arange(12, dtype=np.float32).reshape(4, 3)
+            out = server.submit({"input": x}).result(timeout=30)
+            np.testing.assert_allclose(out["output"], x * 2)
+
+    def test_small_requests_coalesce_into_one_batch(self):
+        # window generous vs. sub-ms submit spacing: all four 2-row
+        # requests land in ONE 8-row micro-batch
+        server = _server(batch_size=8, max_wait_s=0.5)
+        futs = [server.submit(
+            {"input": np.full((2, 3), i, np.float32)})
+            for i in range(4)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(
+                f.result(timeout=30)["output"], 2.0 * i)
+        server.close()
+        m = server.metrics
+        assert m.batches == 1, m.as_dict()
+        assert m.batch_fill_ratio == 1.0
+        assert m.requests == 4 and m.rows == 8
+
+    def test_large_request_splits_and_reassembles_in_order(self):
+        server = _server(batch_size=4, max_wait_s=0.0)
+        x = np.arange(30, dtype=np.float32).reshape(10, 3)
+        out = server.submit({"input": x}).result(timeout=30)
+        np.testing.assert_allclose(out["output"], x * 2)  # row order
+        server.close()
+        assert server.metrics.batches == 3  # 4 + 4 + 2
+        assert server.metrics.rows == 10
+
+    def test_zero_row_submission_resolves_immediately(self):
+        with _server(batch_size=4) as server:
+            fut = server.submit(
+                {"input": np.zeros((0, 3), np.float32)})
+            out = fut.result(timeout=1)
+            # schema-correct empties via empty_jax_outputs: the output
+            # row shape, zero rows
+            assert out["output"].shape == (0, 3)
+            assert out["output"].dtype == np.float32
+
+    def test_zero_row_submission_honors_close_and_signature(self):
+        """The N=0 fast path must not bypass the server contracts:
+        closed is closed, and declared inputs must be present even
+        when empty."""
+        server = _server(batch_size=4)
+        with pytest.raises(ValueError, match="missing"):
+            server.submit({"bogus": np.zeros((0, 5), np.float32)})
+        server.close()
+        with pytest.raises(ServerClosed):
+            server.submit({"input": np.zeros((0, 3), np.float32)})
+
+    def test_signature_validated_at_submit(self):
+        with _server() as server:
+            with pytest.raises(ValueError, match="missing from"):
+                server.submit({"wrong": np.zeros((2, 3), np.float32)})
+            with pytest.raises(ValueError, match="expects"):
+                server.submit({"input": np.zeros((2, 5), np.float32)})
+
+    def test_float64_caller_does_not_invalidate_warmup(self):
+        """Inputs cast to the signature dtype at admission: a sloppy
+        float64 caller must reuse the warmed float32 program, not
+        trigger a retrace (and get float32-typed results back)."""
+        traces = []
+
+        def fn(x):
+            traces.append(1)
+            return x * 2.0
+
+        server = _server(ModelFunction.fromSingle(fn, None,
+                                                  input_shape=(3,)),
+                         batch_size=4)
+        server.warmup()
+        out = server.submit(
+            {"input": np.ones((4, 3), np.float64)}).result(timeout=30)
+        np.testing.assert_allclose(out["output"], 2.0)
+        server.close()
+        assert len(traces) == 1, "float64 submit re-traced the program"
+
+    def test_multi_model_registry_routes_by_name(self):
+        server = ModelServer(ServeConfig())
+        server.register("double", _double_fn(), batch_size=4)
+        server.register("halve", ModelFunction.fromSingle(
+            lambda x: x / 2.0, None, input_shape=(3,)), batch_size=4)
+        with pytest.raises(ValueError, match="pass model="):
+            server.submit({"input": np.ones((1, 3), np.float32)})
+        with pytest.raises(ValueError, match="unknown model"):
+            server.submit({"input": np.ones((1, 3), np.float32)},
+                          model="nope")
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(
+            server.submit({"input": x}, model="double")
+            .result(timeout=30)["output"], 2.0)
+        np.testing.assert_allclose(
+            server.submit({"input": x}, model="halve")
+            .result(timeout=30)["output"], 0.5)
+        with pytest.raises(ValueError, match="already registered"):
+            server.register("double", _double_fn())
+        server.close()
+
+
+class TestWarmup:
+    def test_first_submit_after_warmup_performs_no_new_trace(self):
+        """THE warmup contract: jit traces call the Python fn once per
+        compilation — count those calls. After warmup() the first
+        submit must hit the compiled cache (every serve dispatch is
+        one padded preferred_chunk shape, so one zeros run covers
+        it)."""
+        traces = []
+
+        def fn(x):
+            traces.append(threading.get_ident())
+            return x * 2.0
+
+        mf = ModelFunction.fromSingle(fn, None, input_shape=(3,))
+        server = _server(mf, batch_size=8)
+        assert server.warmup() == {"m": True}
+        assert len(traces) == 1, "warmup should trace exactly once"
+        out = server.submit(
+            {"input": np.ones((3, 3), np.float32)}).result(timeout=30)
+        np.testing.assert_allclose(out["output"], 2.0)
+        server.close()
+        assert len(traces) == 1, \
+            "first submit after warmup re-traced the program"
+
+    def test_host_backend_warmup_is_a_noop(self):
+        server = _server(_slow_host_fn(0.0), batch_size=4)
+        assert server.warmup() == {"m": False}
+        out = server.submit(
+            {"x": np.zeros((2, 3), np.float32)}).result(timeout=30)
+        np.testing.assert_allclose(out["y"], 1.0)
+        server.close()
+
+
+class TestBackpressure:
+    def test_oversized_request_rejected_outright(self):
+        with _server(max_queue_rows=8) as server:
+            with pytest.raises(ServerOverloaded, match="never"):
+                server.submit(
+                    {"input": np.zeros((9, 3), np.float32)})
+        assert server.metrics.rejections == 1
+
+    def test_saturated_queue_rejects_with_typed_error(self):
+        # capacity ~4 rows/50ms; queue bounded at 8 rows — the third+
+        # immediate 4-row submit must be rejected, not queued
+        server = _server(_slow_host_fn(0.05), batch_size=4,
+                         max_queue_rows=8, max_wait_s=0.0)
+        accepted, rejected = [], 0
+        for _ in range(8):
+            try:
+                accepted.append(server.submit(
+                    {"x": np.zeros((4, 3), np.float32)}))
+            except ServerOverloaded:
+                rejected += 1
+        assert rejected > 0
+        for f in accepted:
+            np.testing.assert_allclose(
+                f.result(timeout=30)["y"], 1.0)
+        server.close()
+        assert server.metrics.rejections == rejected
+        assert server.metrics.requests == len(accepted)
+
+    def test_deadline_expired_request_fails_before_dispatch(self):
+        # first request occupies the dispatcher ~0.2s; the second's
+        # 10ms deadline passes while queued → DeadlineExceeded, and
+        # the model never sees its rows
+        seen_rows = []
+
+        def apply(params, inputs):
+            seen_rows.append(len(inputs["x"]))
+            time.sleep(0.2)
+            return {"y": np.asarray(inputs["x"], np.float32)}
+        mf = ModelFunction(apply, None, {"x": ((3,), np.float32)},
+                           output_names=["y"], backend="host")
+        server = _server(mf, batch_size=4, max_wait_s=0.0)
+        first = server.submit({"x": np.zeros((4, 3), np.float32)})
+        time.sleep(0.05)        # first is now dispatching
+        doomed = server.submit({"x": np.ones((4, 3), np.float32)},
+                               deadline=0.01)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        first.result(timeout=30)
+        server.close()
+        assert server.metrics.deadline_misses == 1
+        assert sum(seen_rows) == 4, \
+            "the expired request's rows reached the model"
+
+    def test_expired_request_fails_promptly_not_after_the_window(self):
+        """Once an expired request is detected, collect() must return
+        at once — the dead request's failure (and any live parts
+        already held, dispatched as a partial batch) must not sit out
+        a long max_wait_s window."""
+        server = _server(_slow_host_fn(0.2), batch_size=4,
+                         max_wait_s=2.0)
+        t0 = time.perf_counter()
+        server.submit({"x": np.zeros((4, 3), np.float32)})
+        time.sleep(0.05)        # dispatcher is now busy ~0.2s
+        doomed = server.submit({"x": np.ones((2, 3), np.float32)},
+                               deadline=0.01)
+        live = server.submit({"x": np.full((1, 3), 7.0, np.float32)})
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        np.testing.assert_allclose(live.result(timeout=30)["y"], 8.0)
+        elapsed = time.perf_counter() - t0
+        server.close()
+        assert elapsed < 1.0, \
+            f"expired request held through the coalesce window " \
+            f"({elapsed:.2f}s)"
+
+    def test_nonpositive_deadline_fails_fast(self):
+        with _server() as server:
+            fut = server.submit({"input": np.ones((1, 3), np.float32)},
+                                deadline=0.0)
+            with pytest.raises(DeadlineExceeded, match="not in the"):
+                fut.result(timeout=1)
+        assert server.metrics.deadline_misses == 1
+
+
+class TestSaturationSoak:
+    def test_multithreaded_saturation_no_deadlock_counters_match(self):
+        """The acceptance scenario: offered load > capacity against a
+        bounded queue from many threads. Every submit must either be
+        admitted (and then complete or fail with a deadline error) or
+        be rejected with ServerOverloaded; the queue never grows past
+        its bound; the serve.* counters match the observed outcomes;
+        and the whole thing finishes (join timeouts are the deadlock
+        canary)."""
+        server = _server(_slow_host_fn(0.01), batch_size=8,
+                         max_queue_rows=32, max_wait_s=0.005,
+                         default_deadline_s=5.0)
+        n_threads, per_thread, rows = 4, 30, 4
+        futures, lock = [], threading.Lock()
+        outcomes = {"rejected": 0}
+
+        def fire(tid):
+            x = np.full((rows, 3), float(tid), np.float32)
+            for _ in range(per_thread):
+                try:
+                    f = server.submit({"x": x})
+                except ServerOverloaded:
+                    with lock:
+                        outcomes["rejected"] += 1
+                else:
+                    with lock:
+                        futures.append((tid, f))
+        threads = [threading.Thread(target=fire, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "submitter deadlocked"
+
+        completed = missed = 0
+        for tid, f in futures:
+            try:
+                out = f.result(timeout=60)
+            except DeadlineExceeded:
+                missed += 1
+            else:
+                completed += 1
+                np.testing.assert_allclose(out["y"], float(tid) + 1.0)
+        server.close()
+
+        assert outcomes["rejected"] > 0, \
+            "offered load never saturated the queue"
+        assert completed > 0
+        m = server.metrics
+        assert m.rejections == outcomes["rejected"]
+        assert m.deadline_misses == missed
+        assert m.requests == len(futures)
+        assert m.rows == len(futures) * rows
+        # the published registry view matches the per-server metrics
+        snap = default_registry().snapshot()
+        assert snap["serve.rejections"] == outcomes["rejected"]
+        assert snap["serve.deadline_misses"] == missed
+        assert snap["serve.queue_rows"] == 0.0
+        assert 0.0 < m.batch_fill_ratio <= 1.0
+        assert m.latency_seconds(0.99) >= m.latency_seconds(0.5) > 0.0
+
+
+class TestQuiesce:
+    def test_graceful_drain_completes_admitted_work(self):
+        server = _server(_slow_host_fn(0.02), batch_size=4,
+                         max_wait_s=0.0, max_queue_rows=64)
+        futs = [server.submit({"x": np.zeros((2, 3), np.float32)})
+                for _ in range(6)]
+        server.close(drain=True)
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=1)["y"], 1.0)
+        with pytest.raises(ServerClosed):
+            server.submit({"x": np.zeros((2, 3), np.float32)})
+        server.close()  # idempotent
+
+    def test_non_draining_close_fails_queued_requests(self):
+        server = _server(_slow_host_fn(0.1), batch_size=4,
+                         max_wait_s=0.0, max_queue_rows=64)
+        futs = [server.submit({"x": np.zeros((4, 3), np.float32)})
+                for _ in range(5)]
+        server.close(drain=False)
+        outcomes = {"ok": 0, "closed": 0}
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                outcomes["ok"] += 1
+            except ServerClosed:
+                outcomes["closed"] += 1
+        # whatever was already dispatched completes; the rest fail
+        # with the typed shutdown error — nothing hangs, nothing lost
+        assert outcomes["closed"] > 0
+        assert outcomes["ok"] + outcomes["closed"] == 5
+
+    def test_dispatch_failure_fails_its_requests_not_the_server(self):
+        calls = []
+
+        def apply(params, inputs):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient model failure")
+            return {"y": np.asarray(inputs["x"], np.float32)}
+        mf = ModelFunction(apply, None, {"x": ((3,), np.float32)},
+                           output_names=["y"], backend="host")
+        server = _server(mf, batch_size=4, max_wait_s=0.0)
+        bad = server.submit({"x": np.zeros((4, 3), np.float32)})
+        with pytest.raises(RuntimeError, match="transient"):
+            bad.result(timeout=30)
+        good = server.submit({"x": np.zeros((4, 3), np.float32)})
+        np.testing.assert_allclose(good.result(timeout=30)["y"], 0.0)
+        server.close()
+
+
+class TestMeshSessions:
+    def test_sharded_session_serves_and_takes_collective_launch(self):
+        """A model-parallel mesh session dispatches through
+        ShardedBatchRunner.run, which takes the collective launch lock
+        — the armed trace must show collective_lock_wait inside the
+        serve dispatch, and the session must report itself
+        collective."""
+        from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        trc = tracer()
+        trc.clear()
+        trc.arm()
+        try:
+            server = ModelServer(ServeConfig(max_wait_s=0.0))
+            session = server.register(
+                "mesh", _double_fn(),
+                mesh=make_mesh(MeshSpec(data=-1, model=2)),
+                batch_size=1)
+            assert session.collective is True
+            server.warmup()
+            n = session.chunk
+            x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+            out = server.submit({"input": x}).result(timeout=60)
+            np.testing.assert_allclose(out["output"], x * 2)
+            server.close()
+            names = {s.name for s in trc.spans()}
+            lanes = {s.lane for s in trc.spans()}
+            assert "serve" in lanes
+            assert "collective_lock_wait" in names
+        finally:
+            trc.arm_from_env()
+            trc.clear()
+
+    def test_pure_dp_session_is_not_collective(self):
+        server = ModelServer(ServeConfig())
+        session = server.register("dp", _double_fn(), mesh=None,
+                                  batch_size=4)
+        assert session.collective is False
+        server.close()
+
+
+class TestObservability:
+    def test_serve_lane_spans_and_report(self):
+        """An armed serve run records enqueue/coalesce/dispatch spans
+        on the serve lane, and the report CLI summarizes them through
+        the SAME per-lane machinery as the pipeline lanes — coalesce
+        shows up as a wait-shaped stall."""
+        import json
+
+        from sparkdl_tpu.obs.report import summarize
+
+        trc = tracer()
+        trc.clear()
+        trc.arm()
+        try:
+            with _server(batch_size=4, max_wait_s=0.01) as server:
+                server.warmup()
+                for _ in range(3):
+                    server.submit(
+                        {"input": np.ones((2, 3), np.float32)}
+                    ).result(timeout=30)
+            by_lane = {}
+            for s in trc.spans():
+                by_lane.setdefault(s.lane, set()).add(s.name)
+            assert {"enqueue", "coalesce",
+                    "dispatch"} <= by_lane["serve"], by_lane
+            events = trc.trace_events()
+            json.dumps(events)  # exportable
+            text = summarize(events)
+            assert "serve" in text
+            assert "coalesce" in text.split("stalls")[1], \
+                "coalesce missing from the stall breakdown"
+        finally:
+            trc.arm_from_env()
+            trc.clear()
+
+    def test_disarmed_serve_records_nothing(self):
+        trc = tracer()
+        trc.clear()
+        before = len(trc.spans())
+        with _server(batch_size=4) as server:
+            server.submit(
+                {"input": np.ones((2, 3), np.float32)}
+            ).result(timeout=30)
+        assert len(trc.spans()) == before
+
+    def test_queue_depth_gauges(self):
+        server = _server(_slow_host_fn(0.05), batch_size=4,
+                         max_wait_s=0.0, max_queue_rows=64)
+        futs = [server.submit({"x": np.zeros((4, 3), np.float32)})
+                for _ in range(4)]
+        snap = default_registry().snapshot()
+        assert snap["serve.queue_rows_peak"] >= 4
+        for f in futs:
+            f.result(timeout=30)
+        server.close()
+        assert default_registry().snapshot()["serve.queue_rows"] == 0.0
+
+
+class TestPickle:
+    def test_server_round_trip_drops_workers_and_locks(self):
+        """The StageMetrics precedent, server-shaped: config and
+        registered runners travel, worker threads / locks / queued
+        futures drop, and the arrived server serves."""
+        cloudpickle = pytest.importorskip("cloudpickle")
+
+        server = _server(batch_size=4, max_wait_s=0.01,
+                         max_queue_rows=128)
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        server.submit({"input": x}).result(timeout=30)  # warm state
+
+        server2 = cloudpickle.loads(cloudpickle.dumps(server))
+        assert server2.config == server.config
+        s2 = server2.session("m")
+        assert s2._worker is None           # workers dropped
+        assert s2._queue.depth() == 0       # queue arrives empty
+        out = server2.submit({"input": x}).result(timeout=30)
+        np.testing.assert_allclose(out["output"], x * 2)
+        # cumulative metrics values traveled (the precedent: values
+        # travel, locks drop) and keep counting on arrival
+        assert server2.metrics.requests == server.metrics.requests + 1
+        server2.close()
+        server.close()
+
+    def test_closed_server_stays_closed_across_the_wire(self):
+        cloudpickle = pytest.importorskip("cloudpickle")
+
+        server = _server(batch_size=4)
+        server.close()
+        server2 = cloudpickle.loads(cloudpickle.dumps(server))
+        with pytest.raises(ServerClosed):
+            server2.submit({"input": np.ones((1, 3), np.float32)})
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="max_wait_s"):
+            ServeConfig(max_wait_s=-1.0)
+        with pytest.raises(ValueError, match="max_queue_rows"):
+            ServeConfig(max_queue_rows=0)
+        with pytest.raises(ValueError, match="default_deadline_s"):
+            ServeConfig(default_deadline_s=0.0)
+        with pytest.raises(ValueError, match="drain_timeout_s"):
+            ServeConfig(drain_timeout_s=0.0)
+
+
+class TestStageParts:
+    def test_stage_parts_reuses_one_buffer_and_zero_pads(self):
+        from sparkdl_tpu.runtime.runner import CopyCounters, PadStaging
+
+        staging, counters = PadStaging(), CopyCounters()
+        a = np.ones((2, 3), np.float32)
+        b = np.full((3, 3), 2.0, np.float32)
+        buf = staging.stage_parts("x", [a, b], 8, counters)
+        assert buf.shape == (8, 3)
+        np.testing.assert_array_equal(buf[:2], 1.0)
+        np.testing.assert_array_equal(buf[2:5], 2.0)
+        np.testing.assert_array_equal(buf[5:], 0.0)
+        assert counters.bytes_staged == a.nbytes + b.nbytes
+        assert counters.bytes_copied == 0
+        # second call: SAME buffer object, stale rows re-zeroed
+        buf2 = staging.stage_parts("x", [np.full((1, 3), 9.0,
+                                                 np.float32)], 8)
+        assert buf2 is buf
+        np.testing.assert_array_equal(buf[0], 9.0)
+        np.testing.assert_array_equal(buf[1:], 0.0)
+
+    def test_stage_parts_rejects_overflow(self):
+        from sparkdl_tpu.runtime.runner import PadStaging
+
+        with pytest.raises(ValueError, match="rows"):
+            PadStaging().stage_parts(
+                "x", [np.ones((5, 3), np.float32)], 4)
+
+    def test_runner_warmup_traces_once(self):
+        traces = []
+
+        def fn(x):
+            traces.append(1)
+            return x * 2.0
+
+        r = BatchRunner(ModelFunction.fromSingle(fn, None,
+                                                 input_shape=(3,)),
+                        batch_size=4)
+        assert r.warmup() is True
+        assert len(traces) == 1
+        x = np.ones((4, 3), np.float32)
+        np.testing.assert_allclose(r.run({"input": x})["output"], 2.0)
+        assert len(traces) == 1, "post-warmup run re-traced"

@@ -13,16 +13,17 @@
 #   4. bench smoke: the REAL bench.py in its tiny shape
 #      (SPARKDL_TPU_BENCH_TINY=1, TestNet, CPU) with a schema gate —
 #      a bench refactor that drops pipeline_bound_by, a ceiling key,
-#      or the host-copy counters fails HERE instead of failing the
-#      next TPU round's driver parse. Runs under
-#      SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard enforces the
-#      aligned ship path's zero-copy claim at runtime, not just in
-#      the counters.
+#      the host-copy counters, or the serve block (docs/SERVING.md)
+#      fails HERE instead of failing the next TPU round's driver
+#      parse. Runs under SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard
+#      enforces the aligned ship path's zero-copy claim at runtime,
+#      not just in the counters.
 #   5. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
 #      (SPARKDL_TPU_TRACE=1) and its exported Perfetto trace is
 #      schema-checked (valid trace-event list, ≥1 span per lane:
-#      engine/ship/device), then an end-to-end armed run (engine
-#      stages → runner dispatch/drain → estimator steps → a
+#      engine/ship/device/serve, with serve batch fill > 0.5 under
+#      the concurrent synthetic load), then an end-to-end armed run
+#      (engine stages → runner dispatch/drain → estimator steps → a
 #      collective launch) must produce a trace carrying a
 #      collective_lock_wait span, and the report CLI must read it
 #   6. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
@@ -88,10 +89,21 @@ required = [
     "host_decode_ips", "host_decode_ips_packed",
     "host_decode_ips_packed420",
     "pipeline_bound_by", "pipeline_stage_ceilings_ips",
-    "host_copy", "fidelity", "runner_strategy", "sanitize",
+    "host_copy", "fidelity", "runner_strategy", "sanitize", "serve",
 ]
 missing = [k for k in required if k not in d]
 assert not missing, f"bench smoke: missing JSON keys {missing}"
+# the serve block (docs/SERVING.md): the online front-end's own
+# numbers — offered vs achieved load, fill, tail latency, and the
+# backpressure/deadline counters the acceptance contract names
+srv = d["serve"]
+srv_required = ["offered_rows_per_s", "achieved_rows_per_s",
+                "requests", "rows", "batches", "batch_fill_ratio",
+                "p99_latency_ms", "rejections", "deadline_misses"]
+missing = [k for k in srv_required if k not in srv]
+assert not missing, f"bench smoke: missing serve keys {missing}"
+assert srv["batches"] > 0 and srv["requests"] > 0, srv
+assert 0.0 <= srv["batch_fill_ratio"] <= 1.0, srv
 hc = d["host_copy"]
 hc_required = ["aligned", "tail", "pipeline_bytes_staged",
                "pipeline_bytes_copied", "pipeline_transfer_wait_s"]
@@ -145,11 +157,19 @@ for e in spans:
     for k in ("ts", "dur", "pid", "tid"):
         assert k in e, (k, e)
 got = {lanes.get(e["pid"]) for e in spans}
-for lane in ("engine", "ship", "device"):
+for lane in ("engine", "ship", "device", "serve"):
     assert lane in got, \
         f"lane {lane!r} missing from armed bench trace (got {sorted(l for l in got if l)})"
+# the serve acceptance gate: under the armed run's concurrent
+# synthetic load the micro-batcher must actually fill device batches
+assert d["serve"]["batch_fill_ratio"] > 0.5, d["serve"]
+serve_names = {e["name"] for e in spans
+               if lanes.get(e["pid"]) == "serve"}
+assert "dispatch" in serve_names and "coalesce" in serve_names, \
+    sorted(serve_names)
 print(json.dumps({"obs_bench_trace": "ok", "spans": len(spans),
-                  "lanes": sorted(l for l in got if l)}))
+                  "lanes": sorted(l for l in got if l),
+                  "serve_fill": d["serve"]["batch_fill_ratio"]}))
 EOF
 # end-to-end armed run in ONE process: engine stages -> runner
 # dispatch/drain -> estimator epoch/steps -> a collective launch; its
