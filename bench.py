@@ -369,6 +369,11 @@ def _start_watchdog(seconds: int = 2400, on_cpu: bool = False) -> None:
 
 
 def main() -> None:
+    # FIRST: a wedged bench is exactly the flight recorder's use case —
+    # SPARKDL_TPU_FLIGHT=1 must install the SIGUSR2 trigger + span
+    # retention before any section that can stall, not at reporting time
+    from sparkdl_tpu.obs import flight as obs_flight
+    obs_flight.autoarm()
     tpu_down = False
     if not _probe_accelerator():
         import jax
@@ -592,7 +597,7 @@ def main() -> None:
     # armed the run, the span timeline exports as Perfetto trace-event
     # JSON (SPARKDL_TPU_TRACE_EXPORT names the path) and ci.sh's obs
     # gate schema-checks it (≥1 span per engine/ship/device lane)
-    from sparkdl_tpu.obs import default_registry, tracer
+    from sparkdl_tpu.obs import default_registry, stall_watchdog, tracer
     trc = tracer()
     obs_block = {
         "trace_armed": bool(trc.armed),
@@ -600,6 +605,11 @@ def main() -> None:
         "trace_export": None,
         "trace_dropped": trc.dropped,
         "registry": default_registry().snapshot(),
+        # the operability layer's own state (docs/OBSERVABILITY.md):
+        # whether the run was stall-monitored and whether any flight
+        # bundle was written during it
+        "watchdog": stall_watchdog().verdict(),
+        "flight": obs_flight.recorder().status(),
     }
     if trc.armed:
         trace_path = os.environ.get("SPARKDL_TPU_TRACE_EXPORT",
@@ -607,6 +617,11 @@ def main() -> None:
         obs_block["trace_events"] = trc.export(trace_path)
         obs_block["trace_export"] = trace_path
     print(json.dumps({
+        # monotonically bumped whenever a key is REMOVED or retyped
+        # (additions are compatible); tools/bench_compare.py gates a
+        # fresh tiny-bench against the committed round schema so
+        # bench-trajectory tracking can't silently drift
+        "schema_version": 1,
         "metric": (f"images_per_sec_per_chip_testnet_featurize"
                    f"[{platform},tiny]" if BENCH_TINY else
                    f"images_per_sec_per_chip_inceptionv3_featurize"

@@ -12,7 +12,7 @@ static half of the enforcement pair (the dynamic half is
 ``sparkdl_tpu.runtime.sanitize``, which puts ``jax.transfer_guard``
 under the ship path at runtime).
 
-Four rules, each an AST visitor over every module in the package:
+Five rules, each an AST visitor over every module in the package:
 
 * **H1 — implicit host transfers**: ``jax.device_get`` /
   ``.block_until_ready()`` / ``np.asarray(<jnp-producing call>)``
@@ -33,6 +33,12 @@ Four rules, each an AST visitor over every module in the package:
   (``finally`` blocks, ``close``/``quiesce``/``__exit__``-shaped
   functions) — a swallowed secondary error during quiesce masks
   the drain the engine's effectful-source contract depends on.
+* **H5 — clock discipline** (path-scoped to ``sparkdl_tpu/obs/``
+  and ``sparkdl_tpu/serve/``): ``time.time()`` / ``datetime.now()``
+  are banned where span/latency math lives — everything must share
+  the tracer's ``time.perf_counter`` clock, or wall-clock steps
+  (NTP, suspend) silently skew the one timeline the obs layer
+  exists to keep honest.
 
 Findings suppress inline with a justification::
 

@@ -1,4 +1,4 @@
-"""The four sparkdl-lint rules (H1–H4), each an AST pass.
+"""The five sparkdl-lint rules (H1–H5), each an AST pass.
 
 Every rule is a function ``(tree, path) -> list[Finding]`` registered
 in :data:`RULES`; the walker runs all of them per file and then applies
@@ -498,6 +498,52 @@ def check_h4(tree: ast.AST, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# H5 — wall-clock reads in the observability/serving timing paths
+
+# The tracer's whole premise is ONE clock (time.perf_counter from a
+# single epoch): every span, latency reservoir sample, deadline, and
+# watchdog beat in obs/ and serve/ must come off it. time.time() /
+# datetime.now() are wall clocks — NTP steps them, they jump across
+# suspend, and mixing them with perf_counter intervals silently skews
+# exactly the numbers this layer exists to make trustworthy. The rule
+# is PATH-scoped: wall-clock reads elsewhere (bench stamps, file
+# mtimes) are fine.
+_H5_BANNED = {
+    "time.time": "time.perf_counter()",
+    "datetime.now": "time.perf_counter()",
+    "datetime.utcnow": "time.perf_counter()",
+    "datetime.datetime.now": "time.perf_counter()",
+    "datetime.datetime.utcnow": "time.perf_counter()",
+}
+_H5_PATHS = ("sparkdl_tpu/obs/", "sparkdl_tpu/serve/")
+
+
+class _H5Clock(_ScopedVisitor):
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if name in _H5_BANNED:
+            self.flag(
+                "H5", node,
+                f"`{name}()` in the obs/serve timing layer: span and "
+                "latency math must share the tracer's monotonic clock "
+                f"— use {_H5_BANNED[name]} (wall time jumps with NTP/"
+                "suspend and silently skews the one timeline this "
+                "layer exists to keep honest); a genuine wall-clock "
+                "need (a human-readable artifact stamp) suppresses: "
+                "`# sparkdl-lint: allow[H5] -- <why>`")
+        self.generic_visit(node)
+
+
+def check_h5(tree: ast.AST, path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(p in norm for p in _H5_PATHS):
+        return []
+    v = _H5Clock(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
@@ -505,6 +551,7 @@ RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
     "H2": check_h2,
     "H3": check_h3,
     "H4": check_h4,
+    "H5": check_h5,
 }
 
 _RULE_DOCS = {
@@ -520,6 +567,9 @@ _RULE_DOCS = {
           "fields must hold self._lock",
     "H4": "quiesce hygiene: bare except; silently swallowed "
           "exceptions in cleanup/finally paths",
+    "H5": "clock discipline in sparkdl_tpu/obs/ and sparkdl_tpu/serve/"
+          ": time.time()/datetime.now() banned — span/latency math "
+          "shares the tracer's time.perf_counter clock",
 }
 
 

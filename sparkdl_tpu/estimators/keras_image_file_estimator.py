@@ -34,6 +34,7 @@ import numpy as np
 from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import span
+from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.parallel.mesh import collective_launch
 from sparkdl_tpu.params import (
     CanLoadImage,
@@ -452,7 +453,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     xb = jnp.asarray(X[sel])
                     yb = jnp.asarray(targets[sel])
                     with span("step", lane="estimator",
-                              rows=batch_size), launch:
+                              rows=batch_size), \
+                            watchdog_watch("estimator.step"), launch:
                         trainable, non_trainable, opt_state, loss = \
                             jitted(trainable, non_trainable, opt_state,
                                    xb, yb)
@@ -938,7 +940,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                         num_steps=steps_per_epoch):
                     gx, gy = place(xb, yb)
                     with span("step", lane="estimator",
-                              rows=rows_per_step), launch:
+                              rows=rows_per_step), \
+                            watchdog_watch("estimator.step"), launch:
                         trainable, non_trainable, opt_state, loss = \
                             jitted(trainable, non_trainable, opt_state,
                                    gx, gy)

@@ -16,6 +16,7 @@ import numpy as np
 
 from sparkdl_tpu.data.frame import column_index
 from sparkdl_tpu.obs import span
+from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.params.base import Param, TypeConverters, keyword_only
 from sparkdl_tpu.params.pipeline import Estimator, Model
 from sparkdl_tpu.params.shared import HasLabelCol
@@ -420,7 +421,8 @@ class LogisticRegression(Estimator, HasLabelCol):
         history = []
         for it in range(self.getOrDefault("maxIter")):
             with span("step", lane="estimator", iteration=it,
-                      rows=len(X)):
+                      rows=len(X)), \
+                    watchdog_watch("estimator.step"):
                 params, opt_state, loss = step(params, opt_state)
                 history.append(float(loss))
         return params, history
@@ -516,7 +518,8 @@ class LogisticRegression(Estimator, HasLabelCol):
 
                     step = _step
                 with span("step", lane="estimator", rows=len(xb),
-                          streaming=True):
+                          streaming=True), \
+                        watchdog_watch("estimator.step"):
                     params, opt_state, loss = step(params, opt_state,
                                                    xb, yb, wb)
                     losses.append(float(loss))
@@ -608,7 +611,9 @@ class LogisticRegression(Estimator, HasLabelCol):
                              np.zeros((pad,) + yb.shape[1:], yb.dtype)])
                         wb = np.concatenate(
                             [wb, np.zeros(pad, np.float32)])
-                    with span("step", lane="estimator", rows=len(idx)):
+                    with span("step", lane="estimator",
+                              rows=len(idx)), \
+                            watchdog_watch("estimator.step"):
                         params, opt_state, loss = step(params, opt_state,
                                                        xb, yb, wb)
                         losses.append(float(loss))

@@ -1,22 +1,42 @@
-"""Unified pipeline observability: spans, a metrics registry, and
-Perfetto-exportable timelines across engine → ship → device.
+"""Unified pipeline observability: spans, a metrics registry,
+Perfetto-exportable timelines, and an operable health surface across
+engine → ship → device.
 
-Three pieces (docs/OBSERVABILITY.md):
+Six pieces (docs/OBSERVABILITY.md):
 
 * :mod:`sparkdl_tpu.obs.trace` — ``span(name, lane=...)`` recording
   into one process-wide bounded ring buffer on a single clock, armed by
   ``SPARKDL_TPU_TRACE=1`` (near-zero overhead disarmed), exported as
   Chrome/Perfetto trace-event JSON;
-* :mod:`sparkdl_tpu.obs.registry` — named counters/gauges with ONE
-  ``snapshot() -> dict`` (bench's ``"obs"`` block, throughput_report);
+* :mod:`sparkdl_tpu.obs.registry` — named counters/gauges/reservoirs
+  with ONE ``snapshot() -> dict`` (bench's ``"obs"`` block,
+  throughput_report);
 * :mod:`sparkdl_tpu.obs.report` — ``python -m sparkdl_tpu.obs report
-  <trace.json>``: per-lane busy %, top spans, stall breakdown.
+  <trace.json>``: per-lane busy %, top spans, stall breakdown;
+* :mod:`sparkdl_tpu.obs.watchdog` — heartbeat-fed stall detection for
+  the hot loops (``SPARKDL_TPU_WATCHDOG=1``): no-progress beyond the
+  threshold logs loudly, counts ``watchdog.stalls``, and dumps the
+  flight recorder;
+* :mod:`sparkdl_tpu.obs.flight` — the flight recorder
+  (``SPARKDL_TPU_FLIGHT=1``): retains recent spans + the rolling
+  registry, writes a self-contained forensics bundle on ``dump()``,
+  SIGUSR2, serve dispatch failure, or a watchdog stall;
+* :mod:`sparkdl_tpu.obs.export` — Prometheus text rendering plus a
+  localhost ``/metricsz`` / ``/healthz`` / ``/statusz`` HTTP surface
+  (stdlib only), attachable to a ``ModelServer`` or standalone.
 
-Import-light on purpose: nothing here pulls jax (the report CLI works
-on any machine); :func:`timed_device_get` imports it lazily at the
-drain.
+Import-light on purpose: nothing here pulls jax (the report CLI and
+the telemetry endpoint work on any machine); :func:`timed_device_get`
+and the flight recorder's platform probes import it lazily.
 """
 
+from sparkdl_tpu.obs.export import (
+    TelemetryServer,
+    render_prometheus,
+    start_telemetry,
+)
+from sparkdl_tpu.obs.flight import FlightRecorder
+from sparkdl_tpu.obs.flight import recorder as flight_recorder
 from sparkdl_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -31,16 +51,25 @@ from sparkdl_tpu.obs.trace import (
     timed_device_get,
     tracer,
 )
+from sparkdl_tpu.obs.watchdog import StallWatchdog
+from sparkdl_tpu.obs.watchdog import watchdog as stall_watchdog
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "MetricsRegistry",
     "Reservoir",
     "SpanRecord",
+    "StallWatchdog",
+    "TelemetryServer",
     "Tracer",
     "default_registry",
+    "flight_recorder",
+    "render_prometheus",
     "span",
+    "stall_watchdog",
+    "start_telemetry",
     "timed_device_get",
     "tracer",
 ]

@@ -1,0 +1,251 @@
+"""Scrapeable health surface: Prometheus text + ``/healthz`` +
+``/statusz`` from a localhost stdlib HTTP server.
+
+The registry (PR 3) and the serve metrics (PR 4) made the pipeline's
+numbers *recordable*; this module makes them *operable*: a CI soak, a
+curl, or a Prometheus scraper can watch a live process without any
+in-process hook. Three endpoints, one tiny threading HTTP server
+(stdlib only — no new dependency, bound to localhost by default):
+
+* ``/metricsz`` — ``MetricsRegistry`` rendered as Prometheus text
+  exposition format (``# TYPE`` per metric; counters stay counters,
+  gauges gauges, reservoirs flatten to ``_p50``/``_p99`` gauges plus a
+  ``_count`` counter — same flattening as ``snapshot()``).
+* ``/healthz`` — liveness (the server answering IS the liveness bit)
+  plus the stall watchdog's verdict: 200 while healthy, 503 with the
+  stalled sources named once the watchdog flags a wedge.
+* ``/statusz`` — operator JSON: uptime, platform, watchdog verdict,
+  flight-recorder state, and per-model serve state (warmup, queue
+  depth, fill ratio) for every attached/registered ``ModelServer``.
+
+Attach it to a server (``ModelServer.serve_telemetry(port=...)``) or
+run it standalone around batch runs (:func:`start_telemetry`) — the
+registry is process-wide either way, so a standalone endpoint still
+sees every ship/collective/sanitize counter. ``port=0`` (the default)
+lets the OS pick; read ``TelemetryServer.port`` after ``start()``.
+
+Clocks are ``perf_counter`` deltas only (uptime) — sparkdl-lint H5.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from sparkdl_tpu.obs import flight as _flight
+from sparkdl_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Reservoir,
+    default_registry,
+)
+from sparkdl_tpu.obs.watchdog import watchdog
+
+logger = logging.getLogger(__name__)
+
+#: every exported sample is prefixed so a shared Prometheus namespace
+#: can tell this process's pipeline metrics from anyone else's
+PROM_PREFIX = "sparkdl_"
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """A registry key as a legal Prometheus metric name: dots (and any
+    other illegal byte) become underscores, and the ``sparkdl_`` prefix
+    guarantees a legal leading character."""
+    return PROM_PREFIX + _PROM_BAD.sub("_", name)
+
+
+def _fmt(value: float) -> str:
+    # Prometheus floats: repr round-trips, integers stay readable
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """The registry in Prometheus text exposition format (version
+    0.0.4): one ``# TYPE`` line per metric, kinds preserved. This is
+    THE scrape payload — ``tools/ci.sh``'s telemetry gate parses it
+    line-by-line so a rendering regression fails the build, not the
+    operator's dashboard."""
+    registry = registry if registry is not None else default_registry()
+    lines = []
+    for m in registry.metrics():
+        base = prom_name(m.name)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{base} {_fmt(m.value)}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base} {_fmt(m.value)}")
+        elif isinstance(m, Reservoir):
+            p50, p99 = m.quantiles((0.5, 0.99))
+            lines.append(f"# TYPE {base}_count counter")
+            lines.append(f"{base}_count {_fmt(m.count)}")
+            lines.append(f"# TYPE {base}_p50 gauge")
+            lines.append(f"{base}_p50 {_fmt(p50)}")
+            lines.append(f"# TYPE {base}_p99 gauge")
+            lines.append(f"{base}_p99 {_fmt(p99)}")
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryServer:
+    """Localhost HTTP surface over the process-wide registry, watchdog,
+    and flight recorder (module docstring).
+
+    ``model_server`` (optional) scopes ``/statusz``'s serve section to
+    one :class:`~sparkdl_tpu.serve.server.ModelServer`; without it the
+    section covers every live server the flight recorder knows about.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 model_server=None, watchdog_instance=None):
+        self._registry = (registry if registry is not None
+                          else default_registry())
+        self._requested = (host, port)
+        self._model_server = model_server
+        self._watchdog = (watchdog_instance if watchdog_instance
+                          is not None else watchdog())
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._epoch = time.perf_counter()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = "sparkdl-telemetry/1"
+
+            def do_GET(self):  # noqa: N802 (stdlib contract)
+                outer._route(self)
+
+            def log_message(self, fmt, *args):
+                logger.debug("telemetry: %s", fmt % args)
+
+        self._httpd = ThreadingHTTPServer(self._requested, _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="sparkdl-telemetry", daemon=True)
+        self._thread.start()
+        logger.info("telemetry endpoint listening on http://%s:%d "
+                    "(/metricsz /healthz /statusz)", *self.address)
+        return self
+
+    @property
+    def address(self):
+        if self._httpd is None:
+            return self._requested
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def url(self, path: str = "") -> str:
+        host, port = self.address
+        return f"http://{host}:{port}{path}"
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, handler: BaseHTTPRequestHandler) -> None:
+        path = handler.path.split("?", 1)[0]
+        try:
+            if path in ("/metricsz", "/metrics"):
+                body = render_prometheus(self._registry).encode()
+                self._reply(handler, 200, body,
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                verdict = self._watchdog.verdict()
+                code = 200 if verdict["healthy"] else 503
+                body = json.dumps({
+                    "status": "ok" if code == 200 else "stalled",
+                    "stalled_sources": verdict["stalled_sources"],
+                    "watchdog_armed": verdict["armed"],
+                }).encode()
+                self._reply(handler, code, body, "application/json")
+            elif path == "/statusz":
+                body = json.dumps(self._statusz(),
+                                  default=str).encode()
+                self._reply(handler, 200, body, "application/json")
+            else:
+                self._reply(handler, 404,
+                            b'{"error": "unknown path; try /metricsz, '
+                            b'/healthz, /statusz"}',
+                            "application/json")
+        except Exception:
+            # the health surface must never take the process down (and
+            # a broken probe should read as a 500, not a hang)
+            logger.exception("telemetry: %s handler failed", path)
+            try:
+                self._reply(handler, 500, b'{"error": "internal"}',
+                            "application/json")
+            except Exception as e:
+                logger.debug("telemetry: error reply failed: %s", e)
+
+    @staticmethod
+    def _reply(handler, code: int, body: bytes, ctype: str) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _statusz(self) -> dict:
+        if self._model_server is not None:
+            servers = [self._model_server.telemetry_status()]
+        else:
+            # the flight recorder's per-server degrade shaping, reused:
+            # /statusz and flight bundles must not drift apart
+            servers = _flight._serve_status()
+        return {
+            "pid": os.getpid(),
+            "uptime_s": round(time.perf_counter() - self._epoch, 3),
+            "platform": _flight.platform_info(),
+            "watchdog": self._watchdog.verdict(),
+            "flight": _flight.recorder().status(),
+            "servers": servers,
+            "metrics_count": len(self._registry.snapshot()),
+        }
+
+
+def start_telemetry(port: int = 0, host: str = "127.0.0.1",
+                    registry: Optional[MetricsRegistry] = None
+                    ) -> TelemetryServer:
+    """Standalone endpoint around batch runs: start scraping the
+    process-wide registry/watchdog/flight state with one call (close
+    the returned server when done, or let the daemon thread die with
+    the process)."""
+    return TelemetryServer(registry=registry, port=port,
+                           host=host).start()

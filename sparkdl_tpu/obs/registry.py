@@ -212,6 +212,15 @@ class MetricsRegistry:
                     "requested as Reservoir")
             return m
 
+    def metrics(self) -> list:
+        """The registered metric objects, sorted by name — the
+        kind-preserving readout (``snapshot()`` flattens kinds away;
+        the Prometheus renderer in :mod:`sparkdl_tpu.obs.export` needs
+        them to emit correct ``# TYPE`` lines)."""
+        with self._lock:
+            return [self._metrics[name]
+                    for name in sorted(self._metrics)]
+
     def snapshot(self) -> Dict[str, float]:
         """One flat {name: value} dict, sorted by name — the bench/CI
         contract (and what ``throughput_report`` renders from).

@@ -61,24 +61,36 @@ def _merged_length(intervals: List[Tuple[float, float]]) -> float:
 
 
 def summarize(events: Sequence[dict]) -> str:
-    """The text report (also unit-testable without the CLI)."""
-    lane_of_pid = {e["pid"]: e["args"]["name"] for e in events
+    """The text report (also unit-testable without the CLI).
+
+    Forward-compat contract: lanes are DATA, not a schema — a trace
+    carrying lanes this report has never heard of (newer
+    instrumentation), lane metadata with zero spans (an armed run that
+    never exercised a subsystem), or spans whose pid has no metadata
+    at all must all summarize, never crash; unknown lanes fall back to
+    the span's ``cat`` (or ``?``). Pinned by
+    ``tests/test_obs.py::TestReportForwardCompat``."""
+    lane_of_pid = {e["pid"]: e.get("args", {}).get("name", "?")
+                   for e in events
                    if e.get("ph") == "M"
-                   and e.get("name") == "process_name"}
-    spans = [e for e in events if e.get("ph") == "X"]
+                   and e.get("name") == "process_name"
+                   and "pid" in e}
+    spans = [e for e in events
+             if e.get("ph") == "X" and "ts" in e and "pid" in e]
     if not spans:
         return "(no spans in trace)"
     t0 = min(e["ts"] for e in spans)
-    t1 = max(e["ts"] + e["dur"] for e in spans)
+    t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
     wall_us = max(t1 - t0, 1e-9)
 
     by_lane: Dict[str, List[Tuple[float, float]]] = {}
     by_name: Dict[Tuple[str, str], List[float]] = {}
     for e in spans:
-        lane = lane_of_pid.get(e["pid"], e.get("cat", "?"))
+        lane = lane_of_pid.get(e["pid"]) or e.get("cat", "?")
+        dur = e.get("dur", 0.0)
         by_lane.setdefault(lane, []).append(
-            (e["ts"], e["ts"] + e["dur"]))
-        by_name.setdefault((lane, e["name"]), []).append(e["dur"])
+            (e["ts"], e["ts"] + dur))
+        by_name.setdefault((lane, e.get("name", "?")), []).append(dur)
 
     lines = [f"trace: {len(spans)} spans over {wall_us / 1e3:.3f} ms "
              f"across lanes {', '.join(sorted(by_lane))}",

@@ -1,0 +1,413 @@
+"""Stall watchdog: heartbeat-fed no-progress detection for the hot
+loops.
+
+The collective-launch deadlock PR 2 fixed presented as a silent hang —
+no error, no timeout, no forensics — and the serving layer added more
+loops that can wedge the same way (a dispatcher blocked in a collective
+program, a drain that never completes). This module is the detector:
+the loops that matter mark themselves ACTIVE (``watch(source)``) and
+beat cheaply while making progress (``pulse(source)``); a monitor
+thread flags any active source whose last beat is older than the
+threshold, logs loudly, increments ``watchdog.stalls`` in the metrics
+registry, and triggers a flight-recorder dump
+(:mod:`sparkdl_tpu.obs.flight`) so the hang arrives with a postmortem
+attached instead of a blank screen.
+
+Fed by: the serve dispatcher loop (one source per model session),
+``dispatch_chunks`` (the ship-side dispatch/drain state machine),
+the estimator step loops, and ``collective_launch`` lock holds
+(``collective.hold`` is active for exactly the time the process-wide
+launch lock is held — a hold past the threshold IS the deadlock
+signature).
+
+Arming follows the sanitizer's probe-and-degrade precedent:
+``SPARKDL_TPU_WATCHDOG=1`` in the environment (threshold via
+``SPARKDL_TPU_WATCHDOG_THRESHOLD_S``, default 30s), or
+``watchdog().arm(threshold_s=...)`` programmatically (the override
+wins). Disarmed, ``watch()`` returns one shared no-op context and
+``pulse()`` returns after a single armed-check — the same shared-no-op
+regime as the tracer, pinned alongside its <10µs bound
+(``tests/test_flight.py``).
+
+An idle process is NOT a stall: only sources inside a ``watch()``
+block are monitored, and every watched loop opens the block *after*
+its idle wait (the serve dispatcher watches from "batch collected" to
+"batch resolved", not while blocked waiting for work). Recovery is
+automatic — a stalled source that beats (or exits its watch block)
+clears its verdict and counts ``watchdog.recoveries``.
+
+All clocks are ``time.perf_counter()`` — the tracer's clock (and
+sparkdl-lint H5 enforces that no ``time.time()`` sneaks into obs/serve
+timing math).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from sparkdl_tpu.obs.registry import default_registry
+
+logger = logging.getLogger(__name__)
+
+_TRUE = ("1", "true", "yes", "on")
+
+#: no-progress threshold (seconds) when SPARKDL_TPU_WATCHDOG_THRESHOLD_S
+#: is unset — generous enough that a slow compile is not a "stall"
+DEFAULT_THRESHOLD_S = 30.0
+
+
+def _env_armed() -> bool:
+    return os.environ.get("SPARKDL_TPU_WATCHDOG", "").lower() in _TRUE
+
+
+# (raw env string, parsed value): threshold_s is read on every monitor
+# tick and every /healthz scrape — a config typo must warn ONCE per
+# value, not spam the log for the process lifetime
+_env_threshold_cache: Optional[tuple] = None
+
+
+def _env_threshold() -> float:
+    global _env_threshold_cache
+    raw = os.environ.get("SPARKDL_TPU_WATCHDOG_THRESHOLD_S", "")
+    cached = _env_threshold_cache
+    if cached is not None and cached[0] == raw:
+        return cached[1]
+    try:
+        v = float(raw) if raw else DEFAULT_THRESHOLD_S
+        if v <= 0:
+            raise ValueError(v)
+    except ValueError:
+        # a config typo must degrade to the default, not crash the loop
+        # that was trying to protect itself
+        logger.warning(
+            "SPARKDL_TPU_WATCHDOG_THRESHOLD_S=%r is not a positive "
+            "number; using the default %.1fs", raw, DEFAULT_THRESHOLD_S)
+        v = DEFAULT_THRESHOLD_S
+    _env_threshold_cache = (raw, v)
+    return v
+
+
+class _NoopWatch:
+    """The disarmed fast path: one shared instance, nothing tracked."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP_WATCH = _NoopWatch()
+
+
+class _Watch:
+    """An armed activity window: the source is monitored between enter
+    and exit, and exit ALWAYS deregisters (even if the watchdog was
+    disarmed mid-block) so no source leaks into a false stall later."""
+
+    __slots__ = ("_wd", "_source")
+
+    def __init__(self, wd: "StallWatchdog", source: str):
+        self._wd = wd
+        self._source = source
+
+    def __enter__(self):
+        self._wd.begin(self._source, _force=True)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._wd.end(self._source, _force=True)
+        return False
+
+
+class StallWatchdog:
+    """Heartbeat table + monitor thread (module docstring). One
+    process-wide instance (:func:`watchdog`) is what the instrumented
+    loops feed; standalone instances exist for tests."""
+
+    # sparkdl-lint H3 contract: sources register from every hot-loop
+    # thread at once — structural mutations of the table and the
+    # stall bookkeeping hold self._lock (pulse writes only a float
+    # slot in an existing entry, GIL-atomic by design: the beat must
+    # stay cheap enough for per-chunk call sites)
+    _lock_guards = ("stalls_fired",)
+
+    def __init__(self, threshold_s: Optional[float] = None):
+        # None → follow the env; a number → programmatic override
+        self._threshold_override = threshold_s
+        self._armed_override: Optional[bool] = None
+        self._lock = threading.Lock()
+        # source → [active_count, last_beat, stalled]
+        self._sources: Dict[str, list] = {}
+        self.stalls_fired = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- arming --------------------------------------------------------------
+
+    @property
+    def armed(self) -> bool:
+        ov = self._armed_override
+        if ov is not None:
+            return ov
+        return _env_armed()
+
+    @property
+    def threshold_s(self) -> float:
+        if self._threshold_override is not None:
+            return self._threshold_override
+        return _env_threshold()
+
+    def arm(self, threshold_s: Optional[float] = None) -> None:
+        """Monitor regardless of SPARKDL_TPU_WATCHDOG; an explicit
+        ``threshold_s`` overrides the env threshold too."""
+        if threshold_s is not None:
+            if threshold_s <= 0:
+                raise ValueError(
+                    f"threshold_s must be positive, got {threshold_s}")
+            self._threshold_override = threshold_s
+        self._armed_override = True
+        self._ensure_thread()
+
+    def disarm(self) -> None:
+        """Stop monitoring regardless of the env; the monitor thread
+        exits and active-source bookkeeping drains as watch blocks
+        close."""
+        self._armed_override = False
+        self._stop_thread()
+
+    def arm_from_env(self) -> None:
+        """Drop the programmatic overrides; follow the env again."""
+        self._armed_override = None
+        self._threshold_override = None
+        if self.armed:
+            self._ensure_thread()
+
+    # -- the heartbeat surface (hot path) ------------------------------------
+
+    def watch(self, source: str):
+        """Context manager marking ``source`` ACTIVE for its duration;
+        a shared no-op when disarmed. Open it around the *working*
+        phase of a loop (after the idle wait), then :meth:`pulse`
+        inside it on every unit of progress."""
+        if not self.armed:
+            return _NOOP_WATCH
+        return _Watch(self, source)
+
+    def begin(self, source: str, _force: bool = False) -> None:
+        """Non-context entry half of :meth:`watch` (for __enter__/
+        __exit__-shaped call sites like the collective launch lock)."""
+        if not _force and not self.armed:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            entry = self._sources.get(source)
+            if entry is None:
+                self._sources[source] = [1, now, False]
+            else:
+                entry[0] += 1
+                entry[1] = now
+        self._ensure_thread()
+
+    def end(self, source: str, _force: bool = False) -> None:
+        """Deactivate one :meth:`begin`. Cheap when nothing is tracked;
+        never checks ``armed`` beyond that, so a disarm between begin
+        and end cannot leak an eternally-active source."""
+        if not self._sources:
+            return
+        with self._lock:
+            entry = self._sources.get(source)
+            if entry is None:
+                return
+            entry[0] -= 1
+            if entry[0] <= 0:
+                was_stalled = entry[2]
+                del self._sources[source]
+                if was_stalled:
+                    default_registry().counter(
+                        "watchdog.recoveries").add()
+
+    def pulse(self, source: str) -> None:
+        """Record progress for ``source`` — one float write into the
+        entry's beat slot (GIL-atomic; no lock on the hot path). A
+        pulse outside any watch block is ignored."""
+        entry = self._sources.get(source)
+        if entry is not None:
+            entry[1] = time.perf_counter()
+
+    # -- the verdict ---------------------------------------------------------
+
+    def check_once(self, now: Optional[float] = None) -> List[str]:
+        """One monitor pass: flag newly-stalled sources (side effects:
+        loud log, ``watchdog.stalls``, flight dump), un-flag recovered
+        ones. Returns the sources CURRENTLY considered stalled."""
+        if now is None:
+            now = time.perf_counter()
+        threshold = self.threshold_s
+        fired: List[str] = []
+        recovered: List[str] = []
+        stalled: List[str] = []
+        with self._lock:
+            for source, entry in self._sources.items():
+                if entry[0] <= 0:
+                    continue
+                age = now - entry[1]
+                if age > threshold:
+                    if not entry[2]:
+                        entry[2] = True
+                        fired.append(source)
+                    stalled.append(source)
+                elif entry[2]:
+                    entry[2] = False
+                    recovered.append(source)
+        reg = default_registry()
+        for source in fired:
+            with self._lock:
+                self.stalls_fired += 1
+            reg.counter("watchdog.stalls").add()
+            logger.error(
+                "watchdog: source %r made no progress for > %.3fs — "
+                "possible stall/deadlock; dumping the flight recorder",
+                source, threshold)
+            self._dump_flight(source, threshold)
+        for source in recovered:
+            reg.counter("watchdog.recoveries").add()
+            logger.warning("watchdog: source %r resumed progress",
+                           source)
+        return stalled
+
+    def _dump_flight(self, source: str, threshold: float) -> None:
+        try:
+            from sparkdl_tpu.obs import flight
+            rec = flight.recorder()
+            if rec.armed:
+                rec.dump(reason=f"watchdog stall: {source!r} made no "
+                                f"progress for > {threshold:.3f}s")
+        except Exception:
+            # the watchdog must survive a failed postmortem — the
+            # stall log + counter above already happened
+            logger.exception("watchdog: flight-recorder dump failed")
+
+    def healthy(self) -> bool:
+        """False while any active source is flagged stalled — the
+        ``/healthz`` verdict."""
+        with self._lock:
+            return not any(e[2] for e in self._sources.values())
+
+    def verdict(self) -> dict:
+        """The scrape-able state: active source ages, current stalls,
+        lifetime fire count (``/healthz`` + ``/statusz`` + flight
+        bundles)."""
+        now = time.perf_counter()
+        with self._lock:
+            active = {s: round(now - e[1], 3)
+                      for s, e in self._sources.items() if e[0] > 0}
+            stalled = sorted(s for s, e in self._sources.items()
+                             if e[2])
+            fired = self.stalls_fired
+        return {"armed": self.armed,
+                "threshold_s": self.threshold_s,
+                "active_sources": active,
+                "stalled_sources": stalled,
+                "stalls_fired": fired,
+                "healthy": not stalled}
+
+    # -- the monitor thread --------------------------------------------------
+
+    def _interval(self) -> float:
+        # fast enough to fire "within threshold" of the stall, slow
+        # enough to cost nothing: a quarter-threshold tick, clamped
+        return min(max(self.threshold_s / 4.0, 0.01), 1.0)
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._monitor, name="sparkdl-watchdog",
+                daemon=True)
+            self._thread.start()
+
+    def _stop_thread(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+            self._stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=1.0)
+
+    def _monitor(self) -> None:
+        stop = self._stop
+        while not stop.wait(self._interval()):
+            if not self.armed:
+                return
+            try:
+                self.check_once()
+            except Exception:
+                logger.exception("watchdog: monitor pass failed")
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        # the monitor thread, lock, and active-source table are
+        # process-local; arming config travels
+        state = self.__dict__.copy()
+        del state["_lock"]
+        del state["_thread"]
+        del state["_stop"]
+        del state["_sources"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._sources = {}
+
+
+_WATCHDOG = StallWatchdog()
+
+
+def watchdog() -> StallWatchdog:
+    """THE process-wide watchdog the instrumented loops feed."""
+    return _WATCHDOG
+
+
+def watch(source: str):
+    """Module-level shorthand for ``watchdog().watch(...)`` — the form
+    the hot loops use. Disarmed it returns one shared no-op object."""
+    w = _WATCHDOG
+    if not w.armed:
+        return _NOOP_WATCH
+    return _Watch(w, source)
+
+
+def pulse(source: str) -> None:
+    """Module-level heartbeat: one armed-check then a float write."""
+    w = _WATCHDOG
+    if not w.armed:
+        return
+    w.pulse(source)
+
+
+def begin(source: str) -> None:
+    """Mark ``source`` active (non-context call sites: the collective
+    launch lock's __enter__)."""
+    w = _WATCHDOG
+    if not w.armed:
+        return
+    w.begin(source)
+
+
+def end(source: str) -> None:
+    """Deactivate one :func:`begin`; safe (and cheap) when disarmed or
+    never begun."""
+    _WATCHDOG.end(source)

@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkdl_tpu.obs import default_registry, span
+from sparkdl_tpu.obs import watchdog as _watchdog
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -78,6 +79,12 @@ class _CollectiveLaunch:
             reg.counter("collective.lock_wait_seconds").add(wait)
             if contended:
                 reg.counter("collective.lock_waits").add()
+            # stall-watchdog activity: the hold itself is the watched
+            # window — no beats happen while held, so a hold past the
+            # threshold (the PR-2 deadlock signature: a collective
+            # program that never completes its dispatch) trips the
+            # stall verdict and dumps the flight recorder
+            _watchdog.begin("collective.hold")
             return self
         except BaseException:
             if held:
@@ -85,6 +92,7 @@ class _CollectiveLaunch:
             raise
 
     def __exit__(self, exc_type, exc, tb):
+        _watchdog.end("collective.hold")
         self._lock.release()
         return False
 

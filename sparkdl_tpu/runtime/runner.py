@@ -84,6 +84,8 @@ import numpy as np
 
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import default_registry, span, timed_device_get
+from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
+from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.runtime.sanitize import ship_guard
 
 # In-flight device batches before the oldest result is fetched, for the
@@ -409,46 +411,54 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     # spans), ship.inflight_peak the process-LIFETIME high-water mark
     depth = default_registry().gauge("ship.inflight")
     depth_peak = default_registry().gauge("ship.inflight_peak")
-    nxt = next(chunks, None)
-    placed = None
-    if prefetch and nxt is not None:
-        with span("device_put", lane="ship", rows=nxt[0],
-                  prefetch=True):
-            placed = start_device_prefetch(nxt[1], sharding)
-        prefetch = placed is not None
-    while nxt is not None:
-        valid, chunk = nxt
-        if placed is not None:
-            chunk, placed = placed, None
-        elif place is not None:
-            with span("device_put", lane="ship", rows=valid):
-                chunk = place(chunk)
+    # stall-watchdog activity: one source per dispatching thread
+    # (concurrent runners must not mask each other's wedge); a beat per
+    # chunk, so a dispatch/drain that stops advancing past the
+    # threshold trips the stall verdict
+    wd_source = f"ship.dispatch@{threading.get_ident()}"
+    with watchdog_watch(wd_source):
         nxt = next(chunks, None)
+        placed = None
         if prefetch and nxt is not None:
-            # start chunk i+1's host→device transfer BEFORE dispatching
-            # chunk i: the transfer proceeds while the device computes i
             with span("device_put", lane="ship", rows=nxt[0],
                       prefetch=True):
                 placed = start_device_prefetch(nxt[1], sharding)
             prefetch = placed is not None
-        # NOTE: on async backends this span times the ENQUEUE of the
-        # jitted call, not device compute — device-side time is only
-        # host-observable at the drain (the device_get span)
-        with span("dispatch", lane="ship", rows=valid):
-            res = fn(params, chunk)
-        if host_async and not start_host_copies(res):
-            # missing API: the deep uncopied queue would recreate the
-            # stale-buffer collapse — shallow queue instead
-            host_async = False
-            limit = min(limit, MAX_INFLIGHT_BATCHES)
-        pending.append((valid, res))
-        batches += 1
-        depth.set(len(pending))
-        depth_peak.set_max(len(pending))
-        drain_bounded(pending, sink, limit)
-        depth.set(len(pending))
-    drain_bounded(pending, sink, 0)
-    depth.set(0)
+        while nxt is not None:
+            watchdog_pulse(wd_source)
+            valid, chunk = nxt
+            if placed is not None:
+                chunk, placed = placed, None
+            elif place is not None:
+                with span("device_put", lane="ship", rows=valid):
+                    chunk = place(chunk)
+            nxt = next(chunks, None)
+            if prefetch and nxt is not None:
+                # start chunk i+1's host→device transfer BEFORE
+                # dispatching chunk i: the transfer proceeds while the
+                # device computes i
+                with span("device_put", lane="ship", rows=nxt[0],
+                          prefetch=True):
+                    placed = start_device_prefetch(nxt[1], sharding)
+                prefetch = placed is not None
+            # NOTE: on async backends this span times the ENQUEUE of
+            # the jitted call, not device compute — device-side time is
+            # only host-observable at the drain (the device_get span)
+            with span("dispatch", lane="ship", rows=valid):
+                res = fn(params, chunk)
+            if host_async and not start_host_copies(res):
+                # missing API: the deep uncopied queue would recreate
+                # the stale-buffer collapse — shallow queue instead
+                host_async = False
+                limit = min(limit, MAX_INFLIGHT_BATCHES)
+            pending.append((valid, res))
+            batches += 1
+            depth.set(len(pending))
+            depth_peak.set_max(len(pending))
+            drain_bounded(pending, sink, limit)
+            depth.set(len(pending))
+        drain_bounded(pending, sink, 0)
+        depth.set(0)
     return batches
 
 

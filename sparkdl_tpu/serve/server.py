@@ -49,6 +49,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from sparkdl_tpu.obs import default_registry, span
+from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.parallel.inference import ShardedBatchRunner
 from sparkdl_tpu.parallel.mesh import mesh_has_collectives
 from sparkdl_tpu.runtime.runner import (
@@ -89,6 +91,10 @@ class ModelSession:
         self.config = config
         self.metrics = metrics
         self.chunk = int(runner.preferred_chunk)
+        # warmup state for /statusz + flight bundles: None = never
+        # attempted, True/False = runner.warmup()'s last answer (False
+        # means "nothing to warm", e.g. a host backend)
+        self.warmed: Optional[bool] = None
         self._queue = RequestQueue()
         self._staging = PadStaging()
         self._worker: Optional[threading.Thread] = None
@@ -210,7 +216,8 @@ class ModelSession:
         ever dispatches)."""
         with span("warmup", lane="serve", model=self.name,
                   rows=self.chunk):
-            return self.runner.warmup()
+            self.warmed = self.runner.warmup()
+        return self.warmed
 
     # -- the dispatcher thread -----------------------------------------------
 
@@ -224,30 +231,41 @@ class ModelSession:
 
     def _serve_loop(self) -> None:
         reg = default_registry()
+        # the watchdog activity window opens AFTER the idle wait in
+        # collect(): a dispatcher blocked waiting for traffic is idle,
+        # not stalled — only a collected batch that never resolves
+        # (the wedged-collective signature) may trip the stall verdict
+        wd_source = f"serve.dispatcher:{self.name}"
         while True:
             batch = self._queue.collect(self.chunk,
                                         self.config.max_wait_s)
             if batch is None:
                 return          # closed and drained
-            for req in batch.expired:
-                # failed BEFORE dispatch: no device time for the dead
-                if req.fail(DeadlineExceeded(
-                        f"deadline passed after {time.perf_counter() - req.submitted:.3f}s queued "
-                        f"(model {self.name!r})")):
-                    self.metrics.add_deadline_miss()
-            reg.gauge("serve.queue_rows").set(self._queue.depth())
-            if batch.parts:
-                try:
-                    self._dispatch(batch)
-                except Exception as e:
-                    # a failed dispatch fails ITS requests; the
-                    # dispatcher keeps serving the rest of the queue
-                    logger.exception(
-                        "serve dispatch failed for model %r",
-                        self.name)
-                    for req, _lo, _rows in batch.parts:
-                        req.fail(e)
-            self.metrics.publish(reg)
+            with watchdog_watch(wd_source):
+                for req in batch.expired:
+                    # failed BEFORE dispatch: no device time for the dead
+                    if req.fail(DeadlineExceeded(
+                            f"deadline passed after {time.perf_counter() - req.submitted:.3f}s queued "
+                            f"(model {self.name!r})")):
+                        self.metrics.add_deadline_miss()
+                reg.gauge("serve.queue_rows").set(self._queue.depth())
+                if batch.parts:
+                    try:
+                        self._dispatch(batch)
+                    except Exception as e:
+                        # a failed dispatch fails ITS requests; the
+                        # dispatcher keeps serving the rest of the queue
+                        logger.exception(
+                            "serve dispatch failed for model %r",
+                            self.name)
+                        # armed flight recorder: this is the unhandled-
+                        # failure trigger — the bundle carries the queue
+                        # state + spans that led here
+                        flight.record_failure(
+                            e, where=f"serve.dispatch:{self.name}")
+                        for req, _lo, _rows in batch.parts:
+                            req.fail(e)
+                self.metrics.publish(reg)
 
     def _dispatch(self, batch: MicroBatch) -> None:
         valid = batch.valid
@@ -308,6 +326,11 @@ class ModelSession:
                     "serve session %r did not drain within %.1fs; "
                     "dispatcher left running (daemon)", self.name,
                     self.config.drain_timeout_s)
+        # final metrics publish: rows/rejections admitted after the
+        # dispatcher's last per-batch publish (or never dispatched at
+        # all under drain=False) must land in the registry — the last
+        # partial window is part of the record, not a rounding error
+        self.metrics.publish(default_registry())
 
     # -- pickle discipline (StageMetrics precedent) --------------------------
 
@@ -340,6 +363,13 @@ class ModelServer:
         self._sessions: Dict[str, ModelSession] = {}
         self._closed = False
         self._lock = threading.Lock()
+        self._telemetry = None
+        self._started = time.perf_counter()
+        # the flight recorder's serve section is built from live
+        # servers (weakly held); env-armed processes also get their
+        # SIGUSR2 trigger + span retention installed here
+        flight.register_server(self)
+        flight.autoarm()
 
     # -- registry ------------------------------------------------------------
 
@@ -417,6 +447,66 @@ class ModelServer:
             sessions = list(self._sessions.values())
         return {s.name: s.warmup() for s in sessions}
 
+    # -- the health surface --------------------------------------------------
+
+    def telemetry_status(self) -> dict:
+        """Per-model operating state for ``/statusz`` and the flight
+        recorder's bundles: queue depth, warmup state, runner
+        strategy/config, and the cumulative serve metrics — everything
+        an operator needs to tell "busy" from "wedged" without
+        attaching a debugger."""
+        with self._lock:
+            sessions = dict(self._sessions)
+            closed = self._closed
+        return {
+            "closed": closed,
+            "uptime_s": round(time.perf_counter() - self._started, 3),
+            "config": {
+                "max_wait_s": self.config.max_wait_s,
+                "max_queue_rows": self.config.max_queue_rows,
+                "default_deadline_s": self.config.default_deadline_s,
+                "drain_timeout_s": self.config.drain_timeout_s,
+            },
+            "models": {
+                name: {
+                    "queue_rows": s._queue.depth(),
+                    "queue_closing": s._queue.closing,
+                    "warmed": s.warmed,
+                    "collective": s.collective,
+                    "chunk": s.chunk,
+                    "runner": {
+                        "type": type(s.runner).__name__,
+                        "strategy": getattr(s.runner, "strategy",
+                                            None),
+                        "max_inflight": getattr(s.runner,
+                                                "max_inflight", None),
+                        "batch_size": getattr(s.runner, "batch_size",
+                                              None),
+                    },
+                } for name, s in sessions.items()},
+            "metrics": self.metrics.as_dict(),
+        }
+
+    def serve_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """Attach the localhost health surface
+        (:class:`~sparkdl_tpu.obs.export.TelemetryServer`): started
+        immediately, scoped to this server's ``/statusz``, closed with
+        the server. ``port=0`` lets the OS pick — read ``.port`` on
+        the returned endpoint."""
+        from sparkdl_tpu.obs.export import TelemetryServer
+        with self._lock:
+            if self._closed:
+                raise ServerClosed(
+                    "cannot attach telemetry to a closed server")
+            if self._telemetry is not None:
+                return self._telemetry
+            tel = TelemetryServer(port=port, host=host,
+                                  model_server=self).start()
+            # set only after a successful bind+start: a port-in-use
+            # failure must not leave a dead endpoint cached
+            self._telemetry = tel
+            return tel
+
     # -- lifecycle -----------------------------------------------------------
 
     def close(self, drain: bool = True) -> None:
@@ -425,8 +515,14 @@ class ModelServer:
         with self._lock:
             self._closed = True
             sessions = list(self._sessions.values())
+            telemetry, self._telemetry = self._telemetry, None
         for s in sessions:
             s.close(drain)
+        # the final-window publish (each session also published on its
+        # own close; this covers the zero-session server, idempotently)
+        self.metrics.publish(default_registry())
+        if telemetry is not None:
+            telemetry.close()
 
     def __enter__(self) -> "ModelServer":
         return self
@@ -439,12 +535,18 @@ class ModelServer:
 
     def __getstate__(self):
         # workers/locks/queue contents drop (inside each session's own
-        # hooks); config, registered runners, and cumulative metrics
-        # values travel
+        # hooks), and so does an attached telemetry endpoint (sockets
+        # are process-local); config, registered runners, and
+        # cumulative metrics values travel
         state = self.__dict__.copy()
         del state["_lock"]
+        state["_telemetry"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        # a deserialized server re-registers with the RECEIVING
+        # process's flight recorder (bundle coverage follows the
+        # process, the H3 singleton discipline)
+        flight.register_server(self)

@@ -351,6 +351,75 @@ class TestH4Quiesce:
 
 
 # ---------------------------------------------------------------------------
+# H5 — clock discipline in obs/serve
+
+
+class TestH5Clock:
+    """Span/latency math in sparkdl_tpu/obs/ and sparkdl_tpu/serve/
+    must share the tracer's perf_counter clock — wall-clock reads there
+    are flagged; the same code anywhere else is not (path-scoped)."""
+
+    def test_time_time_in_obs_trips(self):
+        hits = _hits("import time\n"
+                     "def span_end():\n"
+                     "    return time.time()\n", "H5",
+                     path="sparkdl_tpu/obs/fixture.py")
+        assert len(hits) == 1
+        assert "perf_counter" in hits[0].message
+        assert hits[0].qualname == "span_end"
+
+    def test_datetime_now_in_serve_trips(self):
+        hits = _hits("from datetime import datetime\n"
+                     "def deadline():\n"
+                     "    return datetime.now()\n", "H5",
+                     path="sparkdl_tpu/serve/fixture.py")
+        assert len(hits) == 1
+
+    def test_datetime_module_form_trips(self):
+        hits = _hits("import datetime\n"
+                     "def stamp():\n"
+                     "    return datetime.datetime.utcnow()\n", "H5",
+                     path="sparkdl_tpu/obs/fixture.py")
+        assert len(hits) == 1
+
+    def test_perf_counter_is_clean(self):
+        assert _hits("import time\n"
+                     "def now():\n"
+                     "    return time.perf_counter()\n", "H5",
+                     path="sparkdl_tpu/obs/fixture.py") == []
+
+    def test_wall_clock_outside_obs_serve_is_clean(self):
+        src = ("import time\n"
+               "def bench_stamp():\n"
+               "    return time.time()\n")
+        assert _hits(src, "H5", path="fixture.py") == []
+        assert _hits(src, "H5",
+                     path="sparkdl_tpu/runtime/fixture.py") == []
+
+    def test_suppressed(self):
+        src = ("import time\n"
+               "def stamp():\n"
+               "    return time.time()"
+               "  # sparkdl-lint: allow[H5] -- artifact stamp\n")
+        path = "sparkdl_tpu/obs/fixture.py"
+        assert _hits(src, "H5", path=path) == []
+        sup = _suppressed(src, "H5", path=path)
+        assert len(sup) == 1
+        assert "artifact stamp" in sup[0].suppression
+
+    def test_meta_flight_bundle_stamp_is_suppressed_not_invisible(self):
+        """The one legitimate wall-clock read in obs/ — the flight
+        bundle's written_unix stamp — must APPEAR as a suppressed H5
+        finding (the allowlist-not-skipped discipline, H1 precedent)."""
+        found = analyze_paths([os.path.join(PKG_DIR, "obs")])
+        h5 = [f for f in found if f.rule == "H5"]
+        assert h5, "expected the flight.py bundle stamp to be flagged"
+        assert all(f.suppressed for f in h5), format_findings(
+            [f for f in h5 if not f.suppressed])
+        assert any("flight.py" in f.path for f in h5)
+
+
+# ---------------------------------------------------------------------------
 # walker / CLI / formatter
 
 

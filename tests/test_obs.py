@@ -665,3 +665,89 @@ class TestReportCLI:
         bad.write_text("{\"notTraceEvents\": 1}")
         assert main(["report", str(bad)]) == 2
         assert main(["wrong"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# report forward-compat: lanes are data, not a schema
+
+
+class TestReportForwardCompat:
+    """An older report invocation must summarize traces carrying lanes
+    it has never heard of, and a newer report must tolerate traces
+    from before those lanes existed — the lane set grows every obs PR
+    (serve in PR 4, obs/flight in this one) and neither direction may
+    crash."""
+
+    def test_unknown_lane_summarizes(self):
+        events = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "lane-from-the-future"}},
+            {"name": "mystery", "cat": "lane-from-the-future",
+             "ph": "X", "ts": 0.0, "dur": 50.0, "pid": 1, "tid": 7,
+             "args": {}},
+        ]
+        out = summarize(events)
+        assert "lane-from-the-future" in out
+        assert "mystery" in out
+
+    def test_span_without_lane_metadata_falls_back_to_cat(self):
+        events = [{"name": "orphan", "cat": "obs", "ph": "X",
+                   "ts": 0.0, "dur": 10.0, "pid": 99, "tid": 1,
+                   "args": {}}]
+        out = summarize(events)
+        assert "obs/orphan" in out
+
+    def test_zero_span_lane_does_not_crash_or_render_busy(self):
+        """Lane metadata with no spans (an armed run that never
+        exercised a subsystem) must not crash the report or appear as
+        a busy lane."""
+        events = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "serve"}},
+            {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+             "args": {"name": "engine"}},
+            {"name": "stage:decode", "cat": "engine", "ph": "X",
+             "ts": 0.0, "dur": 25.0, "pid": 2, "tid": 1, "args": {}},
+        ]
+        out = summarize(events)
+        assert "engine" in out
+        # the empty lane contributes no busy line
+        assert "serve  " not in out.split("top spans")[0].replace(
+            "lanes", "")
+
+    def test_malformed_metadata_and_missing_dur_tolerated(self):
+        events = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0},
+            {"name": "short", "ph": "X", "ts": 1.0, "pid": 1,
+             "tid": 1},
+        ]
+        out = summarize(events)
+        assert "short" in out
+
+    def test_all_metadata_no_spans(self):
+        events = [{"name": "process_name", "ph": "M", "pid": 1,
+                   "tid": 0, "args": {"name": "engine"}}]
+        assert summarize(events) == "(no spans in trace)"
+
+    def test_new_obs_lane_flows_through_report(self, tmp_path):
+        """The flight recorder's own dump span (obs lane, new in this
+        PR) must ride the generic machinery like every other lane."""
+        from sparkdl_tpu.obs import flight
+        t = tracer()
+        t.arm()
+        try:
+            rec = flight.FlightRecorder()
+            # a dump's own span records at its END — the SECOND
+            # bundle carries the first dump's span
+            rec.dump(path=str(tmp_path / "a.json"), reason="first")
+            path = rec.dump(path=str(tmp_path / "b.json"),
+                            reason="report test")
+        finally:
+            t.disarm()
+            t.arm_from_env()
+        with open(path) as f:
+            events = json.load(f)["spans"]
+        t.clear()
+        out = summarize(events)
+        assert "obs" in out
+        assert "flight.dump" in out

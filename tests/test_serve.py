@@ -389,6 +389,29 @@ class TestQuiesce:
         np.testing.assert_allclose(good.result(timeout=30)["y"], 0.0)
         server.close()
 
+    def test_close_publishes_the_final_partial_window(self):
+        """Rows admitted after the dispatcher's last per-batch publish
+        (here: admitted and never dispatched at all — the worker is
+        pinned off and close(drain=False) abandons the queue) must
+        still land in the registry via the close()-time publish;
+        before it, the last window was simply lost."""
+        server = _server(_double_fn(), batch_size=8, max_wait_s=0.0,
+                         max_queue_rows=64)
+        session = server.session("m")
+        # deterministic "admitted but never dispatched": no worker
+        session._ensure_worker = lambda: None
+        fut = server.submit({"input": np.zeros((3, 3), np.float32)})
+        snap = default_registry().snapshot()
+        # nothing published yet for this window (only live gauges)
+        assert snap["serve.queue_rows"] == 3.0
+        server.close(drain=False)
+        with pytest.raises(ServerClosed):
+            fut.result(timeout=1)
+        snap = default_registry().snapshot()
+        assert snap["serve.requests"] == server.metrics.requests
+        assert snap["serve.rows"] == server.metrics.rows
+        assert server.metrics.rows >= 3
+
 
 class TestMeshSessions:
     def test_sharded_session_serves_and_takes_collective_launch(self):

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Gate a fresh bench JSON against a committed round's schema.
+
+The bench's JSON line is a driver contract: round-over-round tooling
+reads its keys by name, and a refactor that drops or retypes one makes
+the trajectory silently lose a column (the schema asserts in
+tools/ci.sh step 4 catch a fixed list; this tool catches EVERYTHING the
+committed round actually shipped). Rules:
+
+* every key present in the reference must be present in the fresh
+  output with the same JSON type (recursing through nested objects;
+  ``int`` vs ``float`` are both "number");
+* ``null`` on either side is a wildcard — platform-dependent sections
+  (TPU-only shapes on a CPU run, and vice versa) legitimately go null;
+* NEW keys in the fresh output are allowed (schemas grow), but the
+  fresh output must then carry ``schema_version`` (an int >= 1) so
+  readers can key off it — bench.py emits it;
+* dynamic-content objects (the obs registry snapshot) are compared by
+  type only, not by key set — their keys depend on what ran.
+
+Reference resolution: the first usable file among the given reference
+paths wins. A reference may be a raw bench JSON line/file or a driver
+wrapper ``{"parsed": {...}, "tail": "..."}``; a wrapper whose
+``parsed`` is null falls back to parsing the tail's last JSON line,
+and an unusable file falls through to the next reference (the
+committed ``BENCH_r05.json`` stores a truncated tail — ``BENCH_r04``
+then anchors the schema).
+
+Usage::
+
+    python tools/bench_compare.py FRESH.json REF.json [REF2.json ...]
+
+Exit 0 on a compatible schema, 1 on drift, 2 on usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+#: nested objects whose KEYS vary run-to-run (only their type is
+#: checked): the registry snapshot depends on which subsystems ran,
+#: memory stats on the backend
+DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources"}
+
+
+def _from_lines(text: str) -> Optional[dict]:
+    """The last line that parses as a bench dict (bench.py prints ONE
+    JSON line, but logs may precede it)."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            return d
+    return None
+
+
+def load_bench_json(path: str) -> Optional[dict]:
+    """The bench dict from ``path``: a raw bench JSON file (last
+    parsable line wins) or a driver wrapper (``parsed`` preferred,
+    tail-line fallback). None when nothing usable is found."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        return _from_lines(text)
+    if not isinstance(d, dict):
+        return None
+    if "metric" in d:
+        return d
+    parsed = d.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = d.get("tail")
+    if isinstance(tail, str):
+        return _from_lines(tail)
+    return None
+
+
+def _type_of(v) -> str:
+    # bool FIRST: it subclasses int, and a True where a number belongs
+    # is exactly the retyping this gate exists to catch
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "array"
+    if isinstance(v, dict):
+        return "object"
+    return type(v).__name__
+
+
+def compare_schema(ref: dict, fresh: dict, prefix: str = ""
+                   ) -> List[str]:
+    """Drift report: missing/retyped keys, reference → fresh."""
+    errors: List[str] = []
+    for key, rv in ref.items():
+        label = f"{prefix}{key}"
+        if key not in fresh:
+            errors.append(f"missing key: {label!r} (present in the "
+                          "committed reference)")
+            continue
+        fv = fresh[key]
+        if rv is None or fv is None:
+            continue    # platform-dependent null — wildcard
+        rt, ft = _type_of(rv), _type_of(fv)
+        if rt != ft:
+            errors.append(f"type drift at {label!r}: reference {rt}, "
+                          f"fresh {ft}")
+            continue
+        if rt == "object" and key not in DYNAMIC_KEYS:
+            errors.extend(compare_schema(rv, fv, prefix=f"{label}."))
+    return errors
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/bench_compare.py",
+        description="validate a fresh bench JSON against a committed "
+                    "round's schema (module docstring for the rules)")
+    parser.add_argument("fresh", help="fresh bench output (JSON file, "
+                                      "last parsable line wins)")
+    parser.add_argument("references", nargs="+",
+                        help="committed round files, in preference "
+                             "order (BENCH_r05.json BENCH_r04.json …)")
+    args = parser.parse_args(argv)
+
+    try:
+        fresh = load_bench_json(args.fresh)
+    except OSError as e:
+        print(f"bench_compare: cannot read fresh output: {e}",
+              file=sys.stderr)
+        return 2
+    if fresh is None:
+        print(f"bench_compare: {args.fresh}: no bench JSON line found",
+              file=sys.stderr)
+        return 2
+
+    ref = None
+    ref_path = None
+    for path in args.references:
+        try:
+            ref = load_bench_json(path)
+        except OSError as e:
+            print(f"bench_compare: skipping reference {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if ref is not None:
+            ref_path = path
+            break
+        print(f"bench_compare: reference {path} holds no parsable "
+              "bench JSON (truncated tail?); trying the next",
+              file=sys.stderr)
+    if ref is None:
+        print("bench_compare: no usable reference schema",
+              file=sys.stderr)
+        return 2
+
+    errors = compare_schema(ref, fresh)
+    sv = fresh.get("schema_version")
+    if not (isinstance(sv, int) and not isinstance(sv, bool)
+            and sv >= 1):
+        errors.append(
+            f"fresh output must carry schema_version (int >= 1), "
+            f"got {sv!r}")
+    if errors:
+        for e in errors:
+            print(f"bench_compare: DRIFT: {e}")
+        print(f"bench_compare: {len(errors)} schema error(s) vs "
+              f"{ref_path}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "bench_compare": "ok",
+        "reference": ref_path,
+        "reference_keys": len(ref),
+        "fresh_keys": len(fresh),
+        "schema_version": sv,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

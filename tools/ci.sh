@@ -18,7 +18,12 @@
 #      parse. Runs under SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard
 #      enforces the aligned ship path's zero-copy claim at runtime,
 #      not just in the counters.
-#   5. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
+#   5. bench schema-trajectory gate: tools/bench_compare.py checks
+#      the fresh tiny-bench JSON against the committed round schema
+#      (BENCH_r05.json, falling back to r04's parsable schema) —
+#      same keys/types, schema_version present — so bench-trajectory
+#      tracking can't silently drift between rounds.
+#   6. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
 #      (SPARKDL_TPU_TRACE=1) and its exported Perfetto trace is
 #      schema-checked (valid trace-event list, ≥1 span per lane:
 #      engine/ship/device/serve, with serve batch fill > 0.5 under
@@ -26,13 +31,20 @@
 #      (engine stages → runner dispatch/drain → estimator steps → a
 #      collective launch) must produce a trace carrying a
 #      collective_lock_wait span, and the report CLI must read it
-#   6. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
-#      H2 retrace, H3 locks, H4 quiesce) must report ZERO
-#      unsuppressed findings, plus the ruff baseline when installed
+#   7. watchdog + flight-recorder + telemetry gate: a synthetic stall
+#      (dispatcher blocked inside a dispatch) under a short watchdog
+#      threshold must fire the stall verdict, flip /healthz to 503,
+#      and produce a flight bundle carrying ≥1 span, the serve queue
+#      state, and a watchdog.stalls ≥ 1 registry snapshot; after
+#      recovery /metricsz must scrape as valid Prometheus text.
+#   8. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
+#      H2 retrace, H3 locks, H4 quiesce, H5 clock discipline) must
+#      report ZERO unsuppressed findings, plus the ruff baseline when
+#      installed
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
-# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep 1/3/4/5/6)
+# Env:  SPARKDL_TPU_CI_SKIP_SUITE=1  skip step 2 (keep the rest)
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -44,7 +56,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/6] native shim build =="
+echo "== [1/8] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -53,13 +65,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/6] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/8] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/6] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/8] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/6] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/8] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -68,7 +80,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/6] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/8] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
 python - <<'EOF'
 import json
@@ -128,7 +140,11 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/6] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [5/8] bench schema-trajectory gate (tools/bench_compare.py) =="
+python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
+  BENCH_r05.json BENCH_r04.json BENCH_r03.json
+
+echo "== [6/8] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_obs.json
 python - <<'EOF'
@@ -222,7 +238,131 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [6/6] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [7/8] watchdog + flight recorder + telemetry gate (injected stall) =="
+SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import flight, watchdog
+from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+rec = flight.recorder()
+rec.arm()                         # span retention + SIGUSR2 + triggers
+wd = watchdog.watchdog()
+wd.arm(threshold_s=0.3)           # short threshold for the injection
+
+# the synthetic stall: a host-backend model whose apply blocks, so the
+# serve dispatcher wedges INSIDE a dispatch (the silent-hang shape the
+# collective-launch deadlock had)
+gate = threading.Event()
+
+
+def blocked_apply(params, inputs):
+    gate.wait()
+    return {"y": np.asarray(inputs["x"], np.float32) * 2.0}
+
+
+mf = ModelFunction(blocked_apply, None,
+                   input_signature={"x": ((2,), np.float32)},
+                   output_names=["y"], backend="host", name="wedge")
+server = ModelServer(ServeConfig(max_wait_s=0.0, drain_timeout_s=5.0))
+server.register("wedge", mf, batch_size=4)
+tel = server.serve_telemetry()    # localhost, OS-picked port
+
+fut = server.submit({"x": np.zeros((2, 2), np.float32)})
+deadline = time.perf_counter() + 15.0
+while wd.healthy():
+    assert time.perf_counter() < deadline, \
+        "watchdog did not fire within the threshold"
+    time.sleep(0.02)
+
+
+def get(path):
+    try:
+        with urllib.request.urlopen(tel.url(path), timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+code, body = get("/healthz")
+assert code == 503, (code, body)          # stalled -> unhealthy
+health = json.loads(body)
+assert health["status"] == "stalled", health
+assert health["stalled_sources"], health
+
+# the stall must have produced a forensics bundle (written on the
+# monitor thread AFTER the verdict flips — poll briefly)
+deadline = time.perf_counter() + 10.0
+while rec.last_dump_path is None:
+    assert time.perf_counter() < deadline, \
+        "watchdog stall produced no flight bundle"
+    time.sleep(0.02)
+bundle_path = rec.last_dump_path
+with open(bundle_path) as f:
+    bundle = json.load(f)
+assert bundle["schema"].startswith("sparkdl-flight/"), bundle["schema"]
+assert bundle["span_count"] >= 1, bundle["span_count"]
+assert bundle["registry"].get("watchdog.stalls", 0) >= 1, \
+    {k: v for k, v in bundle["registry"].items() if "watchdog" in k}
+[srv] = bundle["serve"]
+assert "wedge" in srv["models"], srv
+assert srv["models"]["wedge"]["runner"]["strategy"] is not None or \
+    srv["models"]["wedge"]["runner"]["type"], srv
+
+gate.set()                        # un-wedge; the dispatcher drains
+out = fut.result(timeout=15)
+assert out["y"].shape == (2, 2), out["y"].shape
+
+# recovery: the verdict clears on its own once progress resumes
+deadline = time.perf_counter() + 10.0
+while not wd.healthy():
+    assert time.perf_counter() < deadline, "no stall recovery"
+    time.sleep(0.02)
+code, body = get("/healthz")
+assert code == 200, (code, body)
+
+# /metricsz must parse as Prometheus text exposition format
+code, body = get("/metricsz")
+assert code == 200, (code, body)
+sample = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|nan|inf)$")
+n = 0
+for line in body.strip().splitlines():
+    if not line:
+        continue
+    if line.startswith("#"):
+        assert re.match(r"^# (TYPE|HELP) ", line), repr(line)
+        continue
+    assert sample.match(line), f"bad Prometheus line: {line!r}"
+    n += 1
+assert n > 0, "empty /metricsz"
+assert "sparkdl_watchdog_stalls" in body, body[:400]
+assert "sparkdl_flight_dumps" in body, body[:400]
+
+code, body = get("/statusz")
+assert code == 200
+st = json.loads(body)
+assert st["servers"][0]["models"]["wedge"]["queue_rows"] == 0, st
+assert st["flight"]["dumps"] >= 1, st["flight"]
+
+server.close()
+tel.close()
+wd.disarm()
+print(json.dumps({"stall_gate": "ok", "prom_samples": n,
+                  "bundle": bundle_path,
+                  "stalls_fired": wd.stalls_fired}))
+EOF
+
+echo "== [8/8] static analysis (sparkdl-lint + ruff baseline) =="
 tools/lint.sh sparkdl_tpu
 
 echo "== ci.sh: ALL GREEN =="
