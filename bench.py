@@ -49,6 +49,12 @@ Reports, in ONE JSON line (driver contract):
   front-end — offered vs achieved rows/sec, mean batch fill ratio,
   p99 request latency, rejection/deadline-miss counts. tools/ci.sh
   gates the schema and (armed) the fill ratio + serve-lane trace.
+* ``autotune`` — the closed-loop infeed autotuner
+  (sparkdl_tpu/autotune, docs/PERFORMANCE.md): tuned-vs-fixed
+  throughput with the baseline's recorded noise band, decision /
+  oscillation / clamp counts, and the converged knob config.
+  tools/ci.sh gates schema + convergence (settled, zero
+  oscillations, no loss outside the band).
 
 Separating these is the point (round-1 lesson): on a tunneled TPU the
 link moves ~10-35 MB/s, capping end-to-end at ~40-134 img/s regardless
@@ -333,6 +339,91 @@ def measure_serve(mf, batch_size: int, n_requests: int,
             "deadline_misses": m["deadline_misses"]}
 
 
+def measure_autotune(mf, batch_size: int, n_rows: int) -> dict:
+    """The closed-loop infeed autotuner's acceptance shape
+    (docs/PERFORMANCE.md): a RunnerTarget-tuned prefetch runner vs the
+    fixed ``host_async`` expert default, same model, same rows.
+
+    Phases: (1) baseline — 3 passes through the static host_async
+    runner; the pass-to-pass spread is the recorded noise band the
+    tuned number is judged inside (the tunneled link legitimately
+    moves several-x between minutes, so a single-point comparison
+    would be theater). (2) settle — the armed controller steps on
+    every pass (interval 0) while the tuned runner runs its warmup +
+    settle window; trials/reverts happen HERE. (3) converged — timed
+    passes with the decision counter snapshotted around them:
+    ``changes_after_warmup`` and ``oscillations`` are what tools/ci.sh
+    gates (a controller that keeps hunting after its settle window is
+    worse than no controller)."""
+    from sparkdl_tpu.autotune import RunnerTarget, controller
+    from sparkdl_tpu.obs import default_registry
+    from sparkdl_tpu.runtime.runner import BatchRunner
+
+    in_name = mf.input_names[0]
+    shape, dtype = mf.input_signature[in_name]
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 255, (n_rows,) + tuple(shape)).astype(dtype)
+    warm = {in_name: x[:batch_size]}
+    full = {in_name: x}
+
+    def passes(runner, n):
+        rates = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            runner.run(full)
+            rates.append(n_rows / (time.perf_counter() - t0))
+        return rates
+
+    baseline = BatchRunner(mf, batch_size=batch_size,
+                           strategy="host_async")
+    baseline.run(warm)                      # compile warmup
+    base_rates = passes(baseline, 3)
+    baseline_ips = float(max(base_rates))
+    noise_band = (max(base_rates) - min(base_rates)) / max(base_rates)
+
+    ctl = controller()
+    reg = default_registry()
+    # the tuned runner starts from the PLATFORM default strategy (the
+    # config a user who set nothing gets — host_async on the tunnel,
+    # where it coincides with the fixed comparator's family): the
+    # controller's job is to beat-or-match the default it inherits,
+    # not a hand-picked shape. prefetch-depth tuning is pinned in
+    # tests/test_autotune.py and measured by measure_transfer --sweep.
+    tuned = BatchRunner(mf, batch_size=batch_size)
+    try:
+        ctl.attach(RunnerTarget(tuned))
+        ctl.arm(interval_s=0.0)             # step on every pass
+        tuned.run(warm)                     # compile warmup
+        # settle window: long enough for BOTH overlap knobs to run a
+        # full explore→evaluate(→revert+freeze) trial before the timed
+        # passes — the convergence gate counts changes AFTER this
+        passes(tuned, 6)
+        decisions_before = reg.counter("autotune.decisions").value
+        tuned_rates = passes(tuned, 3)
+        changes_after = (reg.counter("autotune.decisions").value
+                         - decisions_before)
+        state = ctl.state()
+    finally:
+        ctl.reset()                         # detach + follow the env
+    return {
+        "armed": True,
+        "strategy": tuned.strategy,
+        "baseline_strategy": baseline.strategy,
+        "baseline_ips": round(baseline_ips, 1),
+        "tuned_ips": round(float(max(tuned_rates)), 1),
+        "noise_band_pct": round(noise_band * 100.0, 1),
+        "decisions": int(state["decisions"]),
+        "changes_after_warmup": int(changes_after),
+        "oscillations": int(state["oscillations"]),
+        "clamps": int(state["clamps"]),
+        "steps": int(state["steps"]),
+        "converged": {
+            "max_inflight": int(tuned.max_inflight),
+            "prefetch_depth": int(tuned.prefetch_depth),
+        },
+    }
+
+
 _bench_done = None  # set by main(); threading.Event
 
 
@@ -529,6 +620,12 @@ def main() -> None:
                           threads=2)
     serve = measure_serve(mf, batch_size, **serve_args)
 
+    # the closed-loop infeed autotuner (sparkdl_tpu/autotune,
+    # docs/PERFORMANCE.md): controller settles (few changes, zero
+    # oscillations) and must not lose to the fixed host_async default
+    # outside the recorded noise band — tools/ci.sh gates it
+    autotune = measure_autotune(mf, batch_size, n_rows=n_rows)
+
     # Race the two fused-resize implementations device-resident
     # (VERDICT r4 #7, the transfer-strategy precedent: measured, not
     # asserted): the XLA einsum chain is the library default
@@ -682,6 +779,7 @@ def main() -> None:
         },
         "fidelity": fidelity,
         "serve": serve,
+        "autotune": autotune,
         "infeed_race": infeed_race,
         **({"tpu_fallback": ("tunneled TPU backend did not initialize; "
                              "CPU numbers are compute-bound on this "

@@ -1,0 +1,436 @@
+"""Autotune targets: what the controller measures and which knobs it
+may move.
+
+Each target wraps one live object (a runner, a serve session) and
+turns the cumulative metrics that object already keeps into per-window
+rates — no new hot-path sampling. ``propose(warming)`` returns bounded
+single-step :class:`~sparkdl_tpu.autotune.core.Proposal`\\ s; the
+controller owns hysteresis, clamping, and oscillation refusal.
+
+Speculative moves (deepening overlap, climbing the shape ladder) run
+as **trials**: apply one step, evaluate the next traffic window's
+throughput, keep the step only if it paid ``min_gain``, otherwise
+revert and freeze the knob — so a knob that cannot help on this
+host/link stops being poked instead of oscillating. Signal-shaped
+moves (shrinking a saturated coalesce window, shedding overlap after a
+backend degrade, stepping the ladder down under heavy padding) apply
+directly off their signal with the controller's cooldown as the only
+damping.
+
+All knob writes are single int/float attribute stores the owning hot
+loop re-reads at its next unit of work — shape-safe, lock-free,
+watchdog-safe (controller module docstring).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from sparkdl_tpu.autotune.core import Knob, Proposal
+from sparkdl_tpu.obs.registry import default_registry
+
+logger = logging.getLogger(__name__)
+
+_SEQ = itertools.count(1)
+
+
+class _TrialMixin:
+    """The explore→evaluate→revert machinery shared by targets whose
+    upward moves are speculative. A trial records (knob, old value,
+    baseline throughput, proposed value); the next traffic window
+    either keeps the move (gain ≥ ``min_gain``) or reverts and
+    freezes. A trial whose proposal the controller refused (cooldown /
+    oscillation guard) is dropped without judgment — the knob never
+    moved, so there is nothing to evaluate."""
+
+    #: relative throughput gain a trial must show to be kept
+    min_gain = 0.02
+    #: controller steps a knob rests after a reverted trial
+    freeze_steps = 64
+
+    _trial: Optional[tuple] = None
+
+    def _start_trial(self, knob: Knob, proposed, tput: float,
+                     reason: str, out: List[Proposal]) -> None:
+        self._trial = (knob, knob.value, tput, proposed)
+        out.append(Proposal(knob, proposed, reason))
+
+    def _eval_trial(self, tput: float, out: List[Proposal]) -> bool:
+        """Returns True when a revert was emitted (the caller should
+        not explore further this window).
+
+        EVERY completed trial freezes its knob — kept gains persist
+        but the next climb waits out the freeze epoch. Without this, a
+        noisy window that happens to clear ``min_gain`` re-arms the
+        trial immediately and the knob random-walks toward its bound
+        instead of settling; with it, convergence is structural (each
+        knob completes at most one trial per epoch) and a genuinely
+        faster depth still climbs one validated step per epoch."""
+        if self._trial is None:
+            return False
+        knob, old, base, proposed = self._trial
+        self._trial = None
+        if knob.value == old:
+            return False        # controller refused the trial
+        if tput < base * (1.0 + self.min_gain):
+            knob.freeze(self.freeze_steps)
+            out.append(Proposal(
+                knob, old,
+                f"revert {knob.name}: {tput:.1f} rows/s did not beat "
+                f"{base:.1f} by {self.min_gain:.0%}; frozen "
+                f"{self.freeze_steps} steps", force=True))
+            return True
+        knob.freeze(self.freeze_steps)      # kept — settle the epoch
+        return False
+
+
+class RunnerTarget(_TrialMixin):
+    """Tunes a runner's overlap knobs: ``prefetch_depth`` (prefetch
+    strategy) and ``max_inflight`` (any queued strategy).
+
+    Raise path (trial-gated): while ``transfer_wait_seconds`` takes
+    more than ``raise_wait_frac`` of the window's wall time, the ship
+    path is stalling in drains while transfers could overlap — deepen
+    the input look-ahead first (prefetch), then the result queue.
+    Lower path (signal-shaped): a window that recorded
+    ``ship.prefetch_degrade_events`` means the backend rejected the
+    async PLACEMENT this look-ahead depends on — shed
+    ``prefetch_depth`` one step toward its floor and stop trialing it
+    up. The counter is placement-specific on purpose: the mixed
+    ``ship.degrade_events`` total also counts missing
+    ``copy_to_host_async`` (which says nothing about look-ahead) and
+    would disable depth tuning on backends where placement works. It
+    is process-global, which is semantically right — ``device_put``
+    capability is a backend property, one backend per process.
+    ``max_inflight`` is deliberately NOT shed on degrades:
+    ``dispatch_chunks`` already shallows the result queue at runtime
+    when host copies are missing, and a permanently-degraded backend
+    (which re-probes once per run, counting an event every window)
+    must not walk a healthy queue down to 1. A ``memory_pressure``
+    hook (for TPU hosts that can read ``memory_stats``) is the
+    legitimate reason to reclaim depth AND queue slots; depth that is
+    merely unused is left alone — idle slots cost nothing on a
+    healthy backend."""
+
+    #: fraction of window wall time blocked in device_get drains above
+    #: which the overlap is deepened
+    raise_wait_frac = 0.15
+
+    def __init__(self, runner, name: Optional[str] = None,
+                 max_inflight_cap: int = 32,
+                 max_prefetch_depth: int = 8,
+                 memory_pressure=None):
+        self.runner = runner
+        self.name = name or f"runner{next(_SEQ)}"
+        self.memory_pressure = memory_pressure
+        self._inflight = Knob(
+            "max_inflight",
+            get=lambda: runner.max_inflight,
+            set=lambda v: setattr(runner, "max_inflight", int(v)),
+            lo=1, hi=int(max_inflight_cap))
+        self._depth = Knob(
+            "prefetch_depth",
+            get=lambda: runner.prefetch_depth,
+            set=lambda v: setattr(runner, "prefetch_depth", int(v)),
+            lo=1, hi=int(max_prefetch_depth))
+        self._prev: Optional[tuple] = None
+        self._prev_degrades: Optional[float] = None
+
+    def knobs(self) -> List[Knob]:
+        return [self._inflight, self._depth]
+
+    def _window(self) -> Optional[tuple]:
+        """(rows/s, wait_frac, placement degrades) over the window
+        since the last call; None when no traffic moved."""
+        m = self.runner.metrics
+        deg = default_registry().counter(
+            "ship.prefetch_degrade_events").value
+        cur = (m.rows, m.seconds, m.transfer_wait_seconds)
+        prev, self._prev = self._prev, cur
+        prev_deg, self._prev_degrades = self._prev_degrades, deg
+        if prev is None:
+            return None
+        drows = cur[0] - prev[0]
+        dsec = cur[1] - prev[1]
+        dwait = cur[2] - prev[2]
+        if drows <= 0 or dsec <= 0:
+            return None
+        return (drows / dsec, max(0.0, dwait / dsec),
+                deg - (prev_deg or 0.0))
+
+    def propose(self, warming: bool) -> List[Proposal]:
+        w = self._window()
+        out: List[Proposal] = []
+        if w is None or warming:
+            return out
+        tput, wait_frac, degrades = w
+        if self._eval_trial(tput, out):
+            return out
+        if self.runner.strategy == "immediate":
+            return out          # no queue to tune
+        if self.memory_pressure is not None and self.memory_pressure():
+            # HBM pressure: reclaim overlap buffers — depth first,
+            # then the result queue
+            if self._depth.value > self._depth.lo:
+                out.append(Proposal(self._depth, self._depth.value - 1,
+                                    "memory pressure"))
+            elif self._inflight.value > self._inflight.lo:
+                out.append(Proposal(self._inflight,
+                                    self._inflight.value - 1,
+                                    "memory pressure"))
+            return out
+        if degrades > 0 and self._depth.value > self._depth.lo:
+            # the backend refused async placement this window: stop
+            # asking for look-ahead (depth only — see class docstring
+            # for why max_inflight must NOT follow)
+            out.append(Proposal(self._depth, self._depth.value - 1,
+                                "placement degrade events in window"))
+        if wait_frac >= self.raise_wait_frac:
+            reason = (f"transfer_wait is {wait_frac:.0%} of wall; "
+                      "deepen overlap")
+            if (self.runner.strategy == "prefetch" and degrades == 0
+                    and self._depth.usable()
+                    and self._depth.value < self._depth.hi):
+                self._start_trial(self._depth, self._depth.value + 1,
+                                  tput, reason, out)
+            elif (self._inflight.usable()
+                    and self._inflight.value < self._inflight.hi):
+                self._start_trial(self._inflight,
+                                  self._inflight.value + 1, tput,
+                                  reason, out)
+        return out
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": "runner",
+                "strategy": getattr(self.runner, "strategy", None),
+                "trial_open": self._trial is not None,
+                "knobs": [k.describe() for k in self.knobs()]}
+
+
+class ServeTarget:
+    """Tunes one serve session's dynamic micro-batching window
+    (``ModelSession.max_wait_s``): shrink it when the queue saturates
+    batches without waiting (the window only adds latency then), grow
+    it when fill is poor and the p99 budget has headroom (waiting
+    longer is exactly how coalescing buys fill). The deadband between
+    ``lo_fill`` and ``hi_fill`` plus the controller cooldown is the
+    hysteresis — load that sits in the band moves nothing."""
+
+    #: window fill below which the coalesce window grows
+    lo_fill = 0.6
+    #: window fill above which the coalesce window shrinks
+    hi_fill = 0.95
+    #: multiplicative step (bounded: one notch per decision)
+    grow_factor = 1.5
+
+    def __init__(self, session, name: Optional[str] = None,
+                 min_wait_s: float = 0.0,
+                 max_wait_cap_s: Optional[float] = None,
+                 latency_budget_s: Optional[float] = None):
+        self.session = session
+        self.name = name or f"serve:{session.name}"
+        if max_wait_cap_s is None:
+            max_wait_cap_s = max(4.0 * session.max_wait_s, 0.02)
+        if latency_budget_s is None:
+            latency_budget_s = session.config.default_deadline_s
+        self.latency_budget_s = latency_budget_s
+        self._wait = Knob(
+            "max_wait_s",
+            get=lambda: session.max_wait_s,
+            set=lambda v: setattr(session, "max_wait_s", float(v)),
+            lo=float(min_wait_s), hi=float(max_wait_cap_s))
+        self._prev: Optional[tuple] = None
+
+    def knobs(self) -> List[Knob]:
+        return [self._wait]
+
+    def propose(self, warming: bool) -> List[Proposal]:
+        m = self.session.metrics
+        cur_counts = (m.batches, m.batch_rows, m.batch_capacity_rows)
+        prev, self._prev = self._prev, cur_counts
+        if prev is None or warming:
+            return []
+        dbatches = cur_counts[0] - prev[0]
+        dcap = cur_counts[2] - prev[2]
+        if dbatches <= 0 or dcap <= 0:
+            return []
+        fill = (cur_counts[1] - prev[1]) / dcap
+        cur = self._wait.value
+        if fill >= self.hi_fill and cur > self._wait.lo:
+            # saturated: arrivals outrun dispatch — the window is pure
+            # added latency now
+            return [Proposal(self._wait, max(self._wait.lo, cur / 2.0),
+                             f"fill {fill:.0%} saturated; shrink the "
+                             "coalesce window")]
+        if fill < self.lo_fill and cur < self._wait.hi:
+            new = min(self._wait.hi,
+                      max(cur * self.grow_factor, 0.001))
+            if self.latency_budget_s is not None:
+                p99 = m.latency_seconds(0.99)
+                if p99 + (new - cur) > 0.5 * self.latency_budget_s:
+                    return []   # no p99 headroom to spend on fill
+            return [Proposal(self._wait, new,
+                             f"fill {fill:.0%}; grow the coalesce "
+                             "window for fill")]
+        return []
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": "serve",
+                "model": self.session.name,
+                "latency_budget_s": self.latency_budget_s,
+                "knobs": [k.describe() for k in self.knobs()]}
+
+
+class RechunkTarget(_TrialMixin):
+    """Moves a :class:`~sparkdl_tpu.runtime.runner.BatchRunner`'s
+    device batch — and with it the engine's re-chunk hint, which
+    follows ``preferred_chunk`` live through
+    :class:`~sparkdl_tpu.data.frame.LiveBatchHint` — along a small
+    pre-warmed shape **ladder**.
+
+    The ladder is the retrace guarantee: :meth:`prewarm` traces and
+    compiles every rung up front (one zeros run each through the jit
+    cache), so PR 4's "every dispatch is ONE compiled shape" degrades
+    to "one of K pre-warmed shapes, **zero cold retraces**" — the
+    sparkdl-lint H2 discipline kept at runtime. Decisions only ever
+    move one rung and only among warmed rungs.
+
+    Down moves are signal-shaped: a window whose mean dispatched fill
+    (rows / batches·chunk) sits under ``down_fill`` is paying the
+    small-partition padding tax — a smaller rung strictly reduces pad.
+    Up moves (amortizing per-dispatch latency on high-RTT links) are
+    speculative and trial-gated.
+
+    NOT for runners registered behind a ``ModelServer`` — a serve
+    session fixes its chunk at registration (``session.chunk``) and
+    its warmup covers exactly that one shape."""
+
+    #: window mean batch fill below which the ladder steps down
+    down_fill = 0.5
+    #: window mean batch fill above which an up-trial may start
+    up_fill = 0.98
+
+    def __init__(self, runner, ladder=None, name: Optional[str] = None):
+        self.runner = runner
+        self.name = name or f"rechunk{next(_SEQ)}"
+        base = int(runner.batch_size)
+        if ladder is None:
+            ladder = {max(1, base // 2), base, base * 2}
+        self.ladder = sorted({int(r) for r in ladder})
+        if any(r <= 0 for r in self.ladder):
+            raise ValueError(f"ladder rungs must be positive, got "
+                             f"{self.ladder}")
+        if base not in self.ladder:
+            raise ValueError(
+                f"runner batch_size {base} must be one of the ladder "
+                f"rungs {self.ladder} (the current shape is warmed by "
+                "construction)")
+        self.warmed = False
+        self._rung = Knob(
+            "ladder_rung",
+            get=self._current_rung,
+            set=self._apply_rung,
+            lo=0, hi=len(self.ladder) - 1)
+        self._prev: Optional[tuple] = None
+
+    def _current_rung(self) -> int:
+        try:
+            return self.ladder.index(int(self.runner.batch_size))
+        except ValueError:
+            return -1           # moved off-ladder externally
+
+    def _apply_rung(self, idx) -> None:
+        self.runner.batch_size = self.ladder[int(idx)]
+
+    def knobs(self) -> List[Knob]:
+        return [self._rung]
+
+    def prewarm(self) -> int:
+        """Trace + compile every rung's shape into the runner's jit
+        cache — DIRECTLY through ``model_fn.jitted()`` (the exact
+        callable ``_run_device`` dispatches), never by cycling the
+        live ``batch_size``: a concurrent ``run()`` on another thread
+        must never observe a transient rung (runner.run snapshots
+        batch_size per call, but the snapshot of a mid-prewarm value
+        would be a cold shape). Host backends and unknown-dim
+        signatures no-op, the ``warmup_runner`` discipline.
+        Idempotent; returns the number of rungs actually warmed.
+        Runs at ``controller().attach`` time on the setup thread (the
+        ``on_attach`` hook) when the controller is already armed; the
+        lazy fallback in :meth:`propose` covers targets attached
+        before arming — that path pays the compile inside a controller
+        step, so prefer arm-then-attach for latency-sensitive
+        processes."""
+        if self.warmed:
+            return 0
+        mf = self.runner.model_fn
+        sig = mf.input_signature
+        if (getattr(mf, "backend", None) != "jax"
+                or any(d is None
+                       for shape, _ in sig.values() for d in shape)):
+            self.warmed = True
+            return 0            # nothing jitted to warm
+        fn = mf.jitted()
+        params = mf.device_params()
+        for rung in self.ladder:
+            zeros = {k: np.zeros((rung,) + tuple(shape), dtype)
+                     for k, (shape, dtype) in sig.items()}
+            fn(params, zeros)
+        self.warmed = True
+        logger.info("autotune: %s pre-warmed %d ladder rungs %s",
+                    self.name, len(self.ladder), self.ladder)
+        return len(self.ladder)
+
+    # controller().attach runs this on the setup thread when armed —
+    # the ladder compile must not land inside a hot loop's first step
+    on_attach = prewarm
+
+    def propose(self, warming: bool) -> List[Proposal]:
+        m = self.runner.metrics
+        if not warming and not self.warmed:
+            # prewarm FIRST, then baseline the window after it — the
+            # ladder's zeros runs must not read as traffic
+            self.prewarm()
+            self._prev = (m.rows, m.batches, m.seconds)
+            return []
+        cur_counts = (m.rows, m.batches, m.seconds)
+        prev, self._prev = self._prev, cur_counts
+        if warming or prev is None:
+            return []
+        drows = cur_counts[0] - prev[0]
+        dbatches = cur_counts[1] - prev[1]
+        dsec = cur_counts[2] - prev[2]
+        if drows <= 0 or dbatches <= 0 or dsec <= 0:
+            return []
+        out: List[Proposal] = []
+        tput = drows / dsec
+        if self._eval_trial(tput, out):
+            return out
+        idx = self._rung.value
+        if idx < 0:
+            return []           # batch_size moved off-ladder externally
+        fill = drows / (dbatches * self.runner.batch_size)
+        if fill < self.down_fill and idx > self._rung.lo:
+            out.append(Proposal(
+                self._rung, idx - 1,
+                f"batch fill {fill:.0%}: padding tax — step the shape "
+                f"ladder down to {self.ladder[idx - 1]}"))
+        elif (fill >= self.up_fill and idx < self._rung.hi
+                and self._rung.usable()):
+            self._start_trial(
+                self._rung, idx + 1, tput,
+                f"batch fill {fill:.0%}: amortize per-dispatch "
+                f"latency — trial rung {self.ladder[idx + 1]}", out)
+        return out
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": "rechunk",
+                "ladder": list(self.ladder),
+                "batch_size": int(self.runner.batch_size),
+                "prewarmed": self.warmed,
+                "trial_open": self._trial is not None,
+                "knobs": [k.describe() for k in self.knobs()]}

@@ -446,8 +446,20 @@ class LocalEngine:
         from the ordered partition stream; re-slice outputs back to the
         original partition boundaries. Greedy dispatch (all full hints
         available per arrival go in ONE stage call) preserves the
-        runner's internal async chunk pipelining for large partitions."""
-        hint = int(stage.batch_hint)
+        runner's internal async chunk pipelining for large partitions.
+
+        The hint is re-read BETWEEN blocks (``cur_hint``), not frozen
+        at stream start: a ``LiveBatchHint`` whose runner the autotune
+        controller moves along its pre-warmed shape ladder
+        (``sparkdl_tpu/autotune``) re-aligns the cut mid-stream. Row
+        identity and order are hint-independent — the ``segs``
+        bookkeeping re-slices outputs to the original partition
+        boundaries whatever sizes the blocks were cut at (pinned by
+        ``tests/test_autotune.py::TestMidStreamHintChange``)."""
+
+        def cur_hint() -> int:
+            return max(1, int(stage.batch_hint))
+
         in_frags: list = []      # un-dispatched input fragments
         in_rows = 0
         out_frags: list = []     # stage outputs not yet re-sliced
@@ -465,6 +477,7 @@ class LocalEngine:
             # greedily so the runner's internal async chunk pipelining
             # is preserved.
             nonlocal in_rows, out_rows
+            hint = cur_hint()
             while total:
                 head = in_frags[0]
                 if 0 < head.num_rows <= total \
@@ -504,7 +517,8 @@ class LocalEngine:
                 # small partitions while the consumer blocks in a
                 # device call (execute() docstring measurement); large
                 # partitions leave the window as-is
-                need = -(-2 * int(max_hint or hint) // batch.num_rows)
+                need = -(-2 * int(max_hint or cur_hint())
+                         // batch.num_rows)
                 # widen-only: never shrink an already-deeper default
                 # (many-core hosts run num_workers*2 > 16)
                 inflight_box[0] = max(inflight_box[0], min(16, need))
@@ -518,6 +532,7 @@ class LocalEngine:
                 segs.append((idx, batch.num_rows, None))
                 in_frags.append(batch)
                 in_rows += batch.num_rows
+                hint = cur_hint()
                 if in_rows >= hint:
                     run_rows((in_rows // hint) * hint)
             yield from ready()

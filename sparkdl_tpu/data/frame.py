@@ -153,6 +153,40 @@ def column_index(data, name: str) -> int:
     return idx
 
 
+class LiveBatchHint:
+    """A ``Stage.batch_hint`` that follows its runner's
+    ``preferred_chunk`` LIVE instead of freezing the value at plan
+    build. The engine reads hints through ``int(...)`` / ``bool(...)``
+    (``LocalEngine._stream_rechunk`` re-reads between blocks), so a
+    runner whose device batch the autotune controller moves along its
+    pre-warmed shape ladder (``sparkdl_tpu/autotune``) pulls the
+    engine's re-chunk cut along with it — blocks cut after the change
+    align to the new batch, already-cut blocks stay row-exact (the
+    runner pads/truncates any N). Duck-typed: anything with a
+    ``preferred_chunk`` attribute works; pickles with its runner (the
+    stage-closure shipping discipline)."""
+
+    __slots__ = ("runner",)
+
+    def __init__(self, runner):
+        self.runner = runner
+
+    def __int__(self) -> int:
+        return int(self.runner.preferred_chunk)
+
+    __index__ = __int__
+
+    def __bool__(self) -> bool:
+        return int(self.runner.preferred_chunk) > 0
+
+    def __repr__(self) -> str:
+        return f"LiveBatchHint({int(self)})"
+
+    # pickle via __reduce__ keeps the __slots__ class cloudpickle-safe
+    def __reduce__(self):
+        return (LiveBatchHint, (self.runner,))
+
+
 @dataclasses.dataclass(frozen=True)
 class Stage:
     """One plan step: RecordBatch → RecordBatch. With ``with_index``,

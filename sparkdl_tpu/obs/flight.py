@@ -148,6 +148,17 @@ def _serve_status() -> List[dict]:
     return out
 
 
+def _autotune_state() -> dict:
+    """The autotune controller's knob/decision state — the bundle's
+    "what was the loop doing" section; degrades like every other probe
+    (lazy import: obs must stay import-light)."""
+    try:
+        from sparkdl_tpu.autotune.core import controller
+        return controller().state()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 class FlightRecorder:
     """Retention + bundle writer (module docstring). One process-wide
     instance (:func:`recorder`); standalone instances exist for
@@ -248,6 +259,7 @@ class FlightRecorder:
             "span_count": sum(1 for e in events if e.get("ph") == "X"),
             "spans_dropped": trc.dropped,
             "serve": _serve_status(),
+            "autotune": _autotune_state(),
             "extra": extra or {},
         }
 

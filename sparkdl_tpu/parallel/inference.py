@@ -57,7 +57,8 @@ class ShardedBatchRunner:
                  batch_size: int = 64,
                  metrics: Optional[RunnerMetrics] = None,
                  strategy: Optional[str] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 prefetch_depth: Optional[int] = None):
         if model_fn.backend != "jax":
             raise ValueError(
                 f"sharded execution requires a jax backend, got "
@@ -74,9 +75,16 @@ class ShardedBatchRunner:
         # same measured strategy selection + validation as BatchRunner
         # (runner.py module docstring): host_async on tunneled devices,
         # bounded async dispatch on direct-attached ones
-        from sparkdl_tpu.runtime.runner import resolve_strategy
+        from sparkdl_tpu.runtime.runner import (
+            resolve_prefetch_depth,
+            resolve_strategy,
+        )
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
+        # depth-N input look-ahead for the "prefetch" strategy
+        # (runtime/runner.py) — prefetched chunks land with the data
+        # sharding, so depth costs global-batch-sized HBM per slot
+        self.prefetch_depth = resolve_prefetch_depth(prefetch_depth)
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
         # persistent pad staging (BatchRunner's checkout discipline):
         # concurrent run() calls fall back to a throwaway stager
@@ -186,10 +194,10 @@ class ShardedBatchRunner:
                       mesh=f"{self.mesh.shape[DATA_AXIS]}x"
                            f"{self.mesh.shape[MODEL_AXIS]}"), \
                     launch, ship_guard():
-                batches = dispatch_chunks(fn, params, chunks,
-                                          self.strategy,
-                                          self.max_inflight, sink,
-                                          place=place, sharding=dat)
+                batches = dispatch_chunks(
+                    fn, params, chunks, self.strategy,
+                    self.max_inflight, sink, place=place, sharding=dat,
+                    prefetch_depth=self.prefetch_depth)
         finally:
             if locked:
                 self._staging_lock.release()
@@ -197,4 +205,8 @@ class ShardedBatchRunner:
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=sink.transfer_wait)
+        # autotune apply point (runtime/runner.py precedent): knobs
+        # move between runs only; disarmed this is one armed-check
+        from sparkdl_tpu.autotune.core import poll as autotune_poll
+        autotune_poll()
         return sink.result()

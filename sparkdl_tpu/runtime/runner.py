@@ -25,17 +25,26 @@ Transfer strategy (measured, not asserted — tools/measure_transfer.py):
 * ``immediate`` — drain each chunk's result synchronously as soon as it
   is enqueued. The conservative fallback: no queue, flat memory, never
   pathological.
-* ``prefetch`` — everything ``host_async`` does PLUS a depth-1 input
-  prefetch: chunk *i+1* is ``jax.device_put`` while chunk *i* computes,
-  so the jitted call consumes an already-resident buffer instead of
-  transferring at dispatch time. Degrades to plain ``host_async``
+* ``prefetch`` — everything ``host_async`` does PLUS a depth-N input
+  prefetch (``prefetch_depth``, default 1): the next N chunks are
+  ``jax.device_put`` while chunk *i* computes, so the jitted call
+  consumes an already-resident buffer instead of transferring at
+  dispatch time, and a link whose latency exceeds one chunk's compute
+  can still be kept full. Depth is a bounded look-ahead queue — each
+  placed chunk holds a chunk of device memory, so deeper is NOT free;
+  the autotune controller (``sparkdl_tpu/autotune``) raises it only
+  while drain waits dominate. Degrades to plain ``host_async``
   dispatch (once, with a warning) on backends whose ``device_put``
   cannot place ahead of dispatch — the same probe-and-degrade
   discipline as ``start_host_copies``.
 
 Auto-selection keys off the tunnel's environment marker; override with
 ``SPARKDL_TPU_RUNNER_STRATEGY=immediate|deferred|host_async|prefetch``
-or the ``strategy`` ctor arg.
+or the ``strategy`` ctor arg; the prefetch look-ahead depth with
+``SPARKDL_TPU_PREFETCH_DEPTH`` or the ``prefetch_depth`` ctor arg.
+``strategy``/``max_inflight``/``prefetch_depth`` are read afresh at
+every ``run()`` — a live controller (``sparkdl_tpu/autotune``) may
+move them between runs without touching compiled shapes.
 
 Copy discipline (BENCH r05: the pipeline is link-bound and on a 1-core
 host every ship-side byte the host copies comes straight out of
@@ -82,6 +91,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import jax
 import numpy as np
 
+from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import default_registry, span, timed_device_get
 from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
@@ -100,6 +110,11 @@ MAX_INFLIGHT_BATCHES = 2
 # helps on high-latency links (the strategy's whole point). prefetch is
 # host_async plus input-side overlap and shares the depth.
 MAX_INFLIGHT_HOST_ASYNC = 8
+# default input look-ahead for the "prefetch" strategy: 1 is the
+# PR-1 measured shape (place chunk i+1 while i computes); deeper
+# look-ahead holds more chunk-sized device buffers and is the
+# autotune controller's call, not a static default
+DEFAULT_PREFETCH_DEPTH = 1
 
 _STRATEGIES = ("immediate", "deferred", "host_async", "prefetch")
 
@@ -153,6 +168,46 @@ def resolve_strategy(strategy: Optional[str],
     return strategy, (MAX_INFLIGHT_HOST_ASYNC
                       if strategy in ("host_async", "prefetch")
                       else MAX_INFLIGHT_BATCHES)
+
+
+def resolve_prefetch_depth(depth: Optional[int]) -> int:
+    """Validate/default the "prefetch" strategy's input look-ahead
+    depth: how many chunks ahead of the dispatching one are kept
+    ``device_put`` at once (other strategies carry but ignore it).
+    An explicit ctor value wins, then ``SPARKDL_TPU_PREFETCH_DEPTH``,
+    then :data:`DEFAULT_PREFETCH_DEPTH`."""
+    if depth is None:
+        env = os.environ.get("SPARKDL_TPU_PREFETCH_DEPTH")
+        if not env:
+            return DEFAULT_PREFETCH_DEPTH
+        try:
+            depth = int(env)
+        except ValueError:
+            raise ValueError(
+                f"SPARKDL_TPU_PREFETCH_DEPTH must be a positive int, "
+                f"got {env!r}") from None
+    if depth < 1:
+        raise ValueError(f"prefetch_depth must be >= 1, got {depth}")
+    return int(depth)
+
+
+# once-per-process-per-reason degrade warnings (the imageIO
+# fused-fallback precedent): a long degraded stream — e.g. a serve
+# dispatcher running thousands of runner dispatches against a backend
+# without async placement — must not re-log the same degrade per run.
+# The registry's ship.degrade_events counter keeps the per-event
+# record; the log keeps the first occurrence per reason.
+_WARNED_REASONS: set = set()
+
+
+def warn_once(reason: str, msg: str, *args) -> None:
+    """Log ``msg`` at WARNING exactly once per process per ``reason``
+    key — every runner degrade path funnels through this so new
+    degrade reasons inherit the dedupe."""
+    if reason in _WARNED_REASONS:
+        return
+    _WARNED_REASONS.add(reason)
+    logging.getLogger(__name__).warning(msg, *args)
 
 
 def check_row_counts(inputs: Dict[str, np.ndarray]) -> int:
@@ -388,7 +443,8 @@ def checkout_staging(staging: PadStaging, lock: threading.Lock
 
 
 def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
-                    sink: SlabSink, place=None, sharding=None) -> int:
+                    sink: SlabSink, place=None, sharding=None,
+                    prefetch_depth: int = DEFAULT_PREFETCH_DEPTH) -> int:
     """THE dispatch state machine, shared by BatchRunner._run_device
     and ShardedBatchRunner.run (one copy of the trickiest loop in the
     codebase: generator look-ahead, placed-chunk hand-off, the
@@ -399,11 +455,23 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     the sharded runner's multi-process requirement. ``sharding``
     (optional) is passed to :func:`start_device_prefetch` so prefetched
     chunks land with the data sharding instead of committed to one
-    device."""
+    device. ``prefetch_depth`` (prefetch strategy only) bounds the
+    input look-ahead: up to that many chunks ahead of the dispatching
+    one are kept ``device_put`` at once in a shared FIFO, so a link
+    whose latency exceeds one chunk's compute still arrives resident —
+    at the cost of ``prefetch_depth`` chunk-sized device buffers on top
+    of the ``max_inflight`` result queue."""
     host_async = strategy in ("host_async", "prefetch")
     prefetch = strategy == "prefetch"
+    lookahead = max(1, int(prefetch_depth))
     limit = max_inflight
     pending: collections.deque = collections.deque()
+    # the depth-N input look-ahead: (valid, chunk, placed) triples whose
+    # host→device transfer start_device_prefetch already kicked off
+    # (placed=False only for the chunk pulled when the backend degraded
+    # mid-probe — it still dispatches, un-placed)
+    ahead: collections.deque = collections.deque()
+    exhausted = False
     batches = 0
     # queue-depth gauges, process-global: ship.inflight is the LAST
     # observed depth (concurrent runners overwrite each other — per-run
@@ -416,31 +484,46 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     # chunk, so a dispatch/drain that stops advancing past the
     # threshold trips the stall verdict
     wd_source = f"ship.dispatch@{threading.get_ident()}"
-    with watchdog_watch(wd_source):
+
+    def pull():
+        nonlocal exhausted
         nxt = next(chunks, None)
-        placed = None
-        if prefetch and nxt is not None:
-            with span("device_put", lane="ship", rows=nxt[0],
-                      prefetch=True):
-                placed = start_device_prefetch(nxt[1], sharding)
-            prefetch = placed is not None
-        while nxt is not None:
+        if nxt is None:
+            exhausted = True
+        return nxt
+
+    with watchdog_watch(wd_source):
+        while True:
+            # keep the look-ahead full: start the host→device transfer
+            # of up to ``lookahead`` chunks BEYOND the one about to
+            # dispatch, so the transfers proceed while the device
+            # computes (depth 1 == the classic place-i+1-during-i)
+            while prefetch and not exhausted and len(ahead) < lookahead:
+                nxt = pull()
+                if nxt is None:
+                    break
+                with span("device_put", lane="ship", rows=nxt[0],
+                          prefetch=True, ahead=len(ahead) + 1):
+                    placed = start_device_prefetch(nxt[1], sharding)
+                if placed is None:
+                    # degrade ladder: the chunk already pulled
+                    # dispatches un-placed; no further placements this
+                    # run (host_async dispatch from here on)
+                    prefetch = False
+                    ahead.append((nxt[0], nxt[1], False))
+                else:
+                    ahead.append((nxt[0], placed, True))
+            if ahead:
+                valid, chunk, placed_ok = ahead.popleft()
+            else:
+                nxt = pull()
+                if nxt is None:
+                    break
+                valid, chunk, placed_ok = nxt[0], nxt[1], False
             watchdog_pulse(wd_source)
-            valid, chunk = nxt
-            if placed is not None:
-                chunk, placed = placed, None
-            elif place is not None:
+            if not placed_ok and place is not None:
                 with span("device_put", lane="ship", rows=valid):
                     chunk = place(chunk)
-            nxt = next(chunks, None)
-            if prefetch and nxt is not None:
-                # start chunk i+1's host→device transfer BEFORE
-                # dispatching chunk i: the transfer proceeds while the
-                # device computes i
-                with span("device_put", lane="ship", rows=nxt[0],
-                          prefetch=True):
-                    placed = start_device_prefetch(nxt[1], sharding)
-                prefetch = placed is not None
             # NOTE: on async backends this span times the ENQUEUE of
             # the jitted call, not device compute — device-side time is
             # only host-observable at the drain (the device_get span)
@@ -462,9 +545,6 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     return batches
 
 
-_warned_no_host_async = False
-
-
 def start_host_copies(res: Dict[str, jax.Array]) -> bool:
     """Kick off async device→host copies for every output of an
     enqueued result (the "host_async" strategy's enqueue hook).
@@ -473,7 +553,6 @@ def start_host_copies(res: Dict[str, jax.Array]) -> bool:
     (``MAX_INFLIGHT_BATCHES``): an 8-deep queue of never-copied
     buffers is exactly the stale-buffer collapse round 1 measured.
     Real runtime errors propagate; only the missing-API case degrades."""
-    global _warned_no_host_async
     for v in res.values():
         # Probe for the API with getattr rather than catching
         # AttributeError around the call — an AttributeError raised
@@ -486,45 +565,44 @@ def start_host_copies(res: Dict[str, jax.Array]) -> bool:
                 continue
             except NotImplementedError:
                 pass
-        if not _warned_no_host_async:
-            _warned_no_host_async = True
-            logging.getLogger(__name__).warning(
-                "backend lacks copy_to_host_async; host_async "
-                "degrades to a shallow deferred queue")
+        warn_once("degrade:no_host_async",
+                  "backend lacks copy_to_host_async; host_async "
+                  "degrades to a shallow deferred queue")
         default_registry().counter("ship.degrade_events").add()
         return False
     return True
 
 
-_warned_no_prefetch = False
-
-
 def start_device_prefetch(chunk: Dict[str, np.ndarray], sharding=None
                           ) -> Optional[Dict[str, jax.Array]]:
-    """``jax.device_put`` the NEXT chunk so its host→device transfer
+    """``jax.device_put`` an upcoming chunk so its host→device transfer
     overlaps the CURRENT chunk's compute (the "prefetch" strategy's
-    depth-1 input hook); the jitted call then consumes an
+    input hook; ``dispatch_chunks`` keeps up to ``prefetch_depth`` of
+    these in flight); the jitted call then consumes an
     already-resident buffer instead of transferring at dispatch time.
 
     Returns None when the backend cannot place ahead of dispatch
     (``NotImplementedError`` from ``device_put``) — callers must then
     degrade to plain host_async dispatch for the rest of the run, and
-    the degradation warns exactly once per process (the same
-    probe-and-degrade discipline as :func:`start_host_copies`). Real
-    runtime errors propagate."""
-    global _warned_no_prefetch
+    the degradation warns exactly once per process per reason (the
+    same probe-and-degrade discipline as :func:`start_host_copies`).
+    Real runtime errors propagate."""
     try:
         if sharding is not None:
             return {k: jax.device_put(v, sharding)
                     for k, v in chunk.items()}
         return {k: jax.device_put(v) for k, v in chunk.items()}
     except NotImplementedError:
-        if not _warned_no_prefetch:
-            _warned_no_prefetch = True
-            logging.getLogger(__name__).warning(
-                "backend lacks async device_put; prefetch degrades to "
-                "host_async dispatch")
+        warn_once("degrade:no_prefetch",
+                  "backend lacks async device_put; prefetch degrades "
+                  "to host_async dispatch")
         default_registry().counter("ship.degrade_events").add()
+        # the PLACEMENT-specific count, separate from the mixed
+        # ship.degrade_events total: the autotuner's prefetch-depth
+        # knob keys on this one — a missing copy_to_host_async (the
+        # other degrade reason) says nothing about look-ahead
+        default_registry().counter(
+            "ship.prefetch_degrade_events").add()
         return None
 
 
@@ -613,7 +691,8 @@ class BatchRunner:
     def __init__(self, model_fn: ModelFunction, batch_size: int = 64,
                  metrics: Optional[RunnerMetrics] = None,
                  strategy: Optional[str] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 prefetch_depth: Optional[int] = None):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         self.model_fn = model_fn
@@ -622,6 +701,9 @@ class BatchRunner:
         # immediate == a zero-length queue; deferred keeps a small one
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
+        # depth-N input look-ahead for the "prefetch" strategy; carried
+        # (ignored) by the others so a live strategy change keeps it
+        self.prefetch_depth = resolve_prefetch_depth(prefetch_depth)
         # persistent pad staging, reused across run() calls; checked
         # out under a try-lock so concurrent run() calls on one runner
         # fall back to a private throwaway stager instead of racing
@@ -652,9 +734,9 @@ class BatchRunner:
         engine can feed batch-aligned blocks across partitions."""
         return self.batch_size
 
-    def _chunks(self, n: int):
-        for lo in range(0, n, self.batch_size):
-            yield lo, min(lo + self.batch_size, n)
+    def _chunks(self, n: int, batch_size: int):
+        for lo in range(0, n, batch_size):
+            yield lo, min(lo + batch_size, n)
 
     def warmup(self) -> bool:
         """Pre-trace/compile the jitted program at the device batch
@@ -675,25 +757,36 @@ class BatchRunner:
 
         t0 = time.perf_counter()
         counters = CopyCounters()
+        # ONE snapshot per run: a live controller (sparkdl_tpu/autotune)
+        # may move batch_size from another thread between runs — every
+        # read below must see the same value or a mid-run shrink would
+        # cut chunks on a stale stride and skip rows
+        batch_size = self.batch_size
         if self.model_fn.backend == "host":
-            out, wait = self._run_host(inputs, n)
+            out, wait = self._run_host(inputs, n, batch_size)
         else:
-            out, wait = self._run_device(inputs, n, counters)
-        self.metrics.add(n, -(-n // self.batch_size),
+            out, wait = self._run_device(inputs, n, counters,
+                                         batch_size)
+        self.metrics.add(n, -(-n // batch_size),
                          time.perf_counter() - t0,
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=wait)
+        # the autotune controller's apply point: knobs only ever move
+        # BETWEEN runs, on the thread that just finished one (a single
+        # armed-check when the controller is disarmed)
+        autotune_poll()
         return out
 
     # -- host path ----------------------------------------------------------
 
-    def _run_host(self, inputs, n) -> Tuple[Dict[str, np.ndarray], float]:
+    def _run_host(self, inputs, n, batch_size
+                  ) -> Tuple[Dict[str, np.ndarray], float]:
         # slab outputs here too: each chunk's result writes its row
         # range of one preallocated [N, *out] array (lazily shaped from
         # the first chunk), replacing the per-chunk list + final concat
         slabs: Optional[Dict[str, np.ndarray]] = None
-        for lo, hi in self._chunks(n):
+        for lo, hi in self._chunks(n, batch_size):
             chunk = {k: v[lo:hi] for k, v in inputs.items()}
             out = self.model_fn.apply_fn(self.model_fn.params, chunk)
             if slabs is None:
@@ -707,19 +800,20 @@ class BatchRunner:
 
     # -- device path --------------------------------------------------------
 
-    def _run_device(self, inputs, n, counters: CopyCounters
+    def _run_device(self, inputs, n, counters: CopyCounters,
+                    batch_size: int
                     ) -> Tuple[Dict[str, np.ndarray], float]:
         fn = self.model_fn.jitted()
         params = self.model_fn.device_params()
         # enqueue then drain to self.max_inflight: 0 = immediate drain,
         # >0 = bounded async dispatch; host_async also starts each
         # result's device→host copy at enqueue; prefetch additionally
-        # device_puts chunk i+1 while chunk i computes (module
+        # device_puts upcoming chunks while chunk i computes (module
         # docstring)
         sink = SlabSink(n)
         staging, locked = self._checkout_staging()
         try:
-            chunks = iter_padded_chunks(inputs, n, self.batch_size,
+            chunks = iter_padded_chunks(inputs, n, batch_size,
                                         staging, counters)
             # SPARKDL_TPU_SANITIZE=1: transfer_guard turns any
             # implicit device→host sync inside dispatch/drain into an
@@ -727,7 +821,8 @@ class BatchRunner:
             with span("runner.run", lane="ship", rows=n,
                       strategy=self.strategy), ship_guard():
                 dispatch_chunks(fn, params, chunks, self.strategy,
-                                self.max_inflight, sink)
+                                self.max_inflight, sink,
+                                prefetch_depth=self.prefetch_depth)
         finally:
             if locked:
                 self._staging_lock.release()

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.obs import default_registry, span
 from sparkdl_tpu.obs import flight
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
@@ -91,6 +92,12 @@ class ModelSession:
         self.config = config
         self.metrics = metrics
         self.chunk = int(runner.preferred_chunk)
+        # the LIVE coalesce window, initialized from the frozen config:
+        # the dispatcher re-reads it per collect, so the autotune
+        # controller (sparkdl_tpu/autotune, ServeTarget) can shrink it
+        # when fill saturates / grow it when p99 headroom exists — a
+        # single float store between batches, never mid-collect
+        self.max_wait_s = float(config.max_wait_s)
         # warmup state for /statusz + flight bundles: None = never
         # attempted, True/False = runner.warmup()'s last answer (False
         # means "nothing to warm", e.g. a host backend)
@@ -237,8 +244,7 @@ class ModelSession:
         # (the wedged-collective signature) may trip the stall verdict
         wd_source = f"serve.dispatcher:{self.name}"
         while True:
-            batch = self._queue.collect(self.chunk,
-                                        self.config.max_wait_s)
+            batch = self._queue.collect(self.chunk, self.max_wait_s)
             if batch is None:
                 return          # closed and drained
             with watchdog_watch(wd_source):
@@ -266,6 +272,11 @@ class ModelSession:
                         for req, _lo, _rows in batch.parts:
                             req.fail(e)
                 self.metrics.publish(reg)
+            # autotune apply point, OUTSIDE the watchdog activity
+            # window: a controller step must never eat this source's
+            # heartbeat budget (disarmed: one armed-check — the
+            # shared-no-op regime)
+            autotune_poll()
 
     def _dispatch(self, batch: MicroBatch) -> None:
         valid = batch.valid
@@ -376,7 +387,8 @@ class ModelServer:
     def register(self, name: str, model_fn=None, *, runner=None,
                  batch_size: int = 64, mesh=None,
                  strategy: Optional[str] = None,
-                 max_inflight: Optional[int] = None) -> ModelSession:
+                 max_inflight: Optional[int] = None,
+                 prefetch_depth: Optional[int] = None) -> ModelSession:
         """Register a model under ``name``: either a ``ModelFunction``
         (a ``BatchRunner`` is built; pass ``mesh`` for a data-parallel
         ``ShardedBatchRunner`` — ``batch_size`` is then PER-CHIP) or a
@@ -389,11 +401,13 @@ class ModelServer:
             if mesh is not None:
                 runner = ShardedBatchRunner(
                     model_fn, mesh=mesh, batch_size=batch_size,
-                    strategy=strategy, max_inflight=max_inflight)
+                    strategy=strategy, max_inflight=max_inflight,
+                    prefetch_depth=prefetch_depth)
             else:
                 runner = BatchRunner(
                     model_fn, batch_size=batch_size, strategy=strategy,
-                    max_inflight=max_inflight)
+                    max_inflight=max_inflight,
+                    prefetch_depth=prefetch_depth)
         elif mesh is not None:
             raise ValueError(
                 "pass mesh= with model_fn=, not with a prebuilt "
@@ -474,12 +488,17 @@ class ModelServer:
                     "warmed": s.warmed,
                     "collective": s.collective,
                     "chunk": s.chunk,
+                    # the LIVE coalesce window (autotune may have
+                    # moved it off config.max_wait_s)
+                    "max_wait_s": s.max_wait_s,
                     "runner": {
                         "type": type(s.runner).__name__,
                         "strategy": getattr(s.runner, "strategy",
                                             None),
                         "max_inflight": getattr(s.runner,
                                                 "max_inflight", None),
+                        "prefetch_depth": getattr(
+                            s.runner, "prefetch_depth", None),
                         "batch_size": getattr(s.runner, "batch_size",
                                               None),
                     },

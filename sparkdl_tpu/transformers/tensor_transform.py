@@ -131,7 +131,13 @@ class TensorTransformer(Transformer, HasModelFunction, HasInputMapping,
             return batch
 
         kind = "device" if mf.backend == "jax" else "host"
+        # the hint FOLLOWS the runner (LiveBatchHint) instead of
+        # freezing preferred_chunk at plan build: the autotune
+        # controller may move the device batch along its pre-warmed
+        # shape ladder mid-stream and the engine's re-chunk cut
+        # follows (data/engine.py::_stream_rechunk re-reads per block)
+        from sparkdl_tpu.data.frame import LiveBatchHint
         return dataset.map_batches(
             apply, kind=kind, name=f"apply({mf.name})",
-            batch_hint=(runner.preferred_chunk if kind == "device"
+            batch_hint=(LiveBatchHint(runner) if kind == "device"
                         else None))

@@ -480,6 +480,16 @@ class TestHarness:
         unsuppressed = [f for f in found if not f.suppressed]
         assert unsuppressed == [], format_findings(unsuppressed)
 
+    def test_meta_autotune_package_is_clean(self):
+        """The autotune layer writes to knobs other threads' hot loops
+        read and keeps its own lock-guarded counters — pin it by name
+        (zero unsuppressed H1–H5) so a controller refactor that breaks
+        the lock/clock discipline names the right package instead of
+        hiding in the whole-tree gate above."""
+        found = analyze_paths([os.path.join(PKG_DIR, "autotune")])
+        unsuppressed = [f for f in found if not f.suppressed]
+        assert unsuppressed == [], format_findings(unsuppressed)
+
     def test_meta_known_drains_are_suppressed_not_invisible(self):
         """The drain path is allowlisted, not skipped: the single
         blessed device_get — obs/trace.py::timed_device_get, where

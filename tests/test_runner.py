@@ -270,7 +270,7 @@ class TestBatchRunner:
 
         import sparkdl_tpu.runtime.runner as rmod
 
-        monkeypatch.setattr(rmod, "_warned_no_prefetch", False)
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
         calls = []
 
         def no_async_put(v, *a, **k):

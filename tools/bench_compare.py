@@ -42,8 +42,11 @@ from typing import List, Optional
 
 #: nested objects whose KEYS vary run-to-run (only their type is
 #: checked): the registry snapshot depends on which subsystems ran,
-#: memory stats on the backend
-DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources"}
+#: memory stats on the backend, and the autotune block's
+#: converged-config / decision detail on which targets and knobs the
+#: controller actually touched that round
+DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
+                "autotune"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

@@ -18,12 +18,18 @@
 #      parse. Runs under SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard
 #      enforces the aligned ship path's zero-copy claim at runtime,
 #      not just in the counters.
-#   5. bench schema-trajectory gate: tools/bench_compare.py checks
+#   5. autotune gate (docs/PERFORMANCE.md): the smoke JSON's
+#      "autotune" block must show the closed-loop controller SETTLED
+#      — ≤2 knob changes after its settle window, zero oscillations —
+#      with tuned throughput not losing to the fixed host_async
+#      default outside the recorded noise band (floored at 25% for
+#      the 1-core CI host's scheduler jitter).
+#   6. bench schema-trajectory gate: tools/bench_compare.py checks
 #      the fresh tiny-bench JSON against the committed round schema
 #      (BENCH_r05.json, falling back to r04's parsable schema) —
 #      same keys/types, schema_version present — so bench-trajectory
 #      tracking can't silently drift between rounds.
-#   6. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
+#   7. obs gate (docs/OBSERVABILITY.md): the tiny bench re-runs ARMED
 #      (SPARKDL_TPU_TRACE=1) and its exported Perfetto trace is
 #      schema-checked (valid trace-event list, ≥1 span per lane:
 #      engine/ship/device/serve, with serve batch fill > 0.5 under
@@ -31,13 +37,13 @@
 #      (engine stages → runner dispatch/drain → estimator steps → a
 #      collective launch) must produce a trace carrying a
 #      collective_lock_wait span, and the report CLI must read it
-#   7. watchdog + flight-recorder + telemetry gate: a synthetic stall
+#   8. watchdog + flight-recorder + telemetry gate: a synthetic stall
 #      (dispatcher blocked inside a dispatch) under a short watchdog
 #      threshold must fire the stall verdict, flip /healthz to 503,
 #      and produce a flight bundle carrying ≥1 span, the serve queue
 #      state, and a watchdog.stalls ≥ 1 registry snapshot; after
 #      recovery /metricsz must scrape as valid Prometheus text.
-#   8. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
+#   9. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
 #      H2 retrace, H3 locks, H4 quiesce, H5 clock discipline) must
 #      report ZERO unsuppressed findings, plus the ruff baseline when
 #      installed
@@ -56,7 +62,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/8] native shim build =="
+echo "== [1/9] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -65,13 +71,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/8] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/9] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/8] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/9] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/8] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/9] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -80,7 +86,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/8] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/9] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
 python - <<'EOF'
 import json
@@ -102,6 +108,7 @@ required = [
     "host_decode_ips_packed420",
     "pipeline_bound_by", "pipeline_stage_ceilings_ips",
     "host_copy", "fidelity", "runner_strategy", "sanitize", "serve",
+    "autotune",
 ]
 missing = [k for k in required if k not in d]
 assert not missing, f"bench smoke: missing JSON keys {missing}"
@@ -140,11 +147,50 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/8] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [5/9] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_bench_smoke.json") as f:
+    d = json.loads(f.read().strip().splitlines()[-1])
+at = d["autotune"]
+required = ["armed", "strategy", "baseline_strategy", "baseline_ips",
+            "tuned_ips", "noise_band_pct", "decisions",
+            "changes_after_warmup", "oscillations", "clamps", "steps",
+            "converged"]
+missing = [k for k in required if k not in at]
+assert not missing, f"autotune block: missing keys {missing}"
+assert at["armed"] is True, at
+assert at["baseline_strategy"] == "host_async", at
+for k in ("max_inflight", "prefetch_depth"):
+    assert isinstance(at["converged"].get(k), int), at["converged"]
+# convergence: the controller must SETTLE — bounded changes after its
+# settle window and zero refused direction flip-flops. A controller
+# that keeps hunting is worse than no controller.
+assert at["changes_after_warmup"] <= 2, at
+assert at["oscillations"] == 0, at
+# the tuned config must not LOSE to the fixed host_async expert
+# default outside the recorded noise band (floored at 25%: the 1-core
+# CI host's scheduler jitter dominates the baseline's own spread)
+band = max(0.25, at["noise_band_pct"] / 100.0)
+floor = at["baseline_ips"] * (1.0 - band)
+assert at["tuned_ips"] >= floor, \
+    (f"autotune lost to the fixed default outside the noise band: "
+     f"tuned {at['tuned_ips']} < floor {floor:.1f} "
+     f"(baseline {at['baseline_ips']}, band {band:.0%})")
+print(json.dumps({"autotune_gate": "ok",
+                  "tuned_ips": at["tuned_ips"],
+                  "baseline_ips": at["baseline_ips"],
+                  "changes_after_warmup": at["changes_after_warmup"],
+                  "oscillations": at["oscillations"],
+                  "converged": at["converged"]}))
+EOF
+
+echo "== [6/9] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [6/8] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/9] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_obs.json
 python - <<'EOF'
@@ -238,7 +284,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [7/8] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [8/9] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -362,7 +408,7 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [8/8] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [9/9] static analysis (sparkdl-lint + ruff baseline) =="
 tools/lint.sh sparkdl_tpu
 
 echo "== ci.sh: ALL GREEN =="

@@ -17,15 +17,23 @@ unreliable on the tunneled platform):
   runner_strategy_ips
               the SAME four strategies measured through the production
               BatchRunner (slab outputs + reusable pad staging + the
-              built-in depth-1 "prefetch" strategy) — what the library
+              built-in depth-N "prefetch" strategy) — what the library
               actually ships, vs the hand-rolled loops above
   host_copy   RunnerMetrics' bytes-staged/bytes-copied/transfer-wait
               counters for batch-aligned vs tail-padded runs (the
               aligned shape must report 0/0: zero-copy ship)
 
+``--sweep`` instead measures a (strategy × depth) grid through the
+production BatchRunner — depth is ``max_inflight`` for the queued
+strategies and ``prefetch_depth`` for prefetch — and emits per-config
+rows/s: the measured priors behind the autotune controller's bounds
+(sparkdl_tpu/autotune, docs/PERFORMANCE.md) on whatever host runs it.
+``--model/--batch/--rows`` size the sweep (TestNet makes it cheap on
+CPU).
+
 Prints one JSON object; run on the real chip (no JAX_PLATFORMS
-override) or CPU. Results feed BatchRunner's strategy choice and
-bench.py's reporting.
+override) or CPU. Results feed BatchRunner's strategy choice,
+the autotuner's priors, and bench.py's reporting.
 """
 
 from __future__ import annotations
@@ -156,15 +164,82 @@ def _runner_strategies(batch_size: int, n_rows: int) -> dict:
     return out
 
 
+def _sweep(model: str, batch: int, rows: int,
+           depths=(1, 2, 4, 8)) -> list:
+    """The (strategy × depth) grid through the production BatchRunner:
+    per-config rows/s, best of 2 timed passes (pass 1 absorbs any
+    residual jit/cache effects beyond the explicit warmup). ``depth``
+    maps to the knob each strategy actually has — ``max_inflight`` for
+    deferred/host_async, ``prefetch_depth`` (at the default inflight)
+    for prefetch; immediate has no queue and measures once."""
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.runtime.runner import BatchRunner
+
+    mf = getModelFunction(model, featurize=True)
+    in_name = mf.input_names[0]
+    shape, dtype = mf.input_signature[in_name]
+    images = np.random.default_rng(2).integers(
+        0, 255, size=(rows,) + tuple(shape)).astype(dtype)
+    grid = []
+    for strategy in ("immediate", "deferred", "host_async", "prefetch"):
+        for depth in ((None,) if strategy == "immediate" else depths):
+            kwargs = {}
+            if strategy == "prefetch":
+                kwargs["prefetch_depth"] = depth
+            elif depth is not None:
+                kwargs["max_inflight"] = depth
+            runner = BatchRunner(mf, batch_size=batch,
+                                 strategy=strategy, **kwargs)
+            runner.run({in_name: images[:batch]})    # compile + warm
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                runner.run({in_name: images})
+                best = max(best, rows / (time.perf_counter() - t0))
+            grid.append({"strategy": strategy,
+                         "max_inflight": runner.max_inflight,
+                         "prefetch_depth": runner.prefetch_depth,
+                         "rows_per_s": round(best, 1)})
+    return grid
+
+
 def main() -> None:
+    import argparse
+
     import jax
 
     from sparkdl_tpu.models.zoo import getModelFunction
 
+    parser = argparse.ArgumentParser(
+        prog="python tools/measure_transfer.py",
+        description="measure host<->device transfer strategies "
+                    "(module docstring)")
+    parser.add_argument("--sweep", action="store_true",
+                        help="measure the (strategy x depth) grid "
+                             "through the production BatchRunner "
+                             "instead of the default report")
+    parser.add_argument("--model", default="InceptionV3",
+                        help="model for --sweep (TestNet is the cheap "
+                             "CPU choice)")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="device batch for --sweep (default: "
+                             "platform-sized)")
+    parser.add_argument("--rows", type=int, default=None,
+                        help="rows per timed pass for --sweep "
+                             "(default: 4x batch)")
+    args = parser.parse_args()
+
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    batch = 256 if on_tpu else 8
-    rows = batch * (4 if on_tpu else 2)
+    if args.sweep:
+        batch = args.batch or (256 if on_tpu else 8)
+        rows = args.rows or batch * 4
+        print(json.dumps({"platform": platform, "model": args.model,
+                          "batch": batch, "rows": rows,
+                          "sweep": _sweep(args.model, batch, rows)}))
+        return
+    batch = args.batch or (256 if on_tpu else 8)
+    rows = args.rows or batch * (4 if on_tpu else 2)
     report = {
         "platform": platform,
         "link": measure_link(32 if on_tpu else 8),
