@@ -47,8 +47,15 @@ Reports, in ONE JSON line (driver contract):
 * ``serve`` — the online-serving shape (docs/SERVING.md): concurrent
   sub-batch requests through the ModelServer's dynamic micro-batching
   front-end — offered vs achieved rows/sec, mean batch fill ratio,
-  p99 request latency, rejection/deadline-miss counts. tools/ci.sh
-  gates the schema and (armed) the fill ratio + serve-lane trace.
+  p99 request latency, rejection/deadline-miss/failure counts.
+  tools/ci.sh gates the schema and (armed) the fill ratio +
+  serve-lane trace.
+* ``tails`` — per-request tail attribution (docs/OBSERVABILITY.md):
+  the serve pass runs with the request log armed, and the measured
+  request p50/p99 plus the p99 specimen's phase breakdown
+  (queue/coalesce/staging/device/reassembly) come from the recorded
+  timelines. tools/ci.sh gates the schema and the ≥95% attribution
+  bar.
 * ``autotune`` — the closed-loop infeed autotuner
   (sparkdl_tpu/autotune, docs/PERFORMANCE.md): tuned-vs-fixed
   throughput with the baseline's recorded noise band, decision /
@@ -271,7 +278,7 @@ def measure_fidelity(mf, packed_src, n_images: int = 32) -> dict:
 
 
 def measure_serve(mf, batch_size: int, n_requests: int,
-                  rows_per_request: int, threads: int = 4) -> dict:
+                  rows_per_request: int, threads: int = 4) -> tuple:
     """The online-serving shape (docs/SERVING.md): a ModelServer over
     the production BatchRunner, hammered by concurrent submitter
     threads at offered load above the bounded queue's capacity.
@@ -280,9 +287,16 @@ def measure_serve(mf, batch_size: int, n_requests: int,
     latency, and the rejection count — the backpressure contract made
     a number instead of an assertion. Requests are sized at a fraction
     of the device batch so the achieved rate is earned by coalescing,
-    not by callers pre-batching."""
+    not by callers pre-batching; a couple of OVERSIZED requests ride
+    along so the tails block sees the split-and-reassemble path.
+
+    Returns ``(serve_block, tails_block)``: the request log is armed
+    for the measurement window, so every request records a phase
+    timeline and the ``"tails"`` block attributes the measured p99
+    across phases (tails_from_records)."""
     import threading as th
 
+    from sparkdl_tpu.obs.request_log import request_log, tails_from_records
     from sparkdl_tpu.serve import ModelServer, ServeConfig, ServerOverloaded
 
     in_name = mf.input_names[0]
@@ -293,6 +307,15 @@ def measure_serve(mf, batch_size: int, n_requests: int,
                            rows_per_request * threads * 2)))
     server.register("bench", mf, batch_size=batch_size)
     server.warmup()
+
+    rlog = request_log()
+    # save the OVERRIDE, not the derived armed bit: an env/tracer-armed
+    # log must come back override-free (a stuck override would outlive
+    # the tracer's disarm), and a caller's explicit disarm must survive
+    # this measurement (the flight.autoarm override-inspection precedent)
+    rlog_override = rlog._override
+    rlog.arm()
+    rlog.clear()
 
     futures, lock = [], th.Lock()
 
@@ -313,6 +336,19 @@ def measure_serve(mf, batch_size: int, n_requests: int,
     t0 = time.perf_counter()
     for w in workers:
         w.start()
+    # the split-path specimens: two requests larger than the device
+    # batch, so the tails block covers reassembled multi-batch flows
+    rng = np.random.default_rng(99)
+    big = rng.integers(0, 255, (batch_size + rows_per_request,)
+                       + tuple(shape)).astype(dtype)
+    for _ in range(2):
+        try:
+            f = server.submit({in_name: big})
+        except ServerOverloaded:
+            pass
+        else:
+            with lock:
+                futures.append(f)
     for w in workers:
         w.join()
     # offered load is a SUBMISSION-side rate: clocked at worker join,
@@ -326,17 +362,23 @@ def measure_serve(mf, batch_size: int, n_requests: int,
         completed_rows += len(next(iter(out.values())))
     elapsed = time.perf_counter() - t0
     server.close()
+    tails = tails_from_records(rlog.records())
+    rlog._override = rlog_override
     m = server.metrics.as_dict()
-    offered_rows = threads * n_requests * rows_per_request
-    return {"offered_rows_per_s": round(offered_rows / submit_elapsed, 1),
-            "achieved_rows_per_s": round(completed_rows / elapsed, 1),
-            "requests": m["requests"],
-            "rows": m["rows"],
-            "batches": m["batches"],
-            "batch_fill_ratio": m["batch_fill_ratio"],
-            "p99_latency_ms": m["latency_p99_ms"],
-            "rejections": m["rejections"],
-            "deadline_misses": m["deadline_misses"]}
+    offered_rows = (threads * n_requests * rows_per_request
+                    + 2 * len(big))
+    serve = {
+        "offered_rows_per_s": round(offered_rows / submit_elapsed, 1),
+        "achieved_rows_per_s": round(completed_rows / elapsed, 1),
+        "requests": m["requests"],
+        "rows": m["rows"],
+        "batches": m["batches"],
+        "batch_fill_ratio": m["batch_fill_ratio"],
+        "p99_latency_ms": m["latency_p99_ms"],
+        "rejections": m["rejections"],
+        "deadline_misses": m["deadline_misses"],
+        "failures": m["failures"]}
+    return serve, tails
 
 
 def measure_autotune(mf, batch_size: int, n_rows: int) -> dict:
@@ -618,7 +660,12 @@ def main() -> None:
     else:
         serve_args = dict(n_requests=2, rows_per_request=batch_size // 2,
                           threads=2)
-    serve = measure_serve(mf, batch_size, **serve_args)
+    # the serve pass runs with the request log armed: the "tails"
+    # block attributes the measured request p99 across the named
+    # phases (queue/coalesce/staging/device/reassembly) from the
+    # per-request timelines — tools/ci.sh gates its schema and the
+    # ≥95% attribution bar
+    serve, tails = measure_serve(mf, batch_size, **serve_args)
 
     # the closed-loop infeed autotuner (sparkdl_tpu/autotune,
     # docs/PERFORMANCE.md): controller settles (few changes, zero
@@ -708,6 +755,12 @@ def main() -> None:
         "watchdog": stall_watchdog().verdict(),
         "flight": obs_flight.recorder().status(),
     }
+    from sparkdl_tpu.obs.request_log import request_log as _rlog
+    from sparkdl_tpu.obs.slo import slo_tracker as _slo
+    # SLO verdicts + request-log retention state: the same shapes
+    # /statusz and the flight bundle carry
+    obs_block["slo"] = _slo().status()
+    obs_block["request_log"] = _rlog().status()
     if trc.armed:
         trace_path = os.environ.get("SPARKDL_TPU_TRACE_EXPORT",
                                     "/tmp/sparkdl_tpu_trace.json")
@@ -779,6 +832,7 @@ def main() -> None:
         },
         "fidelity": fidelity,
         "serve": serve,
+        "tails": tails,
         "autotune": autotune,
         "infeed_race": infeed_race,
         **({"tpu_fallback": ("tunneled TPU backend did not initialize; "

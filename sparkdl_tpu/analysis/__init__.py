@@ -39,6 +39,13 @@ Five rules, each an AST visitor over every module in the package:
   the tracer's ``time.perf_counter`` clock, or wall-clock steps
   (NTP, suspend) silently skew the one timeline the obs layer
   exists to keep honest.
+* **H6 — metric-name cardinality**: registry
+  ``counter``/``gauge``/``reservoir`` names interpolating a
+  request-shaped identifier (``request_id``/``req_id``/``rid``) —
+  a per-request id as a metric key grows one eternal registry entry
+  and Prometheus series per request; ids belong in the bounded
+  ``RequestLog``, reservoir exemplars, and span args
+  (``obs/request_log.py``), never in metric names.
 
 Findings suppress inline with a justification::
 

@@ -1,4 +1,4 @@
-"""The five sparkdl-lint rules (H1–H5), each an AST pass.
+"""The six sparkdl-lint rules (H1–H6), each an AST pass.
 
 Every rule is a function ``(tree, path) -> list[Finding]`` registered
 in :data:`RULES`; the walker runs all of them per file and then applies
@@ -544,6 +544,76 @@ def check_h5(tree: ast.AST, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# H6 — metric-name cardinality (request ids must never become keys)
+
+# The registry is a name → metric table that lives for the process and
+# renders every entry to /metricsz on each scrape. A metric NAME built
+# from a per-request identifier therefore grows without bound (one
+# request = one eternal registry entry + one Prometheus series) — the
+# classic cardinality explosion that kills a metrics backend. The
+# per-request layer has purpose-built homes for these values instead:
+# the bounded RequestLog, reservoir exemplars, and span args
+# (obs/request_log.py). The rule is lexical, matching this repo's
+# idiom: a registry factory call whose name expression interpolates a
+# request-shaped identifier.
+
+_H6_METRIC_FACTORIES = {"counter", "gauge", "reservoir"}
+_H6_REQUEST_NAMES = {"request_id", "req_id", "rid"}
+
+
+def _h6_request_ident(expr: ast.AST) -> Optional[str]:
+    """The first request-shaped identifier used inside a metric-name
+    expression, or None. Matches bare names (``rid``), attribute tails
+    (``req.rid``, ``record.request_id``), and anything whose name ends
+    in ``request_id``."""
+    for node in ast.walk(expr):
+        name: Optional[str] = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is None:
+            continue
+        low = name.lower()
+        if low in _H6_REQUEST_NAMES or low.endswith("request_id"):
+            return name
+    return None
+
+
+class _H6Cardinality(_ScopedVisitor):
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _H6_METRIC_FACTORIES:
+            # the metric name: first positional, or the name= kwarg —
+            # the keyword spelling is just as legal a call form
+            name_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "name"), None)
+            if name_arg is not None \
+                    and not isinstance(name_arg, ast.Constant):
+                ident = _h6_request_ident(name_arg)
+                if ident is not None:
+                    self.flag(
+                        "H6", node,
+                        f"metric name built from `{ident}`: a "
+                        "per-request id as a registry key grows one "
+                        "eternal metric (and Prometheus series) PER "
+                        "REQUEST — unbounded cardinality. Request ids "
+                        "belong in the bounded RequestLog, reservoir "
+                        "exemplars, or span args "
+                        "(obs/request_log.py), never in metric names "
+                        "(suppress: `# sparkdl-lint: allow[H6] -- "
+                        "<why this key set is bounded>`)")
+        self.generic_visit(node)
+
+
+def check_h6(tree: ast.AST, path: str) -> List[Finding]:
+    v = _H6Cardinality(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
@@ -552,6 +622,7 @@ RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
     "H3": check_h3,
     "H4": check_h4,
     "H5": check_h5,
+    "H6": check_h6,
 }
 
 _RULE_DOCS = {
@@ -570,6 +641,10 @@ _RULE_DOCS = {
     "H5": "clock discipline in sparkdl_tpu/obs/ and sparkdl_tpu/serve/"
           ": time.time()/datetime.now() banned — span/latency math "
           "shares the tracer's time.perf_counter clock",
+    "H6": "metric-name cardinality: registry counter/gauge/reservoir "
+          "names interpolating a request id (request_id/req_id/rid) "
+          "banned — per-request values go to the RequestLog / "
+          "exemplars / span args, never into metric names",
 }
 
 

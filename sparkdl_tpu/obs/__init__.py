@@ -2,7 +2,7 @@
 Perfetto-exportable timelines, and an operable health surface across
 engine → ship → device.
 
-Six pieces (docs/OBSERVABILITY.md):
+Eight pieces (docs/OBSERVABILITY.md):
 
 * :mod:`sparkdl_tpu.obs.trace` — ``span(name, lane=...)`` recording
   into one process-wide bounded ring buffer on a single clock, armed by
@@ -23,7 +23,16 @@ Six pieces (docs/OBSERVABILITY.md):
   SIGUSR2, serve dispatch failure, or a watchdog stall;
 * :mod:`sparkdl_tpu.obs.export` — Prometheus text rendering plus a
   localhost ``/metricsz`` / ``/healthz`` / ``/statusz`` HTTP surface
-  (stdlib only), attachable to a ``ModelServer`` or standalone.
+  (stdlib only), attachable to a ``ModelServer`` or standalone;
+* :mod:`sparkdl_tpu.obs.request_log` — per-request timelines: every
+  serve submit mints a ``request_id``, armed requests record a phase
+  breakdown (queue / coalesce / staging / device / reassembly) into a
+  bounded ring, render as linked Perfetto flows, and feed the latency
+  reservoir's worst-case exemplars (``report --tails`` attributes the
+  p99 from an exported trace);
+* :mod:`sparkdl_tpu.obs.slo` — rolling-window SLO evaluation (latency
+  + availability objectives): error-budget remaining and burn rate,
+  published as ``sparkdl_slo_*`` on ``/metricsz``.
 
 Import-light on purpose: nothing here pulls jax (the report CLI and
 the telemetry endpoint work on any machine); :func:`timed_device_get`
@@ -44,6 +53,13 @@ from sparkdl_tpu.obs.registry import (
     Reservoir,
     default_registry,
 )
+from sparkdl_tpu.obs.request_log import (
+    RequestLog,
+    RequestRecord,
+    RequestTimeline,
+    request_log,
+)
+from sparkdl_tpu.obs.slo import SLObjective, SLOTracker, slo_tracker
 from sparkdl_tpu.obs.trace import (
     SpanRecord,
     Tracer,
@@ -59,7 +75,12 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "MetricsRegistry",
+    "RequestLog",
+    "RequestRecord",
+    "RequestTimeline",
     "Reservoir",
+    "SLObjective",
+    "SLOTracker",
     "SpanRecord",
     "StallWatchdog",
     "TelemetryServer",
@@ -67,6 +88,8 @@ __all__ = [
     "default_registry",
     "flight_recorder",
     "render_prometheus",
+    "request_log",
+    "slo_tracker",
     "span",
     "stall_watchdog",
     "start_telemetry",

@@ -183,6 +183,19 @@ class TelemetryServer:
         path = handler.path.split("?", 1)[0]
         try:
             if path in ("/metricsz", "/metrics"):
+                # refresh the SLO gauges at scrape time: the serve
+                # loop's publish is rate-limited (obs/slo.py
+                # publish_due — status() scans the whole outcome
+                # window, not a per-micro-batch cost), and the scrape
+                # is exactly the rare reader that should pay for
+                # freshness. Degrades silently: a broken tracker must
+                # not 500 every other metric.
+                try:
+                    from sparkdl_tpu.obs.slo import slo_tracker
+                    slo_tracker().publish(self._registry)
+                except Exception as e:
+                    logger.debug("telemetry: slo refresh failed: %s",
+                                 e)
                 body = render_prometheus(self._registry).encode()
                 self._reply(handler, 200, body,
                             "text/plain; version=0.0.4; charset=utf-8")
@@ -229,12 +242,20 @@ class TelemetryServer:
             # the flight recorder's per-server degrade shaping, reused:
             # /statusz and flight bundles must not drift apart
             servers = _flight._serve_status()
+        from sparkdl_tpu.obs.request_log import request_log
+        from sparkdl_tpu.obs.slo import slo_tracker
         return {
             "pid": os.getpid(),
             "uptime_s": round(time.perf_counter() - self._epoch, 3),
             "platform": _flight.platform_info(),
             "watchdog": self._watchdog.verdict(),
             "flight": _flight.recorder().status(),
+            # error budgets + burn rate (obs/slo.py) and the bounded
+            # per-request log's state (obs/request_log.py) — the same
+            # shapes the flight bundle carries, so a curl and a
+            # postmortem never disagree
+            "slo": slo_tracker().status(),
+            "request_log": request_log().status(),
             "servers": servers,
             "metrics_count": len(self._registry.snapshot()),
         }

@@ -148,6 +148,36 @@ def _serve_status() -> List[dict]:
     return out
 
 
+def _slo_state() -> dict:
+    """The SLO tracker's verdicts — was the process inside its error
+    budgets when the bundle was cut; degrades like every probe."""
+    try:
+        from sparkdl_tpu.obs.slo import slo_tracker
+        return slo_tracker().status()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _request_state() -> dict:
+    """The request log's state plus the most recent per-request
+    records (id, status, latency, phase breakdown) — the bundle's
+    "which requests were in flight and where were they stuck"
+    section. Bounded: last 32 records, the ring itself is already
+    capped."""
+    try:
+        from sparkdl_tpu.obs.request_log import request_log
+        rlog = request_log()
+        recent = [{
+            "request_id": r.request_id, "model": r.model,
+            "rows": r.rows, "batches": r.batches, "status": r.status,
+            "total_s": round(r.total_s, 6),
+            "phases": {k: round(v, 6) for k, v in r.phases.items()},
+        } for r in rlog.records()[-32:]]
+        return {**rlog.status(), "recent": recent}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -260,6 +290,8 @@ class FlightRecorder:
             "spans_dropped": trc.dropped,
             "serve": _serve_status(),
             "autotune": _autotune_state(),
+            "slo": _slo_state(),
+            "requests": _request_state(),
             "extra": extra or {},
         }
 

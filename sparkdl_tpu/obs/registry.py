@@ -96,9 +96,29 @@ class Gauge:
         self._lock = threading.Lock()
 
 
+def nearest_rank(sorted_vals, q: float) -> float:
+    """THE nearest-rank quantile over an ascending-sorted sequence —
+    the one convention every latency readout in this repo shares
+    (Reservoir quantiles, ``report --tails``, bench's ``"tails"``
+    block), so the scraped p99, the attributed p99, and the gated p99
+    cannot drift onto different math. Raises on an empty sequence —
+    callers own their "no observations" semantics."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    if not sorted_vals:
+        raise ValueError("nearest_rank needs at least one value")
+    last = len(sorted_vals) - 1
+    return sorted_vals[
+        min(last, max(0, math.ceil(q * len(sorted_vals)) - 1))]
+
+
 #: default Reservoir window (observations) — enough for a stable p99
 #: under sustained load without unbounded growth
 DEFAULT_RESERVOIR_CAPACITY = 4096
+
+#: retained worst-case exemplars per reservoir — a scraped p99 needs a
+#: handful of traceable specimens, not a second latency log
+EXEMPLAR_CAPACITY = 8
 
 
 class Reservoir:
@@ -106,11 +126,21 @@ class Reservoir:
     quantile readout (request latencies, batch fill samples). Keeps the
     most recent ``capacity`` observations; ``count`` stays the lifetime
     total so a snapshot distinguishes "few samples" from "few
-    retained"."""
+    retained".
+
+    **Exemplars**: ``observe(value, exemplar={...})`` additionally
+    offers a small payload (a request_id + phase breakdown) for
+    worst-case retention — the :data:`EXEMPLAR_CAPACITY` largest
+    recent values keep theirs, so a scraped p99 resolves to an actual
+    request/trace instead of an anonymous number. Retention is a hard
+    bound: candidates not retained (and retained ones displaced or
+    aged out of the observation window) count in
+    ``exemplars_dropped`` — the cardinality guard made visible, never
+    an unbounded side-log."""
 
     # sparkdl-lint H3 contract: observations arrive from every caller
-    # thread at once — writes to count hold self._lock
-    _lock_guards = ("count",)
+    # thread at once — writes to these hold self._lock
+    _lock_guards = ("count", "exemplars_dropped")
 
     def __init__(self, name: str,
                  capacity: int = DEFAULT_RESERVOIR_CAPACITY):
@@ -120,14 +150,63 @@ class Reservoir:
         self.name = name
         self.capacity = capacity
         self.count = 0
+        self.exemplars_dropped = 0
         self._window: collections.deque = collections.deque(
             maxlen=capacity)
+        # (value, lifetime seq, payload) — small (EXEMPLAR_CAPACITY),
+        # scanned linearly per exemplar-carrying observe
+        self._exemplars: list = []
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar=None) -> None:
         with self._lock:
             self._window.append(float(value))
             self.count += 1
+            if exemplar is not None:
+                self._offer_exemplar(float(value), exemplar)
+
+    def _offer_exemplar(self, value: float, payload) -> None:
+        # holding self._lock. Age out exemplars whose observation left
+        # the sliding window — a worst case from an hour ago must not
+        # shadow the current tail
+        horizon = self.count - self.capacity
+        fresh = [e for e in self._exemplars if e[1] > horizon]
+        # sparkdl-lint: allow[H3] -- observe() holds self._lock around every _offer_exemplar call (private helper, lock documented on the first line above)
+        self.exemplars_dropped += len(self._exemplars) - len(fresh)
+        self._exemplars = fresh
+        if len(self._exemplars) < EXEMPLAR_CAPACITY:
+            self._exemplars.append((value, self.count, payload))
+            return
+        worst_idx = min(range(len(self._exemplars)),
+                        key=lambda i: self._exemplars[i][0])
+        if value > self._exemplars[worst_idx][0]:
+            self._exemplars[worst_idx] = (value, self.count, payload)
+        # either the displaced retained exemplar or the rejected
+        # candidate — one payload was discarded by the bound
+        # sparkdl-lint: allow[H3] -- observe() holds self._lock around every _offer_exemplar call
+        self.exemplars_dropped += 1
+
+    def exemplars(self) -> list:
+        """The retained worst-case exemplars, largest value first:
+        ``[{**payload, "value": v}, ...]`` (``value`` is reserved —
+        the observed number always wins a payload collision). The
+        window horizon applies HERE too: plain ``observe()`` calls
+        advance the window without touching the exemplar list, and an
+        hour-old specimen must not be reported as the current tail
+        once its observation has left the window."""
+        with self._lock:
+            horizon = self.count - self.capacity
+            fresh = [e for e in self._exemplars if e[1] > horizon]
+            self.exemplars_dropped += len(self._exemplars) - len(fresh)
+            self._exemplars = fresh
+            items = sorted(fresh, reverse=True,
+                           key=lambda e: (e[0], e[1]))
+        out = []
+        for value, _seq, payload in items:
+            d = dict(payload)
+            d["value"] = value
+            out.append(d)
+        return out
 
     def quantile(self, q: float) -> float:
         """Nearest-rank quantile over the retained window; 0.0 when no
@@ -146,10 +225,7 @@ class Reservoir:
             vals = sorted(self._window)
         if not vals:
             return tuple(0.0 for _ in qs)
-        last = len(vals) - 1
-        return tuple(
-            vals[min(last, max(0, math.ceil(q * len(vals)) - 1))]
-            for q in qs)
+        return tuple(nearest_rank(vals, q) for q in qs)
 
     # locks don't pickle; the retained window and lifetime count travel
     # (StageMetrics precedent)

@@ -15,12 +15,27 @@ where the run's microseconds went without opening a UI:
   ``collective_lock_wait``, ``device_put``, ``pad_stage``, and the
   serve lane's ``coalesce`` window) broken out, because those are the
   seconds a perf PR can actually claw back.
+
+``report --tails <trace.json>`` adds tail-latency attribution from the
+per-request spans the serve layer records when armed
+(obs/request_log.py): the request-latency p50/p99 and the p99
+specimen's breakdown across the named phases (queue vs coalesce-wait
+vs staging vs device vs reassembly) — where the TAIL spends its time,
+which a lane-busy summary cannot say.
+
+Forward-compat contract (both modes): event TYPES are data too — flow
+events (``ph`` s/t/f, how split requests link), counter events, and
+``ph`` values this report has never heard of must all be skipped, not
+crashed on. Pinned by ``tests/test_request_obs.py``.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sparkdl_tpu.obs.registry import nearest_rank
+from sparkdl_tpu.obs.request_log import PHASES
 
 #: span names that are waits, not work — the claw-back targets.
 #: ``coalesce`` is the serve lane's batching window: time spent
@@ -125,14 +140,139 @@ def summarize(events: Sequence[dict]) -> str:
     return "\n".join(lines)
 
 
+def tails_summary(events: Sequence[dict]) -> Optional[dict]:
+    """Tail-latency attribution from the per-request spans
+    (obs/request_log.py records one ``request`` span per resolved
+    request, its args carrying the phase breakdown in ``phases_s``).
+    Returns ``None`` when the trace holds no request spans (disarmed
+    run, or pre-request-log trace — forward AND backward compatible).
+
+    The dict: request count, p50/p99 latency (nearest-rank over
+    successful requests; failed ones live in the availability stream,
+    not the latency population), the p99 specimen's id and per-phase
+    milliseconds, and ``attributed_pct`` — how much of the measured
+    p99 the named phases account for (the acceptance bar is ≥95%)."""
+    reqs = [e for e in events
+            if e.get("ph") == "X" and e.get("name") == "request"
+            and isinstance(e.get("args"), dict) and "ts" in e]
+    if not reqs:
+        return None
+    pool = [e for e in reqs if e["args"].get("status", "ok") == "ok"]
+    if not pool:
+        # the latency population is successes ONLY (the separate-
+        # population contract) — a trace of pure failures has no
+        # percentiles to attribute, and must say so rather than
+        # quietly computing them from the excluded population
+        return {"requests": 0, "failed_excluded": len(reqs),
+                "p50_ms": None, "p99_ms": None,
+                "p99_request_id": None, "p99_batches": None,
+                "p99_phases_ms": {}, "attributed_pct": None,
+                "tail_phase_pct": {}}
+    durs = sorted(float(e.get("dur", 0.0)) for e in pool)
+    p50_us, p99_us = (nearest_rank(durs, 0.5),
+                      nearest_rank(durs, 0.99))
+    worst = next(e for e in pool
+                 if float(e.get("dur", 0.0)) == p99_us)
+    phases_s = worst["args"].get("phases_s") or {}
+    total_s = float(worst.get("dur", 0.0)) / 1e6
+    attributed_s = sum(float(v) for v in phases_s.values()
+                       if isinstance(v, (int, float)))
+    attributed_pct = (100.0 * attributed_s / total_s) if total_s else 0.0
+
+    # the aggregate tail (every request at/above the p99): mean phase
+    # fractions — is the specimen typical of its tail or an outlier?
+    tail = [e for e in pool if float(e.get("dur", 0.0)) >= p99_us]
+    tail_fractions: Dict[str, float] = {}
+    counted = 0
+    for e in tail:
+        ph = e["args"].get("phases_s")
+        dur_s = float(e.get("dur", 0.0)) / 1e6
+        if not isinstance(ph, dict) or dur_s <= 0:
+            continue
+        counted += 1
+        for k, v in ph.items():
+            if isinstance(v, (int, float)):
+                tail_fractions[k] = tail_fractions.get(k, 0.0) \
+                    + float(v) / dur_s
+    if counted:
+        tail_fractions = {k: round(100.0 * v / counted, 1)
+                          for k, v in tail_fractions.items()}
+
+    return {
+        "requests": len(pool),
+        "failed_excluded": len(reqs) - len(pool),
+        "p50_ms": round(p50_us / 1e3, 3),
+        "p99_ms": round(p99_us / 1e3, 3),
+        "p99_request_id": worst["args"].get("request_id"),
+        "p99_batches": worst["args"].get("batches"),
+        "p99_phases_ms": {k: round(float(v) * 1e3, 3)
+                          for k, v in phases_s.items()
+                          if isinstance(v, (int, float))},
+        "attributed_pct": round(attributed_pct, 1),
+        "tail_phase_pct": tail_fractions,
+    }
+
+
+def summarize_tails(events: Sequence[dict]) -> str:
+    """The ``--tails`` text section (unit-testable without the CLI)."""
+    t = tails_summary(events)
+    if t is None:
+        return ("(no request spans in trace — arm SPARKDL_TPU_TRACE "
+                "(or SPARKDL_TPU_REQUEST_LOG=1) and serve traffic "
+                "through a ModelServer to record per-request "
+                "timelines)")
+    if t["requests"] == 0:
+        return (f"({t['failed_excluded']} failed request(s), no "
+                "successes — the latency population is successes "
+                "only; see the availability objective on /statusz "
+                "for the failure story)")
+    lines = [
+        f"requests: {t['requests']} "
+        f"(+{t['failed_excluded']} failed, excluded from the latency "
+        f"population)   p50 {t['p50_ms']:.3f} ms   "
+        f"p99 {t['p99_ms']:.3f} ms",
+        "",
+        f"p99 attribution — request {t['p99_request_id']} "
+        f"({t['p99_batches']} micro-batch(es)):",
+    ]
+    total_ms = t["p99_ms"] or 1e-9
+    for phase in PHASES:
+        ms = t["p99_phases_ms"].get(phase)
+        if ms is None:
+            continue
+        lines.append(f"  {phase.ljust(11)} {ms:10.3f} ms  "
+                     f"{100.0 * ms / total_ms:5.1f}%")
+    for phase, ms in sorted(t["p99_phases_ms"].items()):
+        if phase not in PHASES:     # forward-compat: new phases print
+            lines.append(f"  {phase.ljust(11)} {ms:10.3f} ms  "
+                         f"{100.0 * ms / total_ms:5.1f}%")
+    lines.append(f"  attributed: {t['attributed_pct']:.1f}% of the "
+                 "measured p99")
+    if t["tail_phase_pct"]:
+        frac = ", ".join(f"{k} {v:.1f}%" for k, v in sorted(
+            t["tail_phase_pct"].items(),
+            key=lambda kv: -kv[1]))
+        lines.append(f"  tail mean breakdown: {frac}")
+    return "\n".join(lines)
+
+
 def main(argv: Sequence[str]) -> int:
-    if len(argv) != 2 or argv[0] != "report":
-        print("usage: python -m sparkdl_tpu.obs report <trace.json>")
+    args = list(argv)
+    tails = "--tails" in args
+    if tails:
+        args.remove("--tails")
+    if len(args) != 2 or args[0] != "report":
+        print("usage: python -m sparkdl_tpu.obs report [--tails] "
+              "<trace.json>")
         return 2
     try:
-        events = load_events(argv[1])
+        events = load_events(args[1])
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}")
         return 2
     print(summarize(events))
+    if tails:
+        print()
+        print("request tails (per-request phase attribution)")
+        print(summarize_tails(events))
     return 0

@@ -1,0 +1,300 @@
+"""SLO tracking: error budgets and burn rates over rolling windows.
+
+Raw percentiles answer "how slow is it"; operating a service needs
+"are we eating our latency budget, and how fast" (the production-
+monitoring discipline the TensorFlow system paper argues for). The
+tracker evaluates configurable objectives over a rolling window of
+per-request outcomes fed by the serve layer:
+
+* **latency** — at least ``target`` of requests answer within
+  ``threshold_s`` (a failed request did NOT answer within threshold
+  and counts bad);
+* **availability** — at least ``target`` of requests succeed (the
+  separate availability stream: deadline-expired / failed / shed
+  requests land HERE, never in the latency reservoir's percentile
+  population — each number is computed from the correct population).
+
+Readout per objective: the error budget is ``1 - target``; the
+**burn rate** is ``bad_fraction / error_budget`` (1.0 = consuming the
+budget exactly at the sustainable rate, >1 = burning too fast — the
+standard multi-window alerting quantity); **budget remaining** is
+``1 - burn_rate`` clamped into [-1, 1] (negative = blown). Both
+publish as ``slo.<objective>.*`` registry gauges — scraped as
+``sparkdl_slo_*`` from ``/metricsz`` — and ride ``/statusz`` and the
+flight bundle.
+
+Always on, like the registry counters: ``record()`` is a lock, a
+deque append, and an amortized prune — no arming needed, and with no
+events every objective reads burn 0 / budget 1. The event ring is
+hard-bounded (:data:`EVENT_CAPACITY`); all clocks are
+``time.perf_counter`` (sparkdl-lint H5).
+
+Objectives default from the env (typos degrade to defaults, the
+watchdog-threshold precedent): ``SPARKDL_TPU_SLO_LATENCY_S``
+(threshold, default 0.5), ``SPARKDL_TPU_SLO_LATENCY_TARGET`` (0.99),
+``SPARKDL_TPU_SLO_AVAIL_TARGET`` (0.999), ``SPARKDL_TPU_SLO_WINDOW_S``
+(300); or set programmatically via :meth:`SLOTracker.set_objectives`.
+
+Pickle discipline (StageMetrics precedent): the lock and the event
+ring drop (perf_counter instants are per-process); objectives travel.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: bounded outcome ring — enough for a stable window under sustained
+#: load without unbounded growth
+EVENT_CAPACITY = 8192
+
+#: minimum spacing of hot-path gauge publishes (publish_due): status()
+#: scans the whole event window, which a per-micro-batch cadence must
+#: not pay — scrapes tolerate sub-second staleness, dispatchers don't
+#: tolerate O(window) per batch
+PUBLISH_INTERVAL_S = 0.25
+
+DEFAULT_LATENCY_THRESHOLD_S = 0.5
+DEFAULT_LATENCY_TARGET = 0.99
+DEFAULT_AVAIL_TARGET = 0.999
+DEFAULT_WINDOW_S = 300.0
+
+
+def _env_float(name: str, default: float, *, positive: bool = True,
+               fraction: bool = False) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+        if positive and v <= 0:
+            raise ValueError(v)
+        if fraction and not 0.0 < v < 1.0:
+            raise ValueError(v)
+    except ValueError:
+        # config typos degrade to the default, loudly — never break an
+        # import or a serving loop over an objective string
+        logger.warning("%s=%r is not a valid value; using the default "
+                       "%s", name, raw, default)
+        return default
+    return v
+
+
+@dataclasses.dataclass(frozen=True)
+class SLObjective:
+    """One objective: ``kind`` is ``"latency"`` (good = answered within
+    ``threshold_s``) or ``"availability"`` (good = succeeded), judged
+    against ``target`` over the trailing ``window_s``."""
+
+    name: str
+    kind: str
+    target: float
+    window_s: float = DEFAULT_WINDOW_S
+    threshold_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "availability"):
+            raise ValueError(
+                f"kind must be 'latency' or 'availability', got "
+                f"{self.kind!r}")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(
+                f"target must be a fraction in (0, 1), got "
+                f"{self.target}")
+        if self.window_s <= 0:
+            raise ValueError(
+                f"window_s must be positive, got {self.window_s}")
+        if self.kind == "latency" and (self.threshold_s is None
+                                       or self.threshold_s <= 0):
+            raise ValueError(
+                "latency objectives need a positive threshold_s")
+
+
+def default_objectives() -> Tuple[SLObjective, ...]:
+    """The env-configured default pair (module docstring)."""
+    window = _env_float("SPARKDL_TPU_SLO_WINDOW_S", DEFAULT_WINDOW_S)
+    return (
+        SLObjective(
+            name="latency", kind="latency",
+            target=_env_float("SPARKDL_TPU_SLO_LATENCY_TARGET",
+                              DEFAULT_LATENCY_TARGET, fraction=True),
+            threshold_s=_env_float("SPARKDL_TPU_SLO_LATENCY_S",
+                                   DEFAULT_LATENCY_THRESHOLD_S),
+            window_s=window),
+        SLObjective(
+            name="availability", kind="availability",
+            target=_env_float("SPARKDL_TPU_SLO_AVAIL_TARGET",
+                              DEFAULT_AVAIL_TARGET, fraction=True),
+            window_s=window),
+    )
+
+
+class SLOTracker:
+    """Rolling-window objective evaluation (module docstring). One
+    process-wide instance (:func:`slo_tracker`); standalone instances
+    exist for tests."""
+
+    # sparkdl-lint H3 contract: outcomes arrive from every dispatcher
+    # and submitter thread at once — ring/counter writes hold
+    # self._lock
+    _lock_guards = ("events_total", "_last_publish")
+
+    def __init__(self,
+                 objectives: Optional[List[SLObjective]] = None):
+        self._objectives: Tuple[SLObjective, ...] = (
+            tuple(objectives) if objectives is not None
+            else default_objectives())
+        self._lock = threading.Lock()
+        # (t, latency_s or None, ok) outcome ring, newest right
+        self._events: collections.deque = collections.deque(
+            maxlen=EVENT_CAPACITY)
+        self.events_total = 0
+        self._last_publish = float("-inf")
+
+    # -- configuration -------------------------------------------------------
+
+    @property
+    def objectives(self) -> Tuple[SLObjective, ...]:
+        return self._objectives
+
+    def set_objectives(self, objectives: List[SLObjective]) -> None:
+        """Replace the objective set (the window of past outcomes is
+        kept — objectives are readout config, not state)."""
+        if not objectives:
+            raise ValueError("at least one objective is required")
+        self._objectives = tuple(objectives)
+
+    # -- the outcome stream --------------------------------------------------
+
+    def record(self, latency_s: Optional[float] = None,
+               ok: bool = True, now: Optional[float] = None) -> None:
+        """One request outcome: ``ok=True`` with its latency for a
+        success; ``ok=False`` (no latency) for a deadline miss, a
+        dispatch failure, a shed/abandoned request — the availability
+        stream, deliberately separate from the latency reservoir's
+        success-only population."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            self._events.append((now, latency_s, ok))
+            self.events_total += 1
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        # amortized: drop outcomes older than the widest window so the
+        # ring never reports on stale traffic (holding self._lock)
+        horizon = now - max(o.window_s for o in self._objectives)
+        ev = self._events
+        while ev and ev[0][0] < horizon:
+            ev.popleft()
+
+    # -- readout -------------------------------------------------------------
+
+    def status(self, now: Optional[float] = None) -> dict:
+        """Per-objective verdicts (``/statusz``, flight bundles)."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            events = list(self._events)
+            total_seen = self.events_total
+        out = {"events_total": total_seen, "objectives": {}}
+        for obj in self._objectives:
+            horizon = now - obj.window_s
+            window = [(t, lat, ok) for t, lat, ok in events
+                      if t >= horizon]
+            total = len(window)
+            if obj.kind == "latency":
+                bad = sum(1 for _t, lat, ok in window
+                          if not ok or lat is None
+                          or lat > obj.threshold_s)
+            else:
+                bad = sum(1 for _t, _lat, ok in window if not ok)
+            budget = 1.0 - obj.target
+            bad_fraction = (bad / total) if total else 0.0
+            burn = bad_fraction / budget if budget else 0.0
+            remaining = max(min(1.0 - burn, 1.0), -1.0)
+            entry = {
+                "kind": obj.kind,
+                "target": obj.target,
+                "window_s": obj.window_s,
+                "events": total,
+                "bad": bad,
+                "burn_rate": round(burn, 4),
+                "budget_remaining": round(remaining, 4),
+                "healthy": burn <= 1.0,
+            }
+            if obj.threshold_s is not None:
+                entry["threshold_s"] = obj.threshold_s
+            out["objectives"][obj.name] = entry
+        return out
+
+    def publish(self, registry) -> None:
+        """Set each objective's verdict as ``slo.<name>.*`` gauges —
+        idempotent (the ServeMetrics.publish precedent); rendered to
+        Prometheus these are THE ``sparkdl_slo_*`` series the
+        acceptance gate scrapes. Objective names are a small fixed
+        config set — never per-request values (rule H6)."""
+        st = self.status()
+        for name, entry in st["objectives"].items():
+            registry.gauge(f"slo.{name}.burn_rate").set(
+                entry["burn_rate"])
+            registry.gauge(f"slo.{name}.budget_remaining").set(
+                entry["budget_remaining"])
+            registry.gauge(f"slo.{name}.events").set(entry["events"])
+            registry.gauge(f"slo.{name}.bad").set(entry["bad"])
+
+    def publish_due(self, registry, force: bool = False) -> bool:
+        """The hot-path publish: :meth:`publish` at most once per
+        :data:`PUBLISH_INTERVAL_S` (``force`` for lifecycle edges —
+        session close must leave current gauges behind). status()
+        scans the whole event window, so a per-micro-batch caller
+        must not pay it per batch. Staleness never reaches a reader:
+        /statusz computes live and the /metricsz handler re-publishes
+        at scrape time (obs/export.py) — the throttle only spares the
+        dispatcher, it cannot make a scrape lie."""
+        now = time.perf_counter()
+        with self._lock:
+            if not force and \
+                    now - self._last_publish < PUBLISH_INTERVAL_S:
+                return False
+            self._last_publish = now
+        self.publish(registry)
+        return True
+
+    def clear(self) -> None:
+        """Drop the outcome window (test isolation)."""
+        with self._lock:
+            self._events.clear()
+            self.events_total = 0
+            self._last_publish = float("-inf")
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        del state["_events"]   # perf_counter instants are per-process
+        del state["_last_publish"]
+        state["events_total"] = 0
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._events = collections.deque(maxlen=EVENT_CAPACITY)
+        self._last_publish = float("-inf")
+
+
+_TRACKER = SLOTracker()
+
+
+def slo_tracker() -> SLOTracker:
+    """THE process-wide SLO tracker the serve layer feeds."""
+    return _TRACKER

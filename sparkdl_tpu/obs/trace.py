@@ -210,7 +210,19 @@ class Tracer:
         Perfetto process group per lane, one track per OS thread,
         complete ("X") events in microseconds since the tracer epoch,
         plus a visible drop-note instant when the ring buffer evicted
-        anything."""
+        anything.
+
+        **Flow events (span links)**: a span recorded with the
+        reserved attrs ``flow_id`` (one id) or ``flow_ids`` (several)
+        plus ``flow_ph`` (``"s"`` start / ``"t"`` step / ``"f"`` end)
+        additionally emits Chrome flow events bound to its slice
+        (same ts/pid/tid; steps and ends bind to the enclosing slice
+        via ``bp: "e"``). The serve layer keys these by request_id, so
+        a request split across N micro-batches renders in Perfetto as
+        ONE connected flow: enqueue → each dispatch → resolution. The
+        reserved attrs are consumed here — they do not appear in the
+        exported slice args (``request_id`` is set separately where a
+        visible arg is wanted)."""
         recs = self.spans()
         dropped = self.dropped
         lanes = sorted({r.lane for r in recs})
@@ -229,13 +241,37 @@ class Tracer:
                 events.append({"name": "thread_name", "ph": "M",
                                "pid": pid, "tid": r.thread_id,
                                "args": {"name": r.thread_name}})
+            args = dict(r.attrs)
+            flow_ph = args.pop("flow_ph", None)
+            flow_ids = args.pop("flow_ids", None)
+            flow_id = args.pop("flow_id", None)
+            ts = round((r.start - self._epoch) * 1e6, 3)
+            dur = round(max(r.end - r.start, 0.0) * 1e6, 3)
             events.append({
                 "name": r.name, "cat": r.lane, "ph": "X",
-                "ts": round((r.start - self._epoch) * 1e6, 3),
-                "dur": round(max(r.end - r.start, 0.0) * 1e6, 3),
+                "ts": ts,
+                "dur": dur,
                 "pid": pid, "tid": r.thread_id,
-                "args": dict(r.attrs),
+                "args": args,
             })
+            if flow_ph in ("s", "t", "f"):
+                ids = (list(flow_ids) if flow_ids
+                       else [flow_id] if flow_id is not None else [])
+                # flow events of one id must be in timestamp order:
+                # starts/steps stamp at their slice's start, the END
+                # stamps at its slice's end — the request span opens
+                # at submit time, so an end at its start would precede
+                # the enqueue start and break the chain
+                fts = ts + dur if flow_ph == "f" else ts
+                for fid in ids:
+                    flow = {"name": "request", "cat": "request_flow",
+                            "ph": flow_ph, "id": str(fid), "ts": fts,
+                            "pid": pid, "tid": r.thread_id}
+                    if flow_ph != "s":
+                        # bind to the enclosing slice, not the next
+                        # one to start (Chrome trace-format contract)
+                        flow["bp"] = "e"
+                    events.append(flow)
         if dropped:
             events.append({
                 "name": f"ring buffer dropped {dropped} oldest spans "

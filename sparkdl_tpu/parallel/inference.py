@@ -31,6 +31,7 @@ from sparkdl_tpu.parallel.mesh import (
     mesh_has_collectives,
 )
 from sparkdl_tpu.runtime.runner import (
+    ChunkPhases,
     CopyCounters,
     PadStaging,
     RunnerMetrics,
@@ -52,6 +53,10 @@ class ShardedBatchRunner:
     ``batch_size`` is the PER-CHIP batch; the global device batch is
     ``batch_size * mesh.shape["data"]``.
     """
+
+    # run() accepts the phases= accumulator (runtime/runner.py
+    # ChunkPhases) — the serve layer probes this attribute
+    supports_phases = True
 
     def __init__(self, model_fn: ModelFunction, mesh: Optional[Mesh] = None,
                  batch_size: int = 64,
@@ -137,9 +142,13 @@ class ShardedBatchRunner:
         :func:`~sparkdl_tpu.runtime.runner.warmup_runner`."""
         return warmup_runner(self)
 
-    def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    def run(self, inputs: Dict[str, np.ndarray],
+            phases: Optional[ChunkPhases] = None
+            ) -> Dict[str, np.ndarray]:
         """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]};
-        N is cut into global batches, the tail padded then truncated."""
+        N is cut into global batches, the tail padded then truncated.
+        ``phases`` (optional) accumulates placement/enqueue/drain
+        timestamps for per-request attribution (runtime/runner.py)."""
         n = check_row_counts(inputs)
         if n == 0:  # before the signature check: empty flat inputs
             return empty_jax_outputs(self.model_fn)
@@ -197,10 +206,14 @@ class ShardedBatchRunner:
                 batches = dispatch_chunks(
                     fn, params, chunks, self.strategy,
                     self.max_inflight, sink, place=place, sharding=dat,
-                    prefetch_depth=self.prefetch_depth)
+                    prefetch_depth=self.prefetch_depth, phases=phases)
         finally:
             if locked:
                 self._staging_lock.release()
+        if phases is not None:
+            # drain half of the phase accounting — one pair of clock
+            # reads shared with transfer_wait_seconds
+            phases.drain_s += sink.transfer_wait
         self.metrics.add(n, batches, time.perf_counter() - t0,
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,

@@ -332,6 +332,25 @@ class PadStaging:
 
 
 @dataclass
+class ChunkPhases:
+    """Per-run phase timestamps on the dispatched chunks, accumulated
+    by :func:`dispatch_chunks` when a caller hands one in (``None`` —
+    the default — costs a single ``is not None`` check per chunk).
+
+    The serve layer's per-request timelines (obs/request_log.py) use
+    this to subdivide a request's ``device`` phase into what the ship
+    state machine actually did with it: host→device placement
+    (``device_put_s``), jitted-call enqueue (``enqueue_s`` — on async
+    backends the enqueue, not compute), and the drain wait
+    (``drain_s``, the same clock reads as ``transfer_wait_seconds``).
+    Plain data, no lock: one accumulator belongs to one run() call."""
+
+    device_put_s: float = 0.0
+    enqueue_s: float = 0.0
+    drain_s: float = 0.0
+
+
+@dataclass
 class CopyCounters:
     """Per-call host-copy accounting, folded into RunnerMetrics.
 
@@ -444,7 +463,8 @@ def checkout_staging(staging: PadStaging, lock: threading.Lock
 
 def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
                     sink: SlabSink, place=None, sharding=None,
-                    prefetch_depth: int = DEFAULT_PREFETCH_DEPTH) -> int:
+                    prefetch_depth: int = DEFAULT_PREFETCH_DEPTH,
+                    phases: Optional[ChunkPhases] = None) -> int:
     """THE dispatch state machine, shared by BatchRunner._run_device
     and ShardedBatchRunner.run (one copy of the trickiest loop in the
     codebase: generator look-ahead, placed-chunk hand-off, the
@@ -460,7 +480,10 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
     one are kept ``device_put`` at once in a shared FIFO, so a link
     whose latency exceeds one chunk's compute still arrives resident —
     at the cost of ``prefetch_depth`` chunk-sized device buffers on top
-    of the ``max_inflight`` result queue."""
+    of the ``max_inflight`` result queue. ``phases`` (optional)
+    accumulates per-chunk placement/enqueue timestamps for the serve
+    layer's request timelines (:class:`ChunkPhases`); the drain half
+    is the sink's ``transfer_wait``, folded in by the caller."""
     host_async = strategy in ("host_async", "prefetch")
     prefetch = strategy == "prefetch"
     lookahead = max(1, int(prefetch_depth))
@@ -502,9 +525,13 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
                 nxt = pull()
                 if nxt is None:
                     break
+                put_t0 = time.perf_counter() if phases is not None \
+                    else 0.0
                 with span("device_put", lane="ship", rows=nxt[0],
                           prefetch=True, ahead=len(ahead) + 1):
                     placed = start_device_prefetch(nxt[1], sharding)
+                if phases is not None:
+                    phases.device_put_s += time.perf_counter() - put_t0
                 if placed is None:
                     # degrade ladder: the chunk already pulled
                     # dispatches un-placed; no further placements this
@@ -522,13 +549,20 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
                 valid, chunk, placed_ok = nxt[0], nxt[1], False
             watchdog_pulse(wd_source)
             if not placed_ok and place is not None:
+                put_t0 = time.perf_counter() if phases is not None \
+                    else 0.0
                 with span("device_put", lane="ship", rows=valid):
                     chunk = place(chunk)
+                if phases is not None:
+                    phases.device_put_s += time.perf_counter() - put_t0
             # NOTE: on async backends this span times the ENQUEUE of
             # the jitted call, not device compute — device-side time is
             # only host-observable at the drain (the device_get span)
+            enq_t0 = time.perf_counter() if phases is not None else 0.0
             with span("dispatch", lane="ship", rows=valid):
                 res = fn(params, chunk)
+            if phases is not None:
+                phases.enqueue_s += time.perf_counter() - enq_t0
             if host_async and not start_host_copies(res):
                 # missing API: the deep uncopied queue would recreate
                 # the stale-buffer collapse — shallow queue instead
@@ -688,6 +722,11 @@ class RunnerMetrics:
 class BatchRunner:
     """Runs a ModelFunction over host arrays in fixed-size device chunks."""
 
+    # run() accepts the phases= accumulator (ChunkPhases) — the serve
+    # layer probes this instead of the signature so prebuilt custom
+    # runners without it keep working
+    supports_phases = True
+
     def __init__(self, model_fn: ModelFunction, batch_size: int = 64,
                  metrics: Optional[RunnerMetrics] = None,
                  strategy: Optional[str] = None,
@@ -745,8 +784,13 @@ class BatchRunner:
         backends. See :func:`warmup_runner`."""
         return warmup_runner(self)
 
-    def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]}."""
+    def run(self, inputs: Dict[str, np.ndarray],
+            phases: Optional[ChunkPhases] = None
+            ) -> Dict[str, np.ndarray]:
+        """inputs: {name: [N, *row_shape]} → {name: [N, *out_shape]}.
+        ``phases`` (optional :class:`ChunkPhases`) accumulates this
+        run's placement/enqueue/drain timestamps for per-request
+        attribution (the serve layer's timelines)."""
         n = check_row_counts(inputs)
         if n == 0:
             # BEFORE the signature check: empty variable-list columns
@@ -766,7 +810,7 @@ class BatchRunner:
             out, wait = self._run_host(inputs, n, batch_size)
         else:
             out, wait = self._run_device(inputs, n, counters,
-                                         batch_size)
+                                         batch_size, phases)
         self.metrics.add(n, -(-n // batch_size),
                          time.perf_counter() - t0,
                          bytes_staged=counters.bytes_staged,
@@ -801,7 +845,8 @@ class BatchRunner:
     # -- device path --------------------------------------------------------
 
     def _run_device(self, inputs, n, counters: CopyCounters,
-                    batch_size: int
+                    batch_size: int,
+                    phases: Optional[ChunkPhases] = None
                     ) -> Tuple[Dict[str, np.ndarray], float]:
         fn = self.model_fn.jitted()
         params = self.model_fn.device_params()
@@ -822,10 +867,16 @@ class BatchRunner:
                       strategy=self.strategy), ship_guard():
                 dispatch_chunks(fn, params, chunks, self.strategy,
                                 self.max_inflight, sink,
-                                prefetch_depth=self.prefetch_depth)
+                                prefetch_depth=self.prefetch_depth,
+                                phases=phases)
         finally:
             if locked:
                 self._staging_lock.release()
+        if phases is not None:
+            # the drain half: the same clock reads as
+            # transfer_wait_seconds (timed_device_get), so the traced
+            # and attributed numbers cannot drift
+            phases.drain_s += sink.transfer_wait
         return sink.result(), sink.transfer_wait
 
     def _empty_outputs(self) -> Dict[str, np.ndarray]:

@@ -63,21 +63,36 @@ class Request:
     ``taken`` (rows already placed into micro-batches) is dispatcher
     state, mutated only under the queue condition; result reassembly
     (:meth:`write`) runs only on the single dispatcher thread, so the
-    output slabs need no lock."""
+    output slabs need no lock.
+
+    ``timeline`` (armed runs only — obs/request_log.py) carries the
+    request's minted id and phase marks; the collector stamps the end
+    of the queue phase when it first takes rows, everything else is
+    the dispatcher's. ``None`` disarmed — the no-op regime."""
 
     __slots__ = ("inputs", "n", "deadline", "submitted", "future",
-                 "taken", "_slabs", "_done_rows")
+                 "taken", "timeline", "_slabs", "_done_rows")
 
     def __init__(self, inputs: Dict[str, np.ndarray], n: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], timeline=None):
         self.inputs = inputs
         self.n = n
         self.deadline = deadline          # absolute perf_counter instant
-        self.submitted = time.perf_counter()
+        # ONE clock read with the timeline when present: the latency
+        # the reservoir observes and the timeline's phase sum must be
+        # the same number, not two reads apart
+        self.submitted = (timeline.submitted if timeline is not None
+                          else time.perf_counter())
         self.future: Future = Future()
         self.taken = 0
+        self.timeline = timeline
         self._slabs: Optional[Dict[str, np.ndarray]] = None
         self._done_rows = 0
+
+    @property
+    def rid(self) -> Optional[str]:
+        """The minted request_id (armed runs), for span args/flows."""
+        return self.timeline.rid if self.timeline is not None else None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -216,6 +231,11 @@ class RequestQueue:
                             continue
                         take = min(chunk_rows - valid,
                                    req.n - req.taken)
+                        if req.taken == 0 and req.timeline is not None:
+                            # the queue phase ends at the FIRST take
+                            # (split requests are taken again later —
+                            # that wait is coalesce, not queue)
+                            req.timeline.mark_taken(now)
                         parts.append((req, req.taken, take))
                         req.taken += take
                         self.rows -= take

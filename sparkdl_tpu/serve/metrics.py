@@ -33,7 +33,7 @@ class ServeMetrics:
     # concurrently — every counter write holds self._lock
     _lock_guards = ("requests", "rows", "batches", "batch_rows",
                     "batch_capacity_rows", "rejections",
-                    "deadline_misses")
+                    "deadline_misses", "failures")
 
     def __init__(self):
         self.requests = 0
@@ -43,6 +43,13 @@ class ServeMetrics:
         self.batch_capacity_rows = 0
         self.rejections = 0
         self.deadline_misses = 0
+        # dispatch-time failures (the model/runner raised): a separate
+        # stream from deadline_misses, and — with them — the
+        # availability population the SLO tracker judges. NEITHER ever
+        # lands in the latency reservoir: percentiles are computed
+        # over successful requests only, availability over the rest
+        # (pinned by tests/test_request_obs.py).
+        self.failures = 0
         self._latency = Reservoir("serve.latency_seconds")
         self._lock = threading.Lock()
 
@@ -61,14 +68,26 @@ class ServeMetrics:
         with self._lock:
             self.deadline_misses += 1
 
+    def add_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+
     def add_batch(self, valid_rows: int, capacity_rows: int) -> None:
         with self._lock:
             self.batches += 1
             self.batch_rows += valid_rows
             self.batch_capacity_rows += capacity_rows
 
-    def observe_latency(self, seconds: float) -> None:
-        self._latency.observe(seconds)
+    def observe_latency(self, seconds: float, exemplar=None) -> None:
+        """One SUCCESSFUL request's latency; ``exemplar`` (armed runs)
+        is the request_id + phase breakdown retained for the window's
+        worst cases (Reservoir exemplars, obs/registry.py) so a
+        scraped p99 resolves to an actual request."""
+        self._latency.observe(seconds, exemplar=exemplar)
+
+    def latency_exemplars(self) -> list:
+        """The retained worst-case latency exemplars (largest first)."""
+        return self._latency.exemplars()
 
     # -- readout -------------------------------------------------------------
 
@@ -92,11 +111,14 @@ class ServeMetrics:
             vals = {"requests": self.requests, "rows": self.rows,
                     "batches": self.batches,
                     "rejections": self.rejections,
-                    "deadline_misses": self.deadline_misses}
+                    "deadline_misses": self.deadline_misses,
+                    "failures": self.failures}
         vals["batch_fill_ratio"] = round(self.batch_fill_ratio, 4)
         p50, p99 = self._latency.quantiles((0.5, 0.99))
         vals["latency_p50_ms"] = round(p50 * 1e3, 3)
         vals["latency_p99_ms"] = round(p99 * 1e3, 3)
+        vals["latency_exemplars_dropped"] = \
+            self._latency.exemplars_dropped
         return vals
 
     def publish(self, registry) -> None:
@@ -110,11 +132,14 @@ class ServeMetrics:
                     "serve.rows": self.rows,
                     "serve.batches": self.batches,
                     "serve.rejections": self.rejections,
-                    "serve.deadline_misses": self.deadline_misses}
+                    "serve.deadline_misses": self.deadline_misses,
+                    "serve.failures": self.failures}
         vals["serve.batch_fill_ratio"] = self.batch_fill_ratio
         p50, p99 = self._latency.quantiles((0.5, 0.99))
         vals["serve.latency_p50_ms"] = p50 * 1e3
         vals["serve.latency_p99_ms"] = p99 * 1e3
+        vals["serve.latency_exemplars_dropped"] = \
+            self._latency.exemplars_dropped
         for name, value in vals.items():
             registry.gauge(name).set(value)
 

@@ -35,7 +35,14 @@ Observability rides the ``serve`` obs lane (``enqueue`` / ``coalesce``
 / ``dispatch`` / ``warmup`` spans) plus ``serve.*`` registry metrics
 (docs/OBSERVABILITY.md): live queue depth gauges set on the hot path,
 cumulative counters published from :class:`ServeMetrics` after every
-dispatch/rejection.
+dispatch/rejection. Armed (SPARKDL_TPU_TRACE / SPARKDL_TPU_REQUEST_LOG
+— obs/request_log.py), every submit additionally mints a request_id
+and records a per-request phase timeline (queue → coalesce → staging →
+device → reassembly) whose worst cases become latency-reservoir
+exemplars; request outcomes always feed the SLO tracker's separate
+availability stream (obs/slo.py) — successes carry their latency,
+deadline misses / dispatch failures / rejections / abandons count
+against availability and NEVER pollute the latency percentiles.
 """
 
 from __future__ import annotations
@@ -51,11 +58,14 @@ import numpy as np
 from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.obs import default_registry, span
 from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs.request_log import request_log
+from sparkdl_tpu.obs.slo import slo_tracker
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.parallel.inference import ShardedBatchRunner
 from sparkdl_tpu.parallel.mesh import mesh_has_collectives
 from sparkdl_tpu.runtime.runner import (
     BatchRunner,
+    ChunkPhases,
     PadStaging,
     check_against_signature,
     check_row_counts,
@@ -154,8 +164,23 @@ class ModelSession:
                     f"model {mf.name!r} inputs {missing} missing "
                     f"from request inputs {sorted(raw)}")
             fut: Future = Future()
-            fut.set_result(self.runner.run(raw))
+            t0 = time.perf_counter()
+            try:
+                out = self.runner.run(raw)
+            except Exception:
+                # the inline fast path is still a request outcome: a
+                # broken runner hammered with empty probes must show
+                # up as failures + availability burn, not zero-metric
+                # silence ("outcomes always feed the SLO tracker")
+                self.metrics.add_request(0)
+                self.metrics.add_failure()
+                slo_tracker().record(ok=False)
+                self.metrics.publish(default_registry())
+                raise
+            fut.set_result(out)
             self.metrics.add_request(0)
+            slo_tracker().record(
+                latency_s=time.perf_counter() - t0, ok=True)
             self.metrics.publish(default_registry())
             return fut
         check_against_signature(raw, mf)
@@ -166,15 +191,32 @@ class ModelSession:
         cast = {k: np.asarray(raw[k], np.dtype(dtype))
                 for k, (_shape, dtype) in sig.items()}
 
+        # per-request observability (obs/request_log.py): armed runs
+        # mint a request_id + phase timeline HERE — admission is where
+        # the request's story starts, rejections included. Disarmed
+        # this is one armed-check returning None (the shared no-op
+        # regime, overhead-pinned in tests/test_request_obs.py).
+        rlog = request_log()
+        tl = rlog.timeline(self.name, n, time.perf_counter())
+
         if deadline is None:
             deadline = self.config.default_deadline_s
         abs_deadline = None
         if deadline is not None:
             if deadline <= 0:
                 # deadline-aware admission: a request that is already
-                # dead is failed up front, not queued
+                # dead is failed up front, not queued — an
+                # AVAILABILITY event (obs/slo.py), never a latency
+                # sample
                 self.metrics.add_request(n)
                 self.metrics.add_deadline_miss()
+                slo_tracker().record(ok=False)
+                if tl is not None:
+                    # flow=False: no enqueue span ever opened this
+                    # request's flow — an end with no start dangles
+                    rlog.record(tl.finish(time.perf_counter(),
+                                          "deadline_exceeded"),
+                                submitted=tl.submitted, flow=False)
                 fut = Future()
                 fut.set_exception(DeadlineExceeded(
                     f"deadline {deadline}s is not in the future"))
@@ -185,18 +227,35 @@ class ModelSession:
         reg = default_registry()
         if n > self.config.max_queue_rows:
             self.metrics.add_rejection()
+            slo_tracker().record(ok=False)
+            if tl is not None:
+                # flow=False: rejected before the enqueue span — no
+                # flow start exists to end
+                rlog.record(tl.finish(time.perf_counter(), "rejected"),
+                            submitted=tl.submitted, flow=False)
             self.metrics.publish(reg)
             raise ServerOverloaded(
                 f"request of {n} rows can never be admitted: "
                 f"max_queue_rows={self.config.max_queue_rows}")
-        req = Request(cast, n, abs_deadline)
+        req = Request(cast, n, abs_deadline, timeline=tl)
+        enq_attrs = {"rows": n, "model": self.name}
+        if tl is not None:
+            # visible arg + the Perfetto flow START: the dispatch
+            # span(s) carrying this request step the flow, the request
+            # span ends it — a split request renders as one connected
+            # flow (obs/trace.py trace_events)
+            enq_attrs.update(request_id=tl.rid, flow_id=tl.rid,
+                             flow_ph="s")
         try:
-            with span("enqueue", lane="serve", rows=n,
-                      model=self.name):
+            with span("enqueue", lane="serve", **enq_attrs):
                 depth = self._queue.offer(req,
                                           self.config.max_queue_rows)
         except ServerOverloaded:
             self.metrics.add_rejection()
+            slo_tracker().record(ok=False)
+            if tl is not None:
+                rlog.record(tl.finish(time.perf_counter(), "rejected"),
+                            submitted=tl.submitted)
             self.metrics.publish(reg)
             raise
         # AFTER a successful admission: a submit that can only be
@@ -249,11 +308,16 @@ class ModelSession:
                 return          # closed and drained
             with watchdog_watch(wd_source):
                 for req in batch.expired:
-                    # failed BEFORE dispatch: no device time for the dead
+                    # failed BEFORE dispatch: no device time for the
+                    # dead — and an AVAILABILITY event, never a
+                    # latency sample (the SLO populations stay
+                    # separate, pinned by test)
                     if req.fail(DeadlineExceeded(
                             f"deadline passed after {time.perf_counter() - req.submitted:.3f}s queued "
                             f"(model {self.name!r})")):
                         self.metrics.add_deadline_miss()
+                        slo_tracker().record(ok=False)
+                        self._record_outcome(req, "deadline_exceeded")
                 reg.gauge("serve.queue_rows").set(self._queue.depth())
                 if batch.parts:
                     try:
@@ -270,31 +334,99 @@ class ModelSession:
                         flight.record_failure(
                             e, where=f"serve.dispatch:{self.name}")
                         for req, _lo, _rows in batch.parts:
-                            req.fail(e)
+                            if req.fail(e):
+                                self.metrics.add_failure()
+                                slo_tracker().record(ok=False)
+                                self._record_outcome(req, "failed")
                 self.metrics.publish(reg)
+                # error budgets ride the serve-gauge cadence, rate-
+                # limited: status() scans the whole outcome window,
+                # which a per-micro-batch loop must not pay per batch
+                # (readers never see the throttle — /statusz computes
+                # live, /metricsz re-publishes at scrape time)
+                slo_tracker().publish_due(reg)
             # autotune apply point, OUTSIDE the watchdog activity
             # window: a controller step must never eat this source's
             # heartbeat budget (disarmed: one armed-check — the
             # shared-no-op regime)
             autotune_poll()
 
+    def _record_outcome(self, req: Request, status: str) -> None:
+        """Close out a failed/expired/abandoned request's timeline
+        into the request log (no-op for disarmed requests)."""
+        tl = req.timeline
+        if tl is not None:
+            request_log().record(
+                tl.finish(time.perf_counter(), status),
+                submitted=tl.submitted)
+
     def _dispatch(self, batch: MicroBatch) -> None:
         valid = batch.valid
+        # per-request phase marks (armed requests only): staging is
+        # the assemble below, device is the runner call — both accrue
+        # to every request the micro-batch carries (that IS each
+        # request's experience of its shared batch); anything between
+        # marks lands in the coalesce remainder, so the breakdown
+        # always sums to the end-to-end latency
+        track = any(req.timeline is not None
+                    for req, _lo, _rows in batch.parts)
+        t0 = time.perf_counter() if track else 0.0
         inputs = self._assemble(batch.parts, valid)
+        t1 = time.perf_counter() if track else 0.0
         fill = valid / self.chunk
-        with span("dispatch", lane="serve", rows=valid,
-                  requests=len(batch.parts), fill=round(fill, 3),
-                  model=self.name):
-            out = self.runner.run(inputs)
+        attrs = {"rows": valid, "requests": len(batch.parts),
+                 "fill": round(fill, 3), "model": self.name}
+        phases = None
+        if track:
+            rids = [req.rid for req, _lo, _rows in batch.parts
+                    if req.timeline is not None]
+            # the flow STEP: every request in this batch links its
+            # enqueue span to this dispatch slice (split requests get
+            # one step per micro-batch — one connected flow)
+            attrs.update(request_ids=rids, flow_ids=rids, flow_ph="t")
+            if getattr(self.runner, "supports_phases", False):
+                phases = ChunkPhases()
+        t2 = time.perf_counter() if track else 0.0
+        with span("dispatch", lane="serve", **attrs):
+            if phases is not None:
+                out = self.runner.run(inputs, phases=phases)
+            else:
+                out = self.runner.run(inputs)
+        t3 = time.perf_counter() if track else 0.0
+        if track:
+            for req, _lo, _rows in batch.parts:
+                if req.timeline is not None:
+                    req.timeline.add_batch(t1 - t0, t3 - t2,
+                                           detail=phases)
         batch_lo = 0
         completed: List[Request] = []
         for req, req_lo, rows in batch.parts:
+            w0 = time.perf_counter() if req.timeline is not None \
+                else 0.0
             if req.write(out, batch_lo, req_lo, rows):
                 completed.append(req)
+            if req.timeline is not None:
+                req.timeline.add_reassembly(time.perf_counter() - w0)
             batch_lo += rows
         done_t = time.perf_counter()
+        slo = slo_tracker()
+        rlog = request_log()
         for req in completed:
-            self.metrics.observe_latency(done_t - req.submitted)
+            lat = done_t - req.submitted
+            tl = req.timeline
+            if tl is not None:
+                rec = tl.finish(done_t, "ok")
+                # the worst-case exemplar: request_id + phase
+                # breakdown, retained bounded in the reservoir so the
+                # scraped p99 resolves to an actual request/trace
+                self.metrics.observe_latency(
+                    lat, exemplar=tl.exemplar(rec))
+                rlog.record(rec, submitted=tl.submitted)
+            else:
+                self.metrics.observe_latency(lat)
+            # the latency population: successes only; failures live in
+            # the availability stream (obs/slo.py)
+            slo.record(latency_s=lat, ok=True)
         self.metrics.add_batch(valid, self.chunk)
 
     def _assemble(self, parts, valid: int) -> Dict[str, np.ndarray]:
@@ -326,9 +458,13 @@ class ModelSession:
         silent swallow."""
         abandoned = self._queue.close(drain)
         for req in abandoned:
-            req.fail(ServerClosed(
-                f"server closed before this request was dispatched "
-                f"(model {self.name!r})"))
+            if req.fail(ServerClosed(
+                    f"server closed before this request was dispatched "
+                    f"(model {self.name!r})")):
+                # an accepted-then-abandoned request is an availability
+                # event too — the caller was promised an answer
+                slo_tracker().record(ok=False)
+                self._record_outcome(req, "closed")
         worker = self._worker
         if worker is not None and worker.is_alive():
             worker.join(self.config.drain_timeout_s)
@@ -342,6 +478,7 @@ class ModelSession:
         # all under drain=False) must land in the registry — the last
         # partial window is part of the record, not a rounding error
         self.metrics.publish(default_registry())
+        slo_tracker().publish_due(default_registry(), force=True)
 
     # -- pickle discipline (StageMetrics precedent) --------------------------
 
@@ -504,6 +641,11 @@ class ModelServer:
                     },
                 } for name, s in sessions.items()},
             "metrics": self.metrics.as_dict(),
+            # the scraped p99's worst-case specimens: request_id +
+            # phase breakdown, bounded retention (obs/registry.py
+            # Reservoir exemplars) — how a number on a dashboard
+            # resolves to an actual slow request
+            "latency_exemplars": self.metrics.latency_exemplars(),
         }
 
     def serve_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
@@ -540,6 +682,7 @@ class ModelServer:
         # the final-window publish (each session also published on its
         # own close; this covers the zero-session server, idempotently)
         self.metrics.publish(default_registry())
+        slo_tracker().publish_due(default_registry(), force=True)
         if telemetry is not None:
             telemetry.close()
 

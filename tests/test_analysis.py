@@ -420,6 +420,87 @@ class TestH5Clock:
 
 
 # ---------------------------------------------------------------------------
+# H6 — metric-name cardinality (request ids must never become keys)
+
+
+class TestH6Cardinality:
+    """A registry metric name interpolating a request id grows one
+    eternal registry entry + Prometheus series per request — flagged
+    anywhere; bounded dynamic names (configured knobs) and constant
+    names are not."""
+
+    def test_fstring_request_id_name_trips(self):
+        hits = _hits("def publish(reg, request_id):\n"
+                     "    reg.counter(\n"
+                     "        f'serve.req.{request_id}.rows').add()\n",
+                     "H6")
+        assert len(hits) == 1
+        assert "cardinality" in hits[0].message
+        assert hits[0].qualname == "publish"
+
+    def test_concat_and_attribute_forms_trip(self):
+        src = ("def publish(reg, req):\n"
+               "    reg.gauge('serve.' + req.rid).set(1)\n"
+               "    reg.reservoir('lat.' + req.request_id)\n")
+        hits = _hits(src, "H6")
+        assert len(hits) == 2
+
+    def test_format_call_trips(self):
+        hits = _hits("def publish(reg, rid):\n"
+                     "    reg.gauge('serve.{}.depth'.format(rid))\n",
+                     "H6")
+        assert len(hits) == 1
+
+    def test_keyword_name_form_trips(self):
+        # the name= kwarg spelling is just as legal a call form — it
+        # must not be a loophole
+        hits = _hits("def publish(reg, request_id):\n"
+                     "    reg.counter(\n"
+                     "        name=f'req.{request_id}.rows').add()\n",
+                     "H6")
+        assert len(hits) == 1
+
+    def test_constant_and_bounded_dynamic_names_are_clean(self):
+        # constant names, and dynamic names over bounded key sets (the
+        # autotune knob-gauge idiom) must NOT trip — the rule is about
+        # request-shaped identifiers, not dynamism per se
+        src = ("def publish(reg, target, knob):\n"
+               "    reg.counter('obs.request_log.dropped').add()\n"
+               "    reg.gauge(f'autotune.knob.{target}.{knob}')\n")
+        assert _hits(src, "H6") == []
+
+    def test_request_id_outside_metric_name_is_clean(self):
+        # ids in exemplars / span args / log records are exactly where
+        # they belong — only metric NAMES are the hazard
+        src = ("def observe(res, rid, lat):\n"
+               "    res.observe(lat, exemplar={'request_id': rid})\n")
+        assert _hits(src, "H6") == []
+
+    def test_suppressed_with_justification(self):
+        """The worked inline-suppression fixture: a variable that only
+        SOUNDS request-shaped but draws from a bounded set suppresses
+        with the reason the key set is bounded."""
+        src = ("def count_findings(reg, rid):\n"
+               "    # rid here is a LINT RULE id (H1..H6), six values\n"
+               "    reg.counter(f'lint.{rid}.findings').add()"
+               "  # sparkdl-lint: allow[H6] -- rid is a lint rule id "
+               "(H1..H6, a bounded set), not a request id\n")
+        assert _hits(src, "H6") == []
+        sup = _suppressed(src, "H6")
+        assert len(sup) == 1
+        assert "bounded set" in sup[0].suppression
+
+    def test_meta_obs_and_serve_are_h6_clean(self):
+        """The layers that actually handle request ids ship H6-clean:
+        ids flow through the RequestLog/exemplars/span args, never
+        into registry keys."""
+        found = analyze_paths([os.path.join(PKG_DIR, "obs"),
+                               os.path.join(PKG_DIR, "serve")])
+        h6 = [f for f in found if f.rule == "H6" and not f.suppressed]
+        assert h6 == [], format_findings(h6)
+
+
+# ---------------------------------------------------------------------------
 # walker / CLI / formatter
 
 

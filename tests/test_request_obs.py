@@ -1,0 +1,755 @@
+"""Per-request tracing, tail attribution, and SLO tracking
+(docs/OBSERVABILITY.md "Per-request timelines" / "SLO tracking",
+docs/SERVING.md runbook).
+
+The contract under test:
+
+* disarmed, the per-request path stays in the tracer's shared no-op
+  regime (<10µs/submit-hook, alongside the span bound);
+* armed, every submit mints a unique request_id; a concurrent
+  saturation soak (with split requests) yields records and exemplars
+  whose phase durations SUM to the end-to-end latency within clock
+  tolerance, and every exemplar's request_id resolves to spans (and a
+  connected flow) in the exported trace;
+* ``report --tails`` attributes ≥95% of the measured p99 across the
+  named phases, and ignores event types it has never seen
+  (forward-compat);
+* the RequestLog ring and exemplar retention are hard-bounded with
+  drop counters;
+* failed/expired requests land in the SLO availability stream and
+  NEVER in the latency reservoir — each population is correct;
+* pickle follows the StageMetrics drop-and-recreate discipline.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry, request_log, tracer
+from sparkdl_tpu.obs.registry import EXEMPLAR_CAPACITY, Reservoir
+from sparkdl_tpu.obs.report import (
+    main as report_main,
+    summarize,
+    summarize_tails,
+    tails_summary,
+)
+from sparkdl_tpu.obs.request_log import PHASES, RequestLog
+from sparkdl_tpu.obs.slo import SLObjective, SLOTracker, slo_tracker
+from sparkdl_tpu.serve import (
+    DeadlineExceeded,
+    ModelServer,
+    ServeConfig,
+)
+
+
+def _double_fn():
+    return ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                    input_shape=(3,))
+
+
+def _slow_host_fn(delay_s):
+    def apply(params, inputs):
+        time.sleep(delay_s)
+        return {"y": np.asarray(inputs["x"], np.float32) + 1.0}
+    return ModelFunction(apply, None, {"x": ((3,), np.float32)},
+                         output_names=["y"], backend="host")
+
+
+@pytest.fixture()
+def armed(monkeypatch):
+    """Tracer + request log armed via the env (as production would),
+    everything cleared before/after so tests don't see each other."""
+    monkeypatch.setenv("SPARKDL_TPU_TRACE", "1")
+    t = tracer()
+    t.clear()
+    rlog = request_log()
+    rlog.clear()
+    slo_tracker().clear()
+    yield t, rlog
+    t.clear()
+    rlog.clear()
+    slo_tracker().clear()
+
+
+# ---------------------------------------------------------------------------
+# the disarmed no-op regime
+
+
+class TestDisarmedRegime:
+    def test_disarmed_timeline_is_none_and_cheap(self, monkeypatch):
+        """The per-request submit hook disarmed: one armed-check
+        returning None — pinned <10µs alongside the tracer's span
+        bound (min over repeats; noise only adds time)."""
+        monkeypatch.delenv("SPARKDL_TPU_TRACE", raising=False)
+        monkeypatch.delenv("SPARKDL_TPU_REQUEST_LOG", raising=False)
+        rlog = RequestLog(capacity=16)
+        assert rlog.timeline("m", 4, time.perf_counter()) is None
+        n = 20_000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                rlog.timeline("m", 4, 0.0)
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 10e-6, f"disarmed timeline costs {best * 1e6:.2f} µs"
+
+    def test_disarmed_submit_records_nothing(self, monkeypatch):
+        monkeypatch.delenv("SPARKDL_TPU_TRACE", raising=False)
+        monkeypatch.delenv("SPARKDL_TPU_REQUEST_LOG", raising=False)
+        rlog = request_log()
+        rlog.clear()
+        before = rlog.appended
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as server:
+            server.register("m", _double_fn(), batch_size=4)
+            x = np.zeros((4, 3), np.float32)
+            server.submit({"input": x}).result(timeout=30)
+        assert rlog.appended == before
+        assert rlog.records() == []
+
+    def test_request_log_arms_alone_and_with_tracer(self, monkeypatch):
+        monkeypatch.delenv("SPARKDL_TPU_TRACE", raising=False)
+        monkeypatch.delenv("SPARKDL_TPU_REQUEST_LOG", raising=False)
+        rlog = RequestLog(capacity=4)
+        assert not rlog.armed
+        monkeypatch.setenv("SPARKDL_TPU_REQUEST_LOG", "1")
+        assert rlog.armed                  # its own env switch
+        monkeypatch.delenv("SPARKDL_TPU_REQUEST_LOG")
+        monkeypatch.setenv("SPARKDL_TPU_TRACE", "1")
+        assert rlog.armed                  # follows the armed tracer
+        monkeypatch.delenv("SPARKDL_TPU_TRACE")
+        rlog.arm()
+        assert rlog.armed                  # override wins
+        rlog.disarm()
+        monkeypatch.setenv("SPARKDL_TPU_REQUEST_LOG", "1")
+        assert not rlog.armed              # pinned off beats the env
+
+
+# ---------------------------------------------------------------------------
+# the armed soak: exemplar fidelity + trace resolution
+
+
+class TestArmedSoak:
+    def test_saturation_soak_exemplars_sum_and_resolve(self, armed,
+                                                       tmp_path):
+        """Concurrent saturation soak with split requests: every
+        record's (and exemplar's) phase durations sum to its
+        end-to-end latency within clock tolerance, request ids are
+        unique, and every exemplar's request_id resolves to spans +
+        one connected flow in the exported trace."""
+        t, rlog = armed
+        server = ModelServer(ServeConfig(max_wait_s=0.005,
+                                         max_queue_rows=4096))
+        server.register("m", _double_fn(), batch_size=8)
+        server.warmup()
+
+        futures, lock = [], threading.Lock()
+
+        def fire(tid):
+            rng = np.random.default_rng(tid)
+            for i in range(8):
+                # mixed shapes: sub-batch (coalesce path) and
+                # oversized (split-and-reassemble path)
+                rows = 20 if (tid + i) % 4 == 0 else 3
+                x = rng.normal(size=(rows, 3)).astype(np.float32)
+                f = server.submit({"input": x})
+                with lock:
+                    futures.append((f, x))
+
+        workers = [threading.Thread(target=fire, args=(k,))
+                   for k in range(4)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        for f, x in futures:
+            np.testing.assert_allclose(
+                f.result(timeout=30)["output"], x * 2, rtol=1e-6)
+        server.close()
+
+        recs = rlog.records()
+        assert len(recs) == 32
+        rids = [r.request_id for r in recs]
+        assert len(set(rids)) == len(rids)          # unique ids
+        assert any(r.batches > 1 for r in recs)     # splits happened
+        for r in recs:
+            assert r.status == "ok"
+            assert set(r.phases) == set(PHASES)
+            attributed = sum(r.phases.values())
+            # phase sums match end-to-end within clock tolerance: the
+            # coalesce remainder construction makes this exact up to
+            # float rounding
+            assert attributed == pytest.approx(r.total_s, abs=1e-6)
+
+        exemplars = server.metrics.latency_exemplars()
+        assert exemplars, "saturated soak must retain exemplars"
+        by_rid = {r.request_id: r for r in recs}
+        for ex in exemplars:
+            assert set(ex["phases"]) == set(PHASES)
+            assert sum(ex["phases"].values()) == pytest.approx(
+                ex["value"], abs=1e-6)
+            assert ex["request_id"] in by_rid
+
+        # every exemplar resolves into the exported trace: a request
+        # span carrying the id, and a connected flow (start on the
+        # enqueue span, ≥1 step on dispatch slices, an end)
+        path = tmp_path / "trace.json"
+        t.export(str(path))
+        events = json.loads(path.read_text())
+        req_spans = {e["args"]["request_id"]: e for e in events
+                     if e.get("ph") == "X"
+                     and e.get("name") == "request"}
+        flows = [e for e in events if e.get("cat") == "request_flow"]
+        for ex in exemplars:
+            rid = ex["request_id"]
+            assert rid in req_spans
+            span_phases = req_spans[rid]["args"]["phases_s"]
+            assert set(span_phases) == set(PHASES)
+            kinds = {e["ph"] for e in flows if e["id"] == rid}
+            assert kinds == {"s", "t", "f"}, (rid, kinds)
+        # a split request's flow steps through EVERY micro-batch
+        split = next(r for r in recs if r.batches > 1)
+        steps = [e for e in flows
+                 if e["id"] == split.request_id and e["ph"] == "t"]
+        assert len(steps) == split.batches
+
+    def test_flow_attrs_consumed_not_leaked(self, armed, tmp_path):
+        """The reserved flow_* attrs drive flow-event emission and
+        must NOT appear in the exported slice args (request_id, a
+        visible arg, stays)."""
+        t, rlog = armed
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as server:
+            server.register("m", _double_fn(), batch_size=4)
+            x = np.zeros((4, 3), np.float32)
+            server.submit({"input": x}).result(timeout=30)
+        events = t.trace_events()
+        for e in events:
+            args = e.get("args") or {}
+            assert "flow_id" not in args and "flow_ph" not in args \
+                and "flow_ids" not in args, e
+        enq = next(e for e in events
+                   if e.get("ph") == "X" and e.get("name") == "enqueue")
+        assert enq["args"]["request_id"].startswith("r")
+
+    def test_no_dangling_flow_end_for_never_enqueued_requests(
+            self, armed):
+        """Dead-at-submit / precheck-rejected requests never opened
+        the enqueue span (the flow's 's' start): their records must
+        not emit a flow END — every 'f' in an export needs a matching
+        's' or Perfetto renders dangling arrows."""
+        from sparkdl_tpu.serve import ServerOverloaded
+
+        t, rlog = armed
+        server = ModelServer(ServeConfig(max_wait_s=0.0,
+                                         max_queue_rows=8))
+        server.register("m", _double_fn(), batch_size=4)
+        with pytest.raises(DeadlineExceeded):
+            server.submit({"input": np.zeros((2, 3), np.float32)},
+                          deadline=-1.0).result(timeout=1)
+        with pytest.raises(ServerOverloaded):
+            server.submit({"input": np.zeros((64, 3), np.float32)})
+        server.close()
+        assert len(rlog.records()) == 2     # both outcomes recorded
+        events = t.trace_events()
+        ends = {e["id"] for e in events
+                if e.get("cat") == "request_flow" and e["ph"] == "f"}
+        starts = {e["id"] for e in events
+                  if e.get("cat") == "request_flow" and e["ph"] == "s"}
+        assert ends <= starts, (ends, starts)
+
+    def test_device_phase_detail_from_chunk_phases(self, armed):
+        """jax-backed sessions subdivide the device phase through the
+        runner's ChunkPhases accumulator (runtime/runner.py): the
+        record carries placement/enqueue/drain detail whose parts
+        don't exceed the device phase they subdivide."""
+        _t, rlog = armed
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as server:
+            server.register("m", _double_fn(), batch_size=4)
+            x = np.arange(12, dtype=np.float32).reshape(4, 3)
+            server.submit({"input": x}).result(timeout=30)
+        (rec,) = rlog.records()
+        assert rec.device_detail is not None
+        assert rec.device_detail["enqueue_s"] >= 0.0
+        assert rec.device_detail["drain_s"] >= 0.0
+        detail_sum = sum(rec.device_detail.values())
+        assert detail_sum <= rec.phases["device"] + 1e-3
+
+
+# ---------------------------------------------------------------------------
+# report --tails
+
+
+class TestReportTails:
+    def _request_event(self, rid, dur_us, phases_us, status="ok",
+                       batches=1):
+        return {"name": "request", "cat": "request", "ph": "X",
+                "ts": 0.0, "dur": dur_us, "pid": 9, "tid": 1,
+                "args": {"request_id": rid, "status": status,
+                         "rows": 4, "batches": batches,
+                         "phases_s": {k: v / 1e6
+                                      for k, v in phases_us.items()}}}
+
+    def test_tails_summary_attributes_p99(self):
+        events = [self._request_event(
+            f"r-{i}", 1000.0 + i,
+            {"queue": 300.0, "coalesce": 400.0 + i, "staging": 50.0,
+             "device": 200.0, "reassembly": 50.0})
+            for i in range(10)]
+        s = tails_summary(events)
+        assert s["requests"] == 10
+        assert s["p99_request_id"] == "r-9"
+        assert s["attributed_pct"] == pytest.approx(100.0, abs=0.5)
+        assert s["attributed_pct"] >= 95.0
+        text = summarize_tails(events)
+        assert "p99 attribution" in text and "coalesce" in text
+
+    def test_failed_requests_excluded_from_latency_population(self):
+        events = [self._request_event("ok-1", 1000.0,
+                                      {"queue": 1000.0})]
+        dead = self._request_event(
+            "dead-1", 9_000_000.0, {"queue": 9_000_000.0},
+            status="deadline_exceeded")
+        events.append(dead)
+        s = tails_summary(events)
+        assert s["requests"] == 1
+        assert s["failed_excluded"] == 1
+        assert s["p99_request_id"] == "ok-1"
+        # an all-failures trace has NO latency population: the summary
+        # must say so, not quietly compute percentiles from the
+        # excluded population
+        s = tails_summary([dead])
+        assert s["requests"] == 0 and s["failed_excluded"] == 1
+        assert s["p99_ms"] is None and s["p99_request_id"] is None
+        assert "no successes" in summarize_tails([dead])
+
+    def test_report_ignores_unknown_event_types(self):
+        """Forward-compat: flow events (s/t/f), counter events, and
+        ph values this report has never heard of must be skipped by
+        BOTH modes, never crashed on."""
+        events = [
+            self._request_event("r-1", 1000.0, {"queue": 1000.0}),
+            {"name": "request", "ph": "s", "id": "r-1", "ts": 0.0,
+             "pid": 9, "tid": 1, "cat": "request_flow"},
+            {"name": "request", "ph": "f", "id": "r-1", "ts": 5.0,
+             "pid": 9, "tid": 1, "cat": "request_flow", "bp": "e"},
+            {"name": "ctr", "ph": "C", "ts": 0.0, "pid": 9, "tid": 1,
+             "args": {"v": 1}},
+            {"name": "mystery", "ph": "Q"},         # unknown type
+            {"ph": "X"},                            # degenerate span
+        ]
+        assert "request" in summarize(events)       # no crash
+        s = tails_summary(events)
+        assert s is not None and s["requests"] == 1
+        assert "p99 attribution" in summarize_tails(events)
+
+    def test_no_request_spans_degrades_with_guidance(self):
+        assert tails_summary([{"name": "x", "ph": "X", "ts": 0.0,
+                               "dur": 1.0, "pid": 1, "tid": 1}]) is None
+        assert "no request spans" in summarize_tails([])
+
+    def test_cli_smoke(self, armed, tmp_path, capsys):
+        t, _rlog = armed
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as server:
+            server.register("m", _double_fn(), batch_size=4)
+            x = np.zeros((8, 3), np.float32)
+            server.submit({"input": x}).result(timeout=30)
+        path = tmp_path / "trace.json"
+        t.export(str(path))
+        assert report_main(["report", "--tails", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "request tails" in out
+        assert "attributed:" in out
+
+    def test_cli_usage_error(self, capsys):
+        assert report_main(["report", "--tails"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# cardinality bounds: the ring + exemplar retention
+
+
+class TestBoundedRetention:
+    def test_request_log_ring_bounds_and_counts_drops(self, armed):
+        _t, _ = armed
+        reg = default_registry()
+        before = reg.counter("obs.request_log.dropped").value
+        small = RequestLog(capacity=4)
+        for i in range(10):
+            tl = small.timeline("m", 1, time.perf_counter())
+            small.record(tl.finish(time.perf_counter(), "ok"),
+                         submitted=tl.submitted)
+        assert len(small.records()) == 4
+        assert small.dropped == 6
+        assert reg.counter("obs.request_log.dropped").value \
+            == before + 6
+        st = small.status()
+        assert st["retained"] == 4 and st["dropped"] == 6
+
+    def test_capacity_env_typo_degrades(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_REQUEST_LOG_CAPACITY", "lots")
+        rlog = RequestLog()
+        assert rlog.capacity == 1024      # default, not a crash
+
+    def test_exemplar_retention_bounded_keeps_worst(self):
+        res = Reservoir("t.lat", capacity=1024)
+        for i in range(100):
+            res.observe(float(i), exemplar={"request_id": f"r-{i}"})
+        ex = res.exemplars()
+        assert len(ex) == EXEMPLAR_CAPACITY
+        # the K LARGEST survive, largest first
+        assert [e["value"] for e in ex] == \
+            [float(v) for v in range(99, 99 - EXEMPLAR_CAPACITY, -1)]
+        assert res.exemplars_dropped == 100 - EXEMPLAR_CAPACITY
+
+    def test_exemplars_age_out_of_the_window(self):
+        res = Reservoir("t.lat", capacity=8)
+        res.observe(1e9, exemplar={"request_id": "ancient"})
+        for i in range(20):                 # push it out of the window
+            res.observe(1.0 + i * 1e-3,
+                        exemplar={"request_id": f"r-{i}"})
+        rids = {e["request_id"] for e in res.exemplars()}
+        assert "ancient" not in rids        # a stale worst case must
+        # not shadow the current tail
+
+    def test_exemplars_age_out_without_new_exemplar_offers(self):
+        """Plain observe() calls advance the window too: once a
+        specimen's observation leaves it, the readout must stop
+        naming it — even if no exemplar-carrying observe ever runs
+        again (e.g. the request log was disarmed)."""
+        res = Reservoir("t.lat", capacity=8)
+        res.observe(1e9, exemplar={"request_id": "ancient"})
+        dropped_before = res.exemplars_dropped
+        for i in range(20):
+            res.observe(1.0 + i * 1e-3)     # no exemplars offered
+        assert res.exemplars() == []
+        assert res.exemplars_dropped == dropped_before + 1
+
+    def test_h6_meta_no_per_request_metric_names(self):
+        """The registry never grows request-keyed metric names under
+        load — snapshot keys stay a bounded vocabulary."""
+        reg = default_registry()
+        for key in reg.snapshot():
+            assert "r-" not in key and "request_id" not in key, key
+
+
+# ---------------------------------------------------------------------------
+# SLO tracking: populations + burn rate
+
+
+class TestSLOTracker:
+    def _tracker(self, window_s=60.0):
+        return SLOTracker([
+            SLObjective(name="latency", kind="latency", target=0.9,
+                        threshold_s=0.1, window_s=window_s),
+            SLObjective(name="availability", kind="availability",
+                        target=0.9, window_s=window_s),
+        ])
+
+    def test_burn_rate_math(self):
+        st = self._tracker()
+        for _ in range(8):
+            st.record(latency_s=0.01, ok=True)
+        ob = st.status()["objectives"]
+        assert ob["availability"]["burn_rate"] == 0.0
+        assert ob["availability"]["budget_remaining"] == 1.0
+        st.record(ok=False)                  # 1 bad of 9 ≈ 11.1% bad
+        st.record(ok=False)                  # 2 bad of 10 = 20% bad
+        ob = st.status()["objectives"]
+        # 20% bad / 10% budget = burn 2.0 — burning twice the
+        # sustainable rate; remaining clamps at -1
+        assert ob["availability"]["burn_rate"] == pytest.approx(2.0)
+        assert ob["availability"]["budget_remaining"] == -1.0
+        assert not ob["availability"]["healthy"]
+
+    def test_latency_objective_counts_slow_and_failed_as_bad(self):
+        st = self._tracker()
+        st.record(latency_s=0.01, ok=True)   # good
+        st.record(latency_s=0.5, ok=True)    # slow: bad for latency
+        st.record(ok=False)                  # failed: bad for both
+        ob = st.status()["objectives"]
+        assert ob["latency"]["bad"] == 2
+        assert ob["availability"]["bad"] == 1
+
+    def test_window_rolls_off(self):
+        st = self._tracker(window_s=0.05)
+        st.record(ok=False)
+        time.sleep(0.08)
+        st.record(latency_s=0.01, ok=True)
+        ob = st.status()["objectives"]
+        assert ob["availability"]["events"] == 1     # the miss aged out
+        assert ob["availability"]["burn_rate"] == 0.0
+
+    def test_env_typo_degrades_to_defaults(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_SLO_LATENCY_TARGET", "huge")
+        monkeypatch.setenv("SPARKDL_TPU_SLO_WINDOW_S", "-3")
+        st = SLOTracker()
+        (lat, avail) = st.objectives
+        assert lat.target == 0.99 and lat.window_s == 300.0
+        assert avail.kind == "availability"
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            SLObjective(name="x", kind="speed", target=0.5)
+        with pytest.raises(ValueError, match="fraction"):
+            SLObjective(name="x", kind="availability", target=1.5)
+        with pytest.raises(ValueError, match="threshold"):
+            SLObjective(name="x", kind="latency", target=0.9)
+
+    def test_publish_gauges(self):
+        st = self._tracker()
+        st.record(ok=False)
+        reg = default_registry()
+        st.publish(reg)
+        snap = reg.snapshot()
+        assert snap["slo.availability.burn_rate"] > 0.0
+        assert "slo.availability.budget_remaining" in snap
+        assert "slo.latency.burn_rate" in snap
+
+    def test_publish_due_rate_limits_but_force_wins(self):
+        """The dispatcher-loop publish is rate-limited (status() scans
+        the whole outcome window — not a per-micro-batch cost); the
+        lifecycle edges force through."""
+        st = self._tracker()
+        st.record(ok=False)
+        reg = default_registry()
+        assert st.publish_due(reg) is True     # first: due
+        assert st.publish_due(reg) is False    # immediately after: not
+        assert st.publish_due(reg, force=True) is True
+        st.clear()
+        assert st.publish_due(reg) is True     # clear resets the clock
+
+
+class TestSeparatePopulations:
+    """THE fix pinned: deadline-expired / failed requests are recorded
+    in the availability stream, and the latency reservoir's percentile
+    population holds ONLY successes."""
+
+    def test_deadline_misses_never_enter_latency_reservoir(self):
+        slo_tracker().clear()
+        server = ModelServer(ServeConfig(max_wait_s=0.0))
+        server.register("m", _slow_host_fn(0.05), batch_size=4)
+        x = np.zeros((2, 3), np.float32)
+        # the burst: the first dispatch holds the lane ~50 ms, so
+        # these 1 ms deadlines expire queued
+        futs = [server.submit({"x": x}, deadline=0.001)
+                for _ in range(8)]
+        missed = 0
+        for f in futs:
+            try:
+                f.result(timeout=30)
+            except DeadlineExceeded:
+                missed += 1
+        assert missed >= 1
+        ok = [server.submit({"x": x}) for _ in range(3)]
+        for f in ok:
+            f.result(timeout=30)
+        server.close()
+        m = server.metrics
+        assert m.deadline_misses == missed
+        # the latency population: exactly the successes — a polluted
+        # population would also show p50 far below the 50 ms dispatch
+        # floor
+        successes = (8 - missed) + 3
+        assert m._latency.count == successes
+        assert m.latency_seconds(0.5) >= 0.04
+        # the availability stream saw every outcome
+        avail = slo_tracker().status()["objectives"]["availability"]
+        assert avail["events"] == 11
+        assert avail["bad"] == missed
+        assert avail["burn_rate"] > 0.0
+        slo_tracker().clear()
+
+    def test_dispatch_failures_count_availability_and_failures(self):
+        slo_tracker().clear()
+
+        def broken(params, inputs):
+            raise RuntimeError("boom")
+
+        mf = ModelFunction(broken, None, {"x": ((3,), np.float32)},
+                           output_names=["y"], backend="host")
+        server = ModelServer(ServeConfig(max_wait_s=0.0))
+        server.register("m", mf, batch_size=4)
+        fut = server.submit({"x": np.zeros((2, 3), np.float32)})
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=30)
+        server.close()
+        m = server.metrics
+        assert m.failures == 1
+        assert m._latency.count == 0
+        avail = slo_tracker().status()["objectives"]["availability"]
+        assert avail["bad"] >= 1
+        slo_tracker().clear()
+
+    def test_zero_row_fast_path_is_an_outcome_too(self):
+        """The inline N=0 path must not be a metrics hole: a broken
+        runner hammered with empty probes shows up as failures +
+        availability burn, and successful empties count as good."""
+        slo_tracker().clear()
+
+        def broken(params, inputs):
+            raise RuntimeError("empty-probe boom")
+
+        mf = ModelFunction(broken, None, {"x": ((3,), np.float32)},
+                           output_names=["y"], backend="host")
+        server = ModelServer(ServeConfig(max_wait_s=0.0))
+        server.register("m", mf, batch_size=4)
+        with pytest.raises(ValueError, match="empty"):
+            # the N=0 probe-batch contract wraps the runner error
+            server.submit({"x": np.zeros((0, 3), np.float32)})
+        m = server.metrics
+        assert m.failures == 1
+        avail = slo_tracker().status()["objectives"]["availability"]
+        assert avail["bad"] == 1
+        server.close()
+        slo_tracker().clear()
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as ok_server:
+            ok_server.register("m", _double_fn(), batch_size=4)
+            out = ok_server.submit(
+                {"input": np.zeros((0, 3), np.float32)}).result(1)
+            assert out["output"].shape == (0, 3)
+        avail = slo_tracker().status()["objectives"]["availability"]
+        assert avail["events"] >= 1 and avail["bad"] == 0
+        slo_tracker().clear()
+
+    def test_failed_requests_close_their_timelines(self, armed):
+        _t, rlog = armed
+        server = ModelServer(ServeConfig(max_wait_s=0.0))
+        server.register("m", _slow_host_fn(0.05), batch_size=4)
+        x = np.zeros((2, 3), np.float32)
+        futs = [server.submit({"x": x}, deadline=0.001)
+                for _ in range(6)]
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                outcomes.append("ok")
+            except DeadlineExceeded:
+                outcomes.append("deadline_exceeded")
+        server.close()
+        recs = rlog.records()
+        assert len(recs) == 6
+        assert sorted(r.status for r in recs) == sorted(outcomes)
+        for r in recs:
+            assert sum(r.phases.values()) == pytest.approx(
+                r.total_s, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /statusz + flight bundle
+
+
+class TestSurfaces:
+    def test_statusz_carries_slo_request_log_and_exemplars(self,
+                                                           armed):
+        import urllib.request
+
+        _t, _rlog = armed
+        slo_tracker().clear()
+        server = ModelServer(ServeConfig(max_wait_s=0.0))
+        server.register("m", _double_fn(), batch_size=4)
+        tel = server.serve_telemetry()
+        try:
+            x = np.zeros((4, 3), np.float32)
+            server.submit({"input": x}).result(timeout=30)
+            with urllib.request.urlopen(tel.url("/statusz"),
+                                        timeout=5) as r:
+                st = json.load(r)
+            assert "latency" in st["slo"]["objectives"]
+            assert st["request_log"]["capacity"] > 0
+            (ex,) = st["servers"]
+            assert ex["latency_exemplars"], ex
+            assert ex["latency_exemplars"][0]["request_id"]
+            with urllib.request.urlopen(tel.url("/metricsz"),
+                                        timeout=5) as r:
+                body = r.read().decode()
+            assert "sparkdl_slo_latency_burn_rate" in body
+            assert "sparkdl_slo_availability_budget_remaining" in body
+        finally:
+            server.close()
+            slo_tracker().clear()
+
+    def test_metricsz_refreshes_slo_at_scrape_time(self):
+        """The serve loop's gauge publish is rate-limited; the scrape
+        must never see that throttle — /metricsz re-publishes the SLO
+        verdicts at request time, so an outcome recorded with NO
+        publish at all still reads back fresh."""
+        import re
+        import urllib.request
+
+        from sparkdl_tpu.obs.export import TelemetryServer
+
+        slo_tracker().clear()
+        slo_tracker().record(ok=False)       # never published
+        with TelemetryServer() as tel:
+            with urllib.request.urlopen(tel.url("/metricsz"),
+                                        timeout=5) as r:
+                body = r.read().decode()
+        burn = float(re.search(
+            r"^sparkdl_slo_availability_burn_rate ([-+0-9.e]+)",
+            body, re.M).group(1))
+        assert burn > 0.0
+        slo_tracker().clear()
+
+    def test_flight_bundle_carries_slo_and_requests(self, armed,
+                                                    tmp_path,
+                                                    monkeypatch):
+        from sparkdl_tpu.obs import flight
+
+        _t, rlog = armed
+        monkeypatch.setenv("SPARKDL_TPU_FLIGHT_DIR", str(tmp_path))
+        with ModelServer(ServeConfig(max_wait_s=0.0)) as server:
+            server.register("m", _double_fn(), batch_size=4)
+            x = np.zeros((4, 3), np.float32)
+            server.submit({"input": x}).result(timeout=30)
+            path = flight.recorder().dump(reason="test")
+        bundle = json.loads(open(path).read())
+        assert "objectives" in bundle["slo"]
+        reqs = bundle["requests"]
+        assert reqs["retained"] >= 1
+        assert reqs["recent"][0]["request_id"]
+        assert set(reqs["recent"][0]["phases"]) == set(PHASES)
+
+
+# ---------------------------------------------------------------------------
+# pickle discipline
+
+
+class TestPickle:
+    def test_request_log_roundtrip(self):
+        cloudpickle = pytest.importorskip("cloudpickle")
+        import pickle
+
+        rlog = RequestLog(capacity=7)
+        rlog.arm()
+        tl = rlog.timeline("m", 2, time.perf_counter())
+        rlog.record(tl.finish(time.perf_counter(), "ok"),
+                    submitted=tl.submitted)
+        clone = pickle.loads(cloudpickle.dumps(rlog))
+        assert clone.capacity == 7
+        assert clone.armed                  # armed-ness travels
+        assert clone.records() == []        # records stay local
+        assert clone.dropped == 0
+        tl2 = clone.timeline("m", 2, time.perf_counter())
+        clone.record(tl2.finish(time.perf_counter(), "ok"))
+        assert len(clone.records()) == 1    # usable on arrival
+
+    def test_slo_tracker_roundtrip(self):
+        cloudpickle = pytest.importorskip("cloudpickle")
+        import pickle
+
+        st = SLOTracker([SLObjective(
+            name="availability", kind="availability", target=0.5,
+            window_s=9.0)])
+        st.record(ok=False)
+        clone = pickle.loads(cloudpickle.dumps(st))
+        (obj,) = clone.objectives           # config travels
+        assert obj.target == 0.5 and obj.window_s == 9.0
+        # events are per-process perf_counter instants: dropped
+        assert clone.status()["objectives"]["availability"][
+            "events"] == 0
+        clone.record(ok=True)               # usable on arrival
+        assert clone.status()["objectives"]["availability"][
+            "events"] == 1

@@ -42,11 +42,14 @@ from typing import List, Optional
 
 #: nested objects whose KEYS vary run-to-run (only their type is
 #: checked): the registry snapshot depends on which subsystems ran,
-#: memory stats on the backend, and the autotune block's
+#: memory stats on the backend, the autotune block's
 #: converged-config / decision detail on which targets and knobs the
-#: controller actually touched that round
+#: controller actually touched that round, the tails block's phase
+#: breakdown (and null p50/p99) on which requests the serve pass
+#: actually recorded, and the slo block's objectives on the env's
+#: objective config
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
-                "autotune"}
+                "autotune", "tails", "slo"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

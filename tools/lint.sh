@@ -15,7 +15,7 @@ export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
 targets=("${@:-sparkdl_tpu}")
 
-echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce) =="
+echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality) =="
 python -m sparkdl_tpu.analysis "${targets[@]}"
 
 if command -v ruff >/dev/null 2>&1; then
