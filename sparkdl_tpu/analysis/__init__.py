@@ -12,7 +12,7 @@ static half of the enforcement pair (the dynamic half is
 ``sparkdl_tpu.runtime.sanitize``, which puts ``jax.transfer_guard``
 under the ship path at runtime).
 
-Five rules, each an AST visitor over every module in the package:
+The per-file rules, each an AST visitor over every module analyzed:
 
 * **H1 — implicit host transfers**: ``jax.device_get`` /
   ``.block_until_ready()`` / ``np.asarray(<jnp-producing call>)``
@@ -47,6 +47,28 @@ Five rules, each an AST visitor over every module in the package:
   ``RequestLog``, reservoir exemplars, and span args
   (``obs/request_log.py``), never in metric names.
 
+Three WHOLE-PROGRAM rules run over every analyzed module at once
+(callgraph.py builds the package-wide symbol table + call graph,
+locks.py the lock-scope model; per-file results/facts are cached by
+mtime+hash so the ci.sh gate stays fast):
+
+* **H7 — lock-order cycles**: the acquired-while-holding graph
+  (lock A held while lock B is acquired, directly or through any
+  resolved call chain) must be acyclic; a cycle is reported with its
+  module-by-module witness path — the PR-2 collective-enqueue
+  deadlock, reconstructed as a fixture, is the canonical catch.
+* **H8 — blocking call under a lock**: device syncs
+  (``timed_device_get``/``.block_until_ready()``), ``Condition.wait``,
+  ``queue.get``, ``time.sleep``, file/socket I/O, thread joins — or a
+  transitively-may-block callee — reached while a lock is held. The
+  serve dispatcher's intentional coalescing wait is allowlisted.
+* **H9 — contract drift**: every registry key, span lane, env var,
+  and ``/statusz`` field the code publishes is cross-checked against
+  the docs tables (docs/OBSERVABILITY.md, docs/SERVING.md,
+  docs/PERFORMANCE.md, README.md for env vars) in BOTH directions —
+  an undocumented publish fails, and so does a documented-but-gone
+  name.
+
 Findings suppress inline with a justification::
 
     jax.device_get(x)  # sparkdl-lint: allow[H1] -- epoch-end drain
@@ -60,23 +82,33 @@ generic ruff/mypy baseline from pyproject.toml. Rule reference:
 
 from __future__ import annotations
 
+from sparkdl_tpu.analysis.callgraph import (
+    CallGraph,
+    build_graph,
+    scan_module,
+)
 from sparkdl_tpu.analysis.findings import Finding, format_findings
 from sparkdl_tpu.analysis.rules import RULES, rule_doc
 from sparkdl_tpu.analysis.suppress import DEFAULT_ALLOWLIST, AllowEntry
 from sparkdl_tpu.analysis.walker import (
+    ALL_RULES,
     analyze_paths,
     analyze_source,
     iter_python_files,
 )
 
 __all__ = [
+    "ALL_RULES",
     "AllowEntry",
+    "CallGraph",
     "DEFAULT_ALLOWLIST",
     "Finding",
     "RULES",
     "analyze_paths",
     "analyze_source",
+    "build_graph",
     "format_findings",
     "iter_python_files",
     "rule_doc",
+    "scan_module",
 ]

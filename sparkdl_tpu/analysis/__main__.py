@@ -3,68 +3,136 @@
 Exit 0 when every finding is suppressed (inline annotation or
 allowlist), 1 when any unsuppressed finding remains, 2 on usage
 errors — the contract tools/ci.sh's static-analysis gate keys off.
+
+With no paths the default target set is the installed package PLUS the
+repo's ``tools/`` and ``examples/`` trees when they sit next to it —
+the CLI scripts hold no locks but they do call the hot paths, and a
+deadlock witness that starts in an example is still a deadlock.
+
+``--json`` emits the machine schema CI gates: findings, counts,
+per-rule totals, and the per-file cache's hit/miss accounting (the
+cache is on by default — ``SPARKDL_TPU_LINT_CACHE`` names the file,
+``--no-cache`` disables it).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
+from sparkdl_tpu.analysis.cache import default_cache_path
 from sparkdl_tpu.analysis.findings import format_findings
-from sparkdl_tpu.analysis.rules import RULES, rule_doc
-from sparkdl_tpu.analysis.walker import analyze_paths
+from sparkdl_tpu.analysis.rules import rule_doc
+from sparkdl_tpu.analysis.walker import ALL_RULES, analyze_paths
 
 
-def _default_target() -> str:
-    """The installed package itself — `python -m sparkdl_tpu.analysis`
-    with no args lints the code that is actually importable."""
+def _package_dir() -> str:
     import sparkdl_tpu
     return os.path.dirname(os.path.abspath(sparkdl_tpu.__file__))
+
+
+def _default_targets() -> list:
+    """The installed package, plus the repo's tools/ and examples/
+    when present — `python -m sparkdl_tpu.analysis` with no args lints
+    everything the repo actually ships and drives. The extra dirs are
+    only taken when the package parent IS the repo checkout (marker:
+    docs/OBSERVABILITY.md) — a site-packages install must not sweep a
+    neighboring distribution's stray tools/ directory."""
+    pkg = _package_dir()
+    targets = [pkg]
+    root = os.path.dirname(pkg)
+    if os.path.isfile(os.path.join(root, "docs", "OBSERVABILITY.md")):
+        for extra in ("tools", "examples"):
+            d = os.path.join(root, extra)
+            if os.path.isdir(d):
+                targets.append(d)
+    return targets
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sparkdl_tpu.analysis",
         description="sparkdl-lint: enforce the hot-path invariants "
-                    "(H1 transfers, H2 retrace, H3 locks, H4 quiesce). "
+                    "(H1 transfers, H2 retrace, H3 locks, H4 quiesce, "
+                    "H5 clocks, H6 cardinality) plus the whole-program "
+                    "concurrency passes (H7 lock-order cycles, H8 "
+                    "blocking under a lock, H9 docs contract drift). "
                     "Rule reference: docs/LINT.md")
     parser.add_argument(
         "paths", nargs="*",
         help="files/directories to lint (default: the sparkdl_tpu "
-             "package)")
+             "package + the repo's tools/ and examples/)")
     parser.add_argument(
-        "--rule", action="append", choices=sorted(RULES), dest="rules",
+        "--rule", action="append", choices=sorted(ALL_RULES),
+        dest="rules",
         help="run only this rule (repeatable; default: all)")
     parser.add_argument(
         "--format", choices=("text", "json"), default="text")
     parser.add_argument(
+        "--json", action="store_true",
+        help="shorthand for --format json (the CI gate's schema)")
+    parser.add_argument(
         "--show-suppressed", action="store_true",
         help="also print suppressed findings with their justifications")
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="disable the per-file mtime+hash result cache")
+    parser.add_argument(
+        "--cache", metavar="PATH", default=None,
+        help="cache file (default: SPARKDL_TPU_LINT_CACHE or a "
+             "per-user temp file)")
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule in sorted(RULES):
+        for rule in sorted(ALL_RULES):
             print(f"{rule}: {rule_doc(rule)}")
         return 0
 
-    targets = args.paths or [_default_target()]
+    targets = args.paths or _default_targets()
     for t in targets:
         if not os.path.exists(t):
             print(f"sparkdl-lint: no such path: {t}", file=sys.stderr)
             return 2
 
-    findings = analyze_paths(targets, rules=args.rules)
+    cache_path = None if args.no_cache else \
+        (args.cache or default_cache_path())
+    cache_stats: dict = {}
+    findings = analyze_paths(targets, rules=args.rules,
+                             cache_path=cache_path,
+                             cache_stats=cache_stats)
     unsuppressed = [f for f in findings if not f.suppressed]
-    out = format_findings(findings,
-                          show_suppressed=args.show_suppressed,
-                          fmt=args.format)
-    if out:
-        print(out)
-    if args.format == "text":
+    fmt = "json" if args.json else args.format
+    if fmt == "json":
+        shown = [f for f in findings
+                 if args.show_suppressed or not f.suppressed]
+        by_rule: dict = {}
+        for f in findings:
+            entry = by_rule.setdefault(
+                f.rule, {"unsuppressed": 0, "suppressed": 0})
+            entry["suppressed" if f.suppressed else "unsuppressed"] += 1
+        print(json.dumps({
+            "findings": [f.__dict__ for f in shown],
+            "unsuppressed": len(unsuppressed),
+            "suppressed": len(findings) - len(unsuppressed),
+            "rules": sorted(args.rules) if args.rules
+            else sorted(ALL_RULES),
+            "by_rule": by_rule,
+            "targets": [os.path.relpath(t) if not
+                        os.path.relpath(t).startswith("..") else t
+                        for t in targets],
+            "cache": cache_stats,
+        }, indent=2))
+    else:
+        out = format_findings(findings,
+                              show_suppressed=args.show_suppressed,
+                              fmt="text")
+        if out:
+            print(out)
         suppressed = len(findings) - len(unsuppressed)
         print(f"sparkdl-lint: {len(unsuppressed)} finding(s), "
               f"{suppressed} suppressed", file=sys.stderr)

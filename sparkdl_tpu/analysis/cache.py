@@ -1,0 +1,138 @@
+"""Per-file mtime+hash result cache for the analyzer.
+
+ci.sh runs the lint gate on every build; the package is ~100 modules
+and the whole-program passes re-parse all of them even when one file
+changed. The cache keeps the expensive per-file work — parse, the
+H1–H6 rule passes, the callgraph/lock fact extraction — keyed by
+``(mtime_ns, content sha256, analyzer version, rule set)``; program
+rules (H7–H9) always re-run over the (cheap, already-extracted) facts
+because their verdicts depend on every file at once.
+
+The cache degrades to a no-op on ANY problem (unreadable file, bad
+JSON, version bump): correctness never depends on it, and a corrupt
+cache is silently discarded rather than trusted. ``__main__`` reports
+hits/misses in ``--json`` output so CI can gate that a second run
+actually hit (and a touched file actually re-analyzed).
+
+Location: ``SPARKDL_TPU_LINT_CACHE`` (a file path), or the default
+under the system temp dir, namespaced by euid so shared CI hosts do
+not fight over one file. ``--no-cache`` disables entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from dataclasses import asdict
+from typing import Dict, List, Optional, Tuple
+
+from sparkdl_tpu.analysis.callgraph import ModuleFacts
+from sparkdl_tpu.analysis.contracts import CodeSurface
+from sparkdl_tpu.analysis.findings import Finding
+
+#: bump when rule logic or fact shape changes — stale entries miss
+ANALYZER_VERSION = 4
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("SPARKDL_TPU_LINT_CACHE", "")
+    if env:
+        return env
+    uid = getattr(os, "geteuid", lambda: 0)()
+    return os.path.join(tempfile.gettempdir(),
+                        f"sparkdl_lint_cache_{uid}.json")
+
+
+def file_stamp(path: str, source: str) -> Tuple[int, str]:
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        mtime = 0
+    digest = hashlib.sha256(source.encode("utf-8")).hexdigest()[:20]
+    return mtime, digest
+
+
+class ResultCache:
+    """One JSON file: display path → cached per-file entry."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._data: Dict[str, dict] = {}
+        self._dirty = False
+        if path and os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    raw = json.load(f)
+                if raw.get("version") == ANALYZER_VERSION and \
+                        isinstance(raw.get("files"), dict):
+                    self._data = raw["files"]
+            except (OSError, ValueError):
+                self._data = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    def lookup(self, display: str, path: str, source: str,
+               rules_key: str
+               ) -> Optional[Tuple[List[Finding], ModuleFacts,
+                                   CodeSurface]]:
+        if not self.enabled:
+            return None
+        entry = self._data.get(display)
+        mtime, digest = file_stamp(path, source)
+        if (not entry or entry.get("sha") != digest
+                or entry.get("mtime") != mtime
+                or entry.get("rules") != rules_key):
+            self.misses += 1
+            return None
+        try:
+            findings = [Finding(**f) for f in entry["findings"]]
+            facts = ModuleFacts.from_dict(entry["facts"])
+            surface = CodeSurface.from_dict(entry["surface"])
+        except (KeyError, TypeError, ValueError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return findings, facts, surface
+
+    def store(self, display: str, path: str, source: str,
+              rules_key: str, findings: List[Finding],
+              facts: ModuleFacts, surface: CodeSurface) -> None:
+        if not self.enabled:
+            return
+        mtime, digest = file_stamp(path, source)
+        self._data[display] = {
+            "mtime": mtime, "sha": digest, "rules": rules_key,
+            # suppression state is recomputed per run (the annotation
+            # lives in the source, whose hash keys this entry — but a
+            # cheap replay keeps the walker logic in ONE place)
+            "findings": [asdict(f) for f in findings],
+            "facts": facts.to_dict(),
+            "surface": surface.to_dict(),
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not (self.enabled and self._dirty):
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": ANALYZER_VERSION,
+                           "files": self._data}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            # a read-only cache dir must never fail the lint
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        return {"enabled": self.enabled, "path": self.path,
+                "hits": self.hits, "misses": self.misses}

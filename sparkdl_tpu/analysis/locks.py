@@ -1,0 +1,472 @@
+"""Lock-scope inference for the whole-program rules (H7/H8).
+
+Per function, this module answers three questions the per-file rules
+(H1–H6) cannot:
+
+* **which locks does this function acquire**, and which locks were
+  already held at each acquire site (the raw material of the
+  acquired-while-holding graph H7 builds);
+* **which statements run while a lock is held** — `with self._lock:`
+  blocks exactly (lexical nesting), `acquire()`..`release()` pairs by
+  source-line region (a deliberate heuristic: from the acquire
+  statement to the first later `release()` of the same lock in the
+  same function, else function end — the repo's own acquire/release
+  idioms are all function-scoped);
+* **which calls may block directly** — the device drain
+  (`jax.device_get` / `timed_device_get` / `.block_until_ready()`),
+  `Condition`/`Event.wait`, `queue.get`, `time.sleep`, file/socket
+  I/O, thread joins — classified lexically by the same name rules the
+  per-file passes use.
+
+Lock **identity** is class- or module-scoped, not instance-scoped:
+``self._lock`` inside ``ModelSession`` becomes
+``sparkdl_tpu.serve.server::ModelSession._lock``. Two instances of one
+class therefore share an identity — a deliberate over-approximation
+(the repo's lock-holding classes are singletons or per-pipeline
+objects, and a false cycle is cheap to suppress inline, which is
+itself documentation). A ``threading.Condition(self._lock)`` aliases
+to the mutex it wraps, so ``with self._cond`` and ``with self._lock``
+name ONE lock. ``collective_launch(...)`` — the process-wide launch
+lock from parallel/mesh.py — canonicalizes to the single global id
+``collective_launch`` wherever it is imported from.
+
+Non-blocking try-acquires (``acquire(blocking=False)``) are neither
+acquire events nor block events: a try-lock cannot deadlock (it fails
+instead of waiting), which conveniently models the runner's
+``checkout_staging`` fallback and the autotune ``poll()`` discipline
+as the non-hazards they are.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
+               "threading.Condition", "Condition",
+               "threading.Semaphore", "Semaphore",
+               "threading.BoundedSemaphore"}
+
+#: module-level names accepted as locks even without a visible ctor
+#: (imported from a module outside the analyzed set)
+_LOCKISH_NAME = re.compile(r"lock|mutex|cond|sem", re.IGNORECASE)
+
+#: THE process-wide collective launch lock (parallel/mesh.py): every
+#: spelling (`collective_launch(mesh)`, an imported alias, the
+#: `_CollectiveLaunch` wrapper) canonicalizes to one global identity —
+#: the PR-2 deadlock class is about this one ordering point.
+COLLECTIVE_LOCK_ID = "collective_launch"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# events
+
+
+@dataclass
+class LockEvent:
+    """One lock acquisition: ``held`` is what was already held."""
+
+    lock: str
+    line: int
+    held: Tuple[str, ...]
+    blocking: bool = True      # acquire(blocking=False) -> False
+
+
+@dataclass
+class BlockEvent:
+    """One direct may-block operation."""
+
+    what: str                  # human-readable op, e.g. "time.sleep()"
+    kind: str                  # "sleep" | "wait" | "device" | "io" | ...
+    line: int
+    held: Tuple[str, ...]
+
+
+@dataclass
+class CallEvent:
+    """One call site, with enough shape for cross-module resolution."""
+
+    kind: str                  # "self" | "name" | "dotted" | "method"
+    name: str                  # method/function name (last segment)
+    display: str               # what the source says, for messages
+    line: int
+    held: Tuple[str, ...]
+    qualifier: str = ""        # "self" kind: enclosing class;
+    #                            "dotted": the leading name
+
+
+@dataclass
+class FunctionFacts:
+    """The serializable per-function summary the program rules run on."""
+
+    key: str                   # "module::Qual"
+    module: str
+    path: str
+    qualname: str
+    line: int
+    acquires: List[LockEvent] = field(default_factory=list)
+    blocks: List[BlockEvent] = field(default_factory=list)
+    calls: List[CallEvent] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key, "module": self.module, "path": self.path,
+            "qualname": self.qualname, "line": self.line,
+            "acquires": [[e.lock, e.line, list(e.held), e.blocking]
+                         for e in self.acquires],
+            "blocks": [[e.what, e.kind, e.line, list(e.held)]
+                       for e in self.blocks],
+            "calls": [[e.kind, e.name, e.display, e.line,
+                       list(e.held), e.qualifier] for e in self.calls],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FunctionFacts":
+        f = cls(key=d["key"], module=d["module"], path=d["path"],
+                qualname=d["qualname"], line=d["line"])
+        f.acquires = [LockEvent(a[0], a[1], tuple(a[2]), a[3])
+                      for a in d["acquires"]]
+        f.blocks = [BlockEvent(b[0], b[1], b[2], tuple(b[3]))
+                    for b in d["blocks"]]
+        f.calls = [CallEvent(c[0], c[1], c[2], c[3], tuple(c[4]), c[5])
+                   for c in d["calls"]]
+        return f
+
+
+# ---------------------------------------------------------------------------
+# per-module lock discovery
+
+
+@dataclass
+class ModuleLocks:
+    """What the module pre-pass learned about lock identity."""
+
+    module: str
+    #: class -> instance lock attrs (``self.X = threading.Lock()``)
+    class_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    #: class -> {alias attr -> canonical attr}; e.g. a
+    #: ``threading.Condition(self._lock)`` makes ``_cond`` -> ``_lock``
+    aliases: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    #: module-level lock names (``_LOCK = threading.Lock()``)
+    module_locks: Set[str] = field(default_factory=set)
+
+    def canonical_attr(self, cls: str, attr: str) -> str:
+        return self.aliases.get(cls, {}).get(attr, attr)
+
+
+def discover_locks(tree: ast.Module, module: str) -> ModuleLocks:
+    ml = ModuleLocks(module=module)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and \
+                _dotted(node.value.func) in _LOCK_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    ml.module_locks.add(tgt.id)
+        if isinstance(node, ast.ClassDef):
+            locks: Set[str] = set()
+            aliases: Dict[str, str] = {}
+            # class-body locks are per-CLASS state and behave exactly
+            # like module locks for ordering purposes
+            for item in node.body:
+                if isinstance(item, ast.Assign) and isinstance(
+                        item.value, ast.Call) and \
+                        _dotted(item.value.func) in _LOCK_CTORS:
+                    for tgt in item.targets:
+                        if isinstance(tgt, ast.Name):
+                            locks.add(tgt.id)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                val = sub.value
+                if not (isinstance(val, ast.Call)
+                        and _dotted(val.func) in _LOCK_CTORS):
+                    continue
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        locks.add(tgt.attr)
+                        # Condition(self._lock) wraps an EXISTING
+                        # mutex: the alias and the mutex are one lock
+                        if val.args:
+                            inner = val.args[0]
+                            if (isinstance(inner, ast.Attribute)
+                                    and isinstance(inner.value, ast.Name)
+                                    and inner.value.id == "self"):
+                                aliases[tgt.attr] = inner.attr
+            if locks:
+                ml.class_locks[node.name] = locks
+            if aliases:
+                ml.aliases[node.name] = aliases
+    return ml
+
+
+# ---------------------------------------------------------------------------
+# blocking-call classification
+
+_BLOCK_DOTTED = {
+    "time.sleep": ("time.sleep()", "sleep"),
+    "sleep": ("sleep()", "sleep"),
+    "jax.device_get": ("jax.device_get()", "device"),
+    "timed_device_get": ("timed_device_get()", "device"),
+    "input": ("input()", "io"),
+    "socket.create_connection": ("socket connect", "io"),
+    "urllib.request.urlopen": ("urlopen()", "io"),
+    "subprocess.run": ("subprocess.run()", "io"),
+    "subprocess.check_output": ("subprocess.check_output()", "io"),
+    "subprocess.check_call": ("subprocess.check_call()", "io"),
+}
+_BLOCK_ATTRS = {
+    "block_until_ready": ("`.block_until_ready()` device sync",
+                          "device"),
+    "timed_device_get": ("timed_device_get()", "device"),
+    "recv": ("socket `.recv()`", "io"),
+    "accept": ("socket `.accept()`", "io"),
+    "communicate": ("`.communicate()` on a subprocess", "io"),
+}
+_QUEUEISH = re.compile(r"queue|^_?q$", re.IGNORECASE)
+_THREADISH = re.compile(r"thread|worker|proc", re.IGNORECASE)
+_FUTUREISH = re.compile(r"fut", re.IGNORECASE)
+
+
+def classify_blocking(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(description, kind) when this call can block the thread."""
+    name = _dotted(call.func)
+    if name in _BLOCK_DOTTED:
+        return _BLOCK_DOTTED[name]
+    if name == "open" or (name and name.endswith(".open")):
+        return ("`open()` file I/O", "io")
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _BLOCK_ATTRS:
+        return _BLOCK_ATTRS[attr]
+    recv = call.func.value
+    recv_name = (_dotted(recv) or "").rsplit(".", 1)[-1]
+    if attr == "wait":
+        return (f"`{recv_name or '<expr>'}.wait()` "
+                "(Condition/Event wait)", "wait")
+    if attr == "get" and _QUEUEISH.search(recv_name or ""):
+        return (f"`{recv_name}.get()` queue wait", "wait")
+    if attr == "join" and _THREADISH.search(recv_name or ""):
+        return (f"`{recv_name}.join()` thread join", "wait")
+    if attr == "result" and _FUTUREISH.search(recv_name or ""):
+        return (f"`{recv_name}.result()` future wait", "wait")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the per-function scan
+
+
+class FunctionScanner:
+    """Walks ONE function body tracking the held-lock set, emitting
+    acquire/block/call events. ``with`` items scope lexically;
+    ``acquire()``/``release()`` pairs are resolved afterwards by
+    source-line region."""
+
+    def __init__(self, module: str, path: str, cls: Optional[str],
+                 qualname: str, locks: ModuleLocks,
+                 imports: Dict[str, str]):
+        self.module = module
+        self.path = path
+        self.cls = cls
+        self.qualname = qualname
+        self.locks = locks
+        self.imports = imports
+        self.acquires: List[LockEvent] = []
+        self.blocks: List[BlockEvent] = []
+        self.calls: List[CallEvent] = []
+        #: flat acquire()/release() regions: lock id -> [(lo, hi)]
+        self._flat: List[Tuple[str, int, int]] = []
+
+    # -- lock identity -------------------------------------------------------
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        """The canonical lock identity of ``expr``, or None when it is
+        not recognizably a lock."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            attr = expr.attr
+            cls = self.cls or ""
+            attr = self.locks.canonical_attr(cls, attr)
+            if cls and attr in self.locks.class_locks.get(cls, ()):
+                return f"{self.module}::{cls}.{attr}"
+            # unknown self attr: accept lock-shaped names (a base
+            # class may own the ctor)
+            if _LOCKISH_NAME.search(attr):
+                return f"{self.module}::{cls or '?'}.{attr}"
+            return None
+        name = _dotted(expr)
+        if name is None:
+            return None
+        if name in self.locks.module_locks:
+            return f"{self.module}::{name}"
+        if "." not in name:
+            src = self.imports.get(name)
+            if src is not None:
+                # imported module-level name: identity follows the
+                # DEFINING module — but whether it IS a lock is only
+                # knowable there, so this is a CANDIDATE ("?" prefix)
+                # the CallGraph confirms against that module's lock
+                # table (or by lock-shaped name when the module is
+                # outside the analyzed set) and drops otherwise
+                mod, _, attr = src.rpartition(".")
+                if mod:
+                    return f"?{mod}::{attr}"
+                return (f"{src}::{name}"
+                        if _LOCKISH_NAME.search(name) else None)
+            if _LOCKISH_NAME.search(name):
+                # a parameter or local named like a lock (the
+                # checkout_staging idiom): function-scoped identity
+                return f"{self.module}::{self.qualname}.<{name}>"
+        return None
+
+    def _with_item_lock(self, ctx: ast.AST) -> Optional[str]:
+        if isinstance(ctx, ast.Call):
+            name = _dotted(ctx.func) or ""
+            if name.split(".")[-1] == "collective_launch":
+                return COLLECTIVE_LOCK_ID
+            return None
+        return self.lock_id(ctx)
+
+    # -- the walk ------------------------------------------------------------
+
+    def scan(self, fn: ast.AST) -> None:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        self._walk(body, ())
+        self._apply_flat_regions()
+
+    def _walk(self, stmts: List[ast.stmt], held: Tuple[str, ...]):
+        for stmt in stmts:
+            self._visit_stmt(stmt, held)
+
+    def _visit_stmt(self, stmt: ast.stmt, held: Tuple[str, ...]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return      # nested defs are scanned as their own functions
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new = tuple(held)
+            for item in stmt.items:
+                lock = self._with_item_lock(item.context_expr)
+                self._scan_expr(item.context_expr, held)
+                if lock is not None and lock not in new:
+                    self.acquires.append(LockEvent(
+                        lock, stmt.lineno, tuple(new)))
+                    new = new + (lock,)
+            self._walk(stmt.body, new)
+            return
+        # acquire()/release() statements: flat regions
+        expr = stmt.value if isinstance(stmt, ast.Expr) else None
+        asn = stmt.value if isinstance(stmt, ast.Assign) else None
+        for val in (expr, asn):
+            if isinstance(val, ast.Call) and isinstance(
+                    val.func, ast.Attribute):
+                if val.func.attr == "acquire":
+                    lock = self.lock_id(val.func.value)
+                    if lock is not None:
+                        blocking = not self._is_try_acquire(val)
+                        if blocking:
+                            self.acquires.append(LockEvent(
+                                lock, val.lineno, held))
+                            self._flat.append(
+                                (lock, val.lineno, 1 << 30))
+                        break
+                if val.func.attr == "release":
+                    lock = self.lock_id(val.func.value)
+                    if lock is not None:
+                        for i, (lk, lo, hi) in enumerate(self._flat):
+                            if lk == lock and hi == 1 << 30 \
+                                    and lo < val.lineno:
+                                self._flat[i] = (lk, lo, val.lineno)
+                                break
+                        break
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._visit_stmt(child, held)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+            elif isinstance(child, ast.ExceptHandler):
+                self._walk(child.body, held)
+            elif isinstance(child, (ast.arguments, ast.keyword)):
+                self._scan_expr(child, held)  # generic below
+        # statement bodies reached above; nothing else to do
+
+    @staticmethod
+    def _is_try_acquire(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(
+                    kw.value, ast.Constant) and kw.value.value is False:
+                return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return False
+
+    def _scan_expr(self, expr: ast.AST, held: Tuple[str, ...]):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._record_call(node, held)
+
+    def _record_call(self, call: ast.Call, held: Tuple[str, ...]):
+        hazard = classify_blocking(call)
+        if hazard is not None:
+            # try-acquires and lock bookkeeping are handled as lock
+            # events, never as blocking ops
+            self.blocks.append(BlockEvent(
+                hazard[0], hazard[1], call.lineno, held))
+        name = _dotted(call.func)
+        if name is None:
+            return
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            self.calls.append(CallEvent(
+                "self", parts[1], name, call.lineno, held,
+                qualifier=self.cls or ""))
+        elif len(parts) == 1:
+            self.calls.append(CallEvent(
+                "name", parts[0], name, call.lineno, held))
+        elif len(parts) == 2 and parts[0] in self.imports:
+            self.calls.append(CallEvent(
+                "dotted", parts[1], name, call.lineno, held,
+                qualifier=self.imports[parts[0]]))
+        else:
+            # obj.method(...): resolved later by the unique-method
+            # heuristic
+            self.calls.append(CallEvent(
+                "method", parts[-1], name, call.lineno, held))
+
+    def _apply_flat_regions(self):
+        """Fold acquire()..release() line regions into every event's
+        held set (the lexical `with` sets were exact already)."""
+        if not self._flat:
+            return
+
+        def fold(line: int, held: Tuple[str, ...]) -> Tuple[str, ...]:
+            out = list(held)
+            for lk, lo, hi in self._flat:
+                if lo < line <= hi and lk not in out:
+                    out.append(lk)
+            return tuple(out)
+
+        for ev in self.blocks:
+            ev.held = fold(ev.line, ev.held)
+        for ev in self.calls:
+            ev.held = fold(ev.line, ev.held)
+        for ev in self.acquires:
+            # an acquire's own region must not mark it as held-before
+            ev.held = tuple(lk for lk in fold(ev.line, ev.held)
+                            if lk != ev.lock)

@@ -1,0 +1,230 @@
+"""The whole-program rules: H7 lock-order cycles, H8 blocking under a
+lock.
+
+Both run over the :class:`~sparkdl_tpu.analysis.callgraph.CallGraph`
+built from EVERY analyzed module at once — the failure modes they hunt
+(PR 2's collective-enqueue deadlock; a dispatcher lock held into a
+device drain) never live in one file.
+
+**H7 — lock-order-cycle.** Build the *acquired-while-holding* digraph:
+an edge ``A → B`` means some function acquires lock ``B`` (directly,
+or through any resolved call chain) while already holding ``A``. Any
+cycle in that graph is a deadlock schedule: thread 1 runs one edge's
+witness, thread 2 the other's, each stops inside the other's hold.
+The finding prints the full witness path module-by-module — which
+function held what at which line, and through which call chain the
+second lock is reached — because a deadlock report an operator cannot
+retrace is a number, not a diagnosis. This is exactly the shape of the
+PR-2 production deadlock (two trials enqueuing collective programs
+onto per-device FIFO queues in opposite orders), reconstructed as a
+fixture in ``tests/test_callgraph.py`` and required caught.
+
+**H8 — blocking-call-under-lock.** A lock held across a blocking
+operation — device sync (`timed_device_get` / `.block_until_ready()`),
+`Condition.wait`, `queue.get`, `time.sleep`, file/socket I/O, a
+thread join — serializes every other thread that touches that lock
+behind the slow operation, and is one late `notify` away from a hang.
+Flagged directly and transitively (a resolved callee that may block,
+with the chain printed). The dispatcher's intentional coalescing
+``Condition.wait`` (serve/batching.py) is allowlisted — the batching
+window IS the product there; everything else suppresses inline with a
+reason, per the H1–H6 grammar.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkdl_tpu.analysis.callgraph import CallGraph
+from sparkdl_tpu.analysis.findings import Finding
+from sparkdl_tpu.analysis.locks import FunctionFacts
+
+
+def short_lock(lock: str) -> str:
+    mod, _, attr = lock.partition("::")
+    mod = mod[len("sparkdl_tpu."):] if mod.startswith("sparkdl_tpu.") \
+        else mod
+    return f"{mod}:{attr}" if attr else mod
+
+
+# ---------------------------------------------------------------------------
+# H7 — lock-order cycles
+
+
+class _Edge:
+    """One acquired-while-holding edge with its human witness."""
+
+    __slots__ = ("held", "acquired", "path", "line", "qual", "chain")
+
+    def __init__(self, held: str, acquired: str, path: str, line: int,
+                 qual: str, chain: str):
+        self.held = held
+        self.acquired = acquired
+        self.path = path
+        self.line = line
+        self.qual = qual
+        self.chain = chain          # "" for a direct acquire
+
+
+def _collect_edges(graph: CallGraph) -> List[_Edge]:
+    edges: List[_Edge] = []
+    for key, f in graph.functions.items():
+        for acq in f.acquires:
+            for held in acq.held:
+                if held == acq.lock:
+                    continue
+                edges.append(_Edge(held, acq.lock, f.path, acq.line,
+                                   graph.short(key), ""))
+        for call in f.calls:
+            if not call.held:
+                continue
+            target = graph.resolve(f, call)
+            if target is None or target == key:
+                continue
+            for lock, chain in graph.may_acquire(target).items():
+                # held == lock is re-entry through a call chain — a
+                # plain Lock self-deadlocks; it becomes a 1-cycle
+                for held in call.held:
+                    edges.append(_Edge(
+                        held, lock, f.path, call.line,
+                        graph.short(key), " -> ".join(chain)))
+    return edges
+
+
+def _find_cycles(adj: Dict[str, Set[str]]) -> List[Tuple[str, ...]]:
+    """Simple cycles, deduped by their lock set, shortest first. DFS
+    with a bounded path (lock graphs here are tiny)."""
+    cycles: List[Tuple[str, ...]] = []
+    seen_sets: Set[frozenset] = set()
+    nodes = sorted(adj)
+
+    def dfs(start: str, node: str, path: List[str]):
+        if len(path) > 6:
+            return
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start and len(path) >= 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    # a self-loop is a real finding only as re-entry
+                    seen_sets.add(key)
+                    cycles.append(tuple(path))
+            elif nxt not in path and nxt > start:
+                # canonical start = smallest node keeps dedup cheap
+                dfs(start, nxt, path + [nxt])
+
+    for n in nodes:
+        # self loops (re-entry deadlocks)
+        if n in adj.get(n, ()):
+            key = frozenset((n,))
+            if key not in seen_sets:
+                seen_sets.add(key)
+                cycles.append((n,))
+        dfs(n, n, [n])
+    return cycles
+
+
+def check_h7(graph: CallGraph) -> List[Finding]:
+    edges = _collect_edges(graph)
+    adj: Dict[str, Set[str]] = {}
+    by_pair: Dict[Tuple[str, str], _Edge] = {}
+    for e in edges:
+        adj.setdefault(e.held, set()).add(e.acquired)
+        by_pair.setdefault((e.held, e.acquired), e)
+    findings: List[Finding] = []
+    for cycle in _find_cycles(adj):
+        steps = [(cycle[i], cycle[(i + 1) % len(cycle)])
+                 for i in range(len(cycle))]
+        witnesses = [by_pair[s] for s in steps if s in by_pair]
+        if not witnesses:
+            continue
+        first = witnesses[0]
+        lines = []
+        for (held, acquired), w in zip(steps, witnesses):
+            via = f" via {w.chain}" if w.chain else ""
+            lines.append(
+                f"[{w.qual} at {w.path}:{w.line}] holds "
+                f"{short_lock(held)} and acquires "
+                f"{short_lock(acquired)}{via}")
+        if len(cycle) == 1:
+            head = (f"lock re-entry deadlock on "
+                    f"{short_lock(cycle[0])} (a plain Lock is not "
+                    "reentrant)")
+        else:
+            ring = " -> ".join(short_lock(c) for c in cycle)
+            head = (f"lock-order cycle {ring} -> "
+                    f"{short_lock(cycle[0])}: two threads running "
+                    "these witnesses in parallel deadlock (the PR-2 "
+                    "collective-enqueue shape)")
+        findings.append(Finding(
+            rule="H7", path=first.path, line=first.line, col=0,
+            qualname=first.qual.partition(":")[2] or first.qual,
+            message=(head + "; witness: " + "; ".join(lines)
+                     + " (suppress: `# sparkdl-lint: allow[H7] -- "
+                       "<why this order is safe>`)")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# H8 — blocking calls while holding a lock
+
+
+def check_h8(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for key, f in graph.functions.items():
+        for b in f.blocks:
+            if not b.held:
+                continue
+            marker = (f.path, b.line, b.what)
+            if marker in seen:
+                continue
+            seen.add(marker)
+            findings.append(Finding(
+                rule="H8", path=f.path, line=b.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"{b.what} while holding "
+                    f"{_held_str(b.held)}: every thread contending "
+                    "that lock now waits out this operation too — "
+                    "move the blocking work outside the lock scope, "
+                    "or suppress with `# sparkdl-lint: allow[H8] -- "
+                    "<why the hold is the point>`")))
+        for call in f.calls:
+            if not call.held:
+                continue
+            target = graph.resolve(f, call)
+            if target is None or target == key:
+                continue
+            hit = graph.may_block(target)
+            if hit is None:
+                continue
+            chain, op = hit
+            marker = (f.path, call.line, chain)
+            if marker in seen:
+                continue
+            seen.add(marker)
+            findings.append(Finding(
+                rule="H8", path=f.path, line=call.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"`{call.display}(...)` may block "
+                    f"(transitively: {chain} reaches {op}) while "
+                    f"holding {_held_str(call.held)} — the lock "
+                    "serializes every contender behind that stall; "
+                    "narrow the lock scope or suppress with "
+                    "`# sparkdl-lint: allow[H8] -- <why>`")))
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings
+
+
+def _held_str(held: Tuple[str, ...]) -> str:
+    return ", ".join(short_lock(h) for h in held)
+
+
+#: the program-rule registry (walker.py runs these over the one
+#: CallGraph it builds per invocation; H9 lives in contracts.py
+#: because it needs the docs tree, not the call graph)
+PROGRAM_RULES = {
+    "H7": check_h7,
+    "H8": check_h8,
+}

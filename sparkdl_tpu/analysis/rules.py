@@ -645,6 +645,20 @@ _RULE_DOCS = {
           "names interpolating a request id (request_id/req_id/rid) "
           "banned — per-request values go to the RequestLog / "
           "exemplars / span args, never into metric names",
+    "H7": "lock-order cycles (whole-program): the acquired-while-"
+          "holding graph across every analyzed module must be acyclic "
+          "— any cycle is a deadlock schedule, reported with its "
+          "module-by-module witness path (the PR-2 collective-enqueue "
+          "shape)",
+    "H8": "blocking call under a lock (whole-program): device syncs, "
+          "Condition/Event waits, queue.get, time.sleep, file/socket "
+          "I/O, thread joins — direct or through any resolved call "
+          "chain — while a lock is held",
+    "H9": "contract drift: registry keys / span lanes / env vars / "
+          "/statusz fields the code publishes vs the docs tables "
+          "(docs/OBSERVABILITY.md, docs/SERVING.md, "
+          "docs/PERFORMANCE.md), BOTH directions — undocumented "
+          "publishes and documented-but-gone names both fail",
 }
 
 

@@ -53,7 +53,11 @@ class AllowEntry:
 
 
 #: The drain-path set: the ONLY places allowed to synchronize
-#: device→host without an inline justification.
+#: device→host without an inline justification — plus the two
+#: structurally-intentional holds the whole-program rules would
+#: otherwise flag (H8: the dispatcher's coalescing wait IS the
+#: batching window) and the measurement CLIs whose entire job is the
+#: banned operation.
 DEFAULT_ALLOWLIST: Dict[str, Tuple[AllowEntry, ...]] = {
     "H1": (
         AllowEntry(
@@ -66,6 +70,25 @@ DEFAULT_ALLOWLIST: Dict[str, Tuple[AllowEntry, ...]] = {
             "sparkdl_tpu/utils/measure.py", "",
             "measurement tools: forcing + timing transfers is their "
             "entire job (forced-sync methodology, VERDICT r1 weak #3)"),
+        AllowEntry(
+            "tools/measure_transfer.py", "",
+            "the (strategy x depth) sweep CLI: forcing + timing the "
+            "drain per configuration is its entire job — the "
+            "utils/measure precedent, in script form"),
+        AllowEntry(
+            "tools/train_testnet_artifact.py", "main",
+            "one-shot artifact trainer: the end-of-fit parameter "
+            "drain IS the artifact write (nothing downstream to "
+            "overlap with)"),
+    ),
+    "H8": (
+        AllowEntry(
+            "sparkdl_tpu/serve/batching.py", "RequestQueue.collect",
+            "the dispatcher's intentional Condition.wait: the "
+            "coalescing window IS the product (latency deliberately "
+            "traded for batch fill, docs/SERVING.md) — wait() "
+            "RELEASES the queue mutex while blocked, so producers "
+            "keep admitting; deadline clipping bounds the sleep"),
     ),
 }
 

@@ -1,4 +1,23 @@
-"""File walking + rule orchestration for sparkdl-lint."""
+"""File walking + rule orchestration for sparkdl-lint.
+
+Two layers of rules run per invocation:
+
+* **per-file** (H1–H6, :data:`~sparkdl_tpu.analysis.rules.RULES`) —
+  one AST pass each over each module; results (and the callgraph/lock
+  facts + published-surface extraction the program layer needs) are
+  cacheable per file by mtime+hash (:mod:`.cache`).
+* **whole-program** (H7/H8 over the
+  :class:`~sparkdl_tpu.analysis.callgraph.CallGraph`, H9 over the
+  merged published surface vs the repo docs) — always re-run, over
+  the cheap per-file facts; their verdicts depend on every analyzed
+  module at once.
+
+Suppression is uniform: every finding — per-file or program — that
+lands on a line of an analyzed python file honors the inline
+``# sparkdl-lint: allow[..] -- why`` grammar, and the allowlist
+applies everywhere. Doc-side H9 findings (a stale table row) anchor in
+the ``.md`` file and therefore only suppress via the allowlist.
+"""
 
 from __future__ import annotations
 
@@ -6,7 +25,15 @@ import ast
 import os
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
+from sparkdl_tpu.analysis import contracts
+from sparkdl_tpu.analysis.cache import ResultCache
+from sparkdl_tpu.analysis.callgraph import (
+    CallGraph,
+    ModuleFacts,
+    scan_module,
+)
 from sparkdl_tpu.analysis.findings import Finding
+from sparkdl_tpu.analysis.program import PROGRAM_RULES
 from sparkdl_tpu.analysis.rules import RULES
 from sparkdl_tpu.analysis.suppress import (
     AllowEntry,
@@ -16,6 +43,9 @@ from sparkdl_tpu.analysis.suppress import (
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules",
               "artifacts"}
+
+#: every rule the CLI's --rule accepts (per-file + whole-program)
+ALL_RULES = tuple(sorted(list(RULES) + list(PROGRAM_RULES) + ["H9"]))
 
 
 def iter_python_files(target: str) -> Iterator[str]:
@@ -33,14 +63,45 @@ def iter_python_files(target: str) -> Iterator[str]:
                 yield os.path.join(dirpath, name)
 
 
+def _file_findings(tree: ast.AST, path: str,
+                   wanted: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule in wanted:
+        if rule in RULES:
+            findings.extend(RULES[rule](tree, path))
+    return findings
+
+
+def _apply_suppressions(findings: List[Finding],
+                        indexes: Dict[str, SuppressionIndex],
+                        allowlist) -> None:
+    for f in findings:
+        f.suppressed = False
+        f.suppression = ""
+        index = indexes.get(f.path)
+        if index is not None:
+            inline = index.lookup(f.rule, f.line)
+            if inline is not None:
+                f.suppressed = True
+                f.suppression = f"inline -- {inline}"
+                continue
+        listed = allowlisted(f.rule, f.path, f.qualname, allowlist)
+        if listed is not None:
+            f.suppressed = True
+            f.suppression = listed
+
+
 def analyze_source(source: str, path: str,
                    rules: Optional[Iterable[str]] = None,
                    allowlist: Optional[Dict[str, Tuple[AllowEntry, ...]]]
                    = None) -> List[Finding]:
-    """Run the rule set over one module's source. Findings covered by
-    an inline ``# sparkdl-lint: allow[..]`` annotation or the
-    allowlist come back with ``suppressed=True`` and the justification
-    attached — they are reported, not hidden."""
+    """Run the PER-FILE rule set over one module's source (plus the
+    program rules when the module alone exhibits the hazard — a
+    single-module lock cycle or blocking hold is still whole-program
+    shaped, just with a one-module program). Findings covered by an
+    inline ``# sparkdl-lint: allow[..]`` annotation or the allowlist
+    come back with ``suppressed=True`` and the justification attached
+    — they are reported, not hidden."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -50,21 +111,15 @@ def analyze_source(source: str, path: str,
             message=f"file does not parse: {e.msg} (sparkdl-lint "
                     "cannot vouch for a module it cannot read)")]
     wanted = ([r.upper() for r in rules] if rules is not None
-              else list(RULES))
-    findings: List[Finding] = []
-    for rule in wanted:
-        findings.extend(RULES[rule](tree, path))
-    index = SuppressionIndex(source)
-    for f in findings:
-        inline = index.lookup(f.rule, f.line)
-        if inline is not None:
-            f.suppressed = True
-            f.suppression = f"inline -- {inline}"
-            continue
-        listed = allowlisted(f.rule, f.path, f.qualname, allowlist)
-        if listed is not None:
-            f.suppressed = True
-            f.suppression = listed
+              else list(ALL_RULES))
+    findings = _file_findings(tree, path, wanted)
+    if any(r in PROGRAM_RULES for r in wanted):
+        graph = CallGraph([scan_module(tree, path)])
+        for rule in wanted:
+            if rule in PROGRAM_RULES:
+                findings.extend(PROGRAM_RULES[rule](graph))
+    _apply_suppressions(findings, {path: SuppressionIndex(source)},
+                        allowlist)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -72,9 +127,27 @@ def analyze_source(source: str, path: str,
 def analyze_paths(targets: Sequence[str],
                   rules: Optional[Iterable[str]] = None,
                   allowlist: Optional[Dict[str, Tuple[AllowEntry, ...]]]
-                  = None) -> List[Finding]:
-    """Analyze every python file under each target path."""
+                  = None,
+                  cache_path: Optional[str] = None,
+                  docs_root: Optional[str] = None,
+                  cache_stats: Optional[dict] = None) -> List[Finding]:
+    """Analyze every python file under each target path: per-file
+    rules (cached by mtime+hash when ``cache_path`` is given), then
+    the whole-program passes (H7/H8 lock analysis over the combined
+    call graph; H9 contract drift against the repo docs when a
+    ``docs/`` tree governs the targets). ``cache_stats`` (a dict, when
+    given) receives the cache hit/miss accounting for CI gating."""
+    wanted = ([r.upper() for r in rules] if rules is not None
+              else list(ALL_RULES))
+    rules_key = ",".join(sorted(r for r in wanted if r in RULES))
+    cache = ResultCache(cache_path)
+
     findings: List[Finding] = []
+    indexes: Dict[str, SuppressionIndex] = {}
+    modules: List[ModuleFacts] = []
+    surface = contracts.CodeSurface()
+    file_paths: List[str] = []
+
     for target in targets:
         for path in iter_python_files(target):
             with open(path, encoding="utf-8") as f:
@@ -83,7 +156,46 @@ def analyze_paths(targets: Sequence[str],
             # (editor-clickable, stable across machines)
             rel = os.path.relpath(path)
             display = path if rel.startswith("..") else rel
-            findings.extend(analyze_source(source, display,
-                                           rules=rules,
-                                           allowlist=allowlist))
+            file_paths.append(display)
+            indexes[display] = SuppressionIndex(source)
+            cached = cache.lookup(display, path, source, rules_key)
+            if cached is not None:
+                file_f, facts, file_surface = cached
+                findings.extend(file_f)
+                modules.append(facts)
+                surface.merge(file_surface)
+                continue
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                findings.append(Finding(
+                    rule="PARSE", path=display, line=e.lineno or 1,
+                    col=(e.offset or 1) - 1,
+                    message=f"file does not parse: {e.msg} "
+                            "(sparkdl-lint cannot vouch for a module "
+                            "it cannot read)"))
+                continue
+            file_f = _file_findings(tree, display, wanted)
+            facts = scan_module(tree, display)
+            file_surface = contracts.extract_file_surface(display, tree)
+            findings.extend(file_f)
+            modules.append(facts)
+            surface.merge(file_surface)
+            cache.store(display, path, source, rules_key, file_f,
+                        facts, file_surface)
+
+    if any(r in PROGRAM_RULES for r in wanted) and modules:
+        graph = CallGraph(modules)
+        for rule in sorted(PROGRAM_RULES):
+            if rule in wanted:
+                findings.extend(PROGRAM_RULES[rule](graph))
+    if "H9" in wanted and file_paths:
+        findings.extend(contracts.check_surface(
+            surface, file_paths, docs_root=docs_root))
+
+    _apply_suppressions(findings, indexes, allowlist)
+    cache.save()
+    if cache_stats is not None:
+        cache_stats.update(cache.stats())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

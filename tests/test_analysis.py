@@ -4,7 +4,7 @@ Per rule: a positive fixture (deliberately broken code trips it), a
 negative fixture (idiomatic clean code passes), and a suppressed
 fixture (inline annotation downgrades without hiding). Plus the
 meta-test: the shipped package itself must analyze to ZERO unsuppressed
-findings — the gate tools/ci.sh step [5/5] enforces, pinned here so a
+findings — the gate tools/ci.sh step [10/11] enforces, pinned here so a
 regressing module fails the suite before it fails CI.
 """
 

@@ -1,0 +1,666 @@
+"""Whole-program analyzer tests: callgraph/lock-scope inference, the
+H7/H8 program rules (including the reconstructed PR-2 deadlock
+fixture), the H9 contract-drift round-trip, and the per-file result
+cache.
+
+Fixture style mirrors tests/test_analysis.py: deliberately broken
+multi-module trees under tmp_path trip the rules; idiomatic clean
+trees don't; inline suppressions downgrade without hiding. The PR-2
+fixture is the acceptance bar: the production deadlock this repo
+actually shipped (racing per-device collective enqueues under
+fitMultiple, fixed by collective_launch in PR 2) reconstructed as two
+modules whose witness path H7 must print module-by-module.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import sparkdl_tpu
+from sparkdl_tpu.analysis import analyze_paths, build_graph
+from sparkdl_tpu.analysis.callgraph import CallGraph, module_name
+from sparkdl_tpu.analysis.contracts import check_h9, names_overlap
+from sparkdl_tpu.analysis.walker import analyze_source
+
+PKG_DIR = os.path.dirname(os.path.abspath(sparkdl_tpu.__file__))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+
+
+def _tree(tmp_path, files: dict) -> str:
+    for name, src in files.items():
+        (tmp_path / name).write_text(src)
+    return str(tmp_path)
+
+
+def _unsup(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+def _sup(findings, rule):
+    return [f for f in findings if f.rule == rule and f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# callgraph + lock-scope inference
+
+
+class TestCallGraphInference:
+    def test_module_name_anchors_at_package(self):
+        assert module_name("sparkdl_tpu/serve/server.py") == \
+            "sparkdl_tpu.serve.server"
+        assert module_name("tools/measure_transfer.py") == \
+            "tools.measure_transfer"
+
+    def test_self_method_edge_resolves(self, tmp_path):
+        root = _tree(tmp_path, {"m.py": (
+            "class A:\n"
+            "    def outer(self):\n"
+            "        self.inner()\n"
+            "    def inner(self):\n"
+            "        pass\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        f = next(v for k, v in g.functions.items()
+                 if v.qualname == "A.outer")
+        call = next(c for c in f.calls if c.name == "inner")
+        assert g.resolve(f, call) is not None
+
+    def test_cross_module_import_edge_resolves(self, tmp_path):
+        root = _tree(tmp_path, {
+            "a.py": "from b import helper\n"
+                    "def caller():\n"
+                    "    helper()\n",
+            "b.py": "def helper():\n"
+                    "    pass\n"})
+        g = build_graph([os.path.join(root, "a.py"),
+                         os.path.join(root, "b.py")])
+        f = next(v for v in g.functions.values()
+                 if v.qualname == "caller")
+        call = next(c for c in f.calls if c.name == "helper")
+        assert g.resolve(f, call).endswith("b::helper")
+
+    def test_ambiguous_method_does_not_resolve(self, tmp_path):
+        """Two classes defining `run`: obj.run() must resolve to
+        NEITHER — a guessed edge would manufacture false deadlocks."""
+        root = _tree(tmp_path, {"m.py": (
+            "class A:\n"
+            "    def run(self):\n"
+            "        pass\n"
+            "class B:\n"
+            "    def run(self):\n"
+            "        pass\n"
+            "def drive(obj):\n"
+            "    obj.run()\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        f = next(v for v in g.functions.values()
+                 if v.qualname == "drive")
+        call = next(c for c in f.calls if c.name == "run")
+        assert g.resolve(f, call) is None
+
+    def test_with_lock_held_set_is_lexical(self, tmp_path):
+        root = _tree(tmp_path, {"m.py": (
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def __getstate__(self):\n"
+            "        return {}\n"
+            "    def locked(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n"
+            "    def unlocked(self):\n"
+            "        time.sleep(1)\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        by_qual = {v.qualname: v for v in g.functions.values()}
+        assert by_qual["A.locked"].blocks[0].held
+        assert not by_qual["A.unlocked"].blocks[0].held
+
+    def test_acquire_release_region_is_line_scoped(self, tmp_path):
+        root = _tree(tmp_path, {"m.py": (
+            "import threading, time\n"
+            "LOCK = threading.Lock()\n"
+            "def f():\n"
+            "    LOCK.acquire()\n"
+            "    time.sleep(1)\n"
+            "    LOCK.release()\n"
+            "    time.sleep(2)\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        f = next(v for v in g.functions.values() if v.qualname == "f")
+        held = {b.line: bool(b.held) for b in f.blocks}
+        assert held[5] is True      # inside acquire..release
+        assert held[7] is False     # after release
+
+    def test_try_acquire_is_not_an_acquire(self, tmp_path):
+        """acquire(blocking=False) cannot deadlock — the
+        checkout_staging idiom must produce no lock events."""
+        root = _tree(tmp_path, {"m.py": (
+            "import threading, time\n"
+            "LOCK = threading.Lock()\n"
+            "def f():\n"
+            "    got = LOCK.acquire(blocking=False)\n"
+            "    time.sleep(1)\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        f = next(v for v in g.functions.values() if v.qualname == "f")
+        assert f.acquires == []
+        assert not f.blocks[0].held
+
+    def test_condition_aliases_to_its_mutex(self, tmp_path):
+        """Condition(self._lock): `with self._cond` and `with
+        self._lock` are ONE lock — no false self-cycle between them."""
+        root = _tree(tmp_path, {"m.py": (
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cond = threading.Condition(self._lock)\n"
+            "    def __getstate__(self):\n"
+            "        return {}\n"
+            "    def f(self):\n"
+            "        with self._cond:\n"
+            "            pass\n")})
+        g = build_graph([os.path.join(root, "m.py")])
+        f = next(v for v in g.functions.values() if v.qualname == "Q.f")
+        assert f.acquires[0].lock.endswith("Q._lock")
+
+    def test_may_block_propagates_across_modules(self, tmp_path):
+        root = _tree(tmp_path, {
+            "a.py": "from b import drain\n"
+                    "def outer():\n"
+                    "    drain()\n",
+            "b.py": "import time\n"
+                    "def drain():\n"
+                    "    time.sleep(1)\n"})
+        g = build_graph([os.path.join(root, "a.py"),
+                         os.path.join(root, "b.py")])
+        key = next(k for k, v in g.functions.items()
+                   if v.qualname == "outer")
+        hit = g.may_block(key)
+        assert hit is not None
+        chain, op = hit
+        assert "drain" in chain and "sleep" in op
+
+
+# ---------------------------------------------------------------------------
+# H7 — lock-order cycles
+
+
+#: the PR-2 production deadlock, reconstructed: two trial launchers
+#: enqueue a collective (multi-device) program onto per-device FIFO
+#: queues in OPPOSITE orders — exactly the racing-enqueue shape
+#: collective_launch() serializes away (parallel/mesh.py).
+PR2_FIXTURE = {
+    "devqueues.py": (
+        "import threading\n"
+        "\n"
+        "# each XLA device executes its queue in FIFO order; the lock\n"
+        "# stands in for exclusive use of that queue's tail\n"
+        "DEV0_QUEUE = threading.Lock()\n"
+        "DEV1_QUEUE = threading.Lock()\n"),
+    "trial_a.py": (
+        "from devqueues import DEV0_QUEUE, DEV1_QUEUE\n"
+        "\n"
+        "def enqueue_collective(step):\n"
+        "    # device 0 first, then device 1\n"
+        "    with DEV0_QUEUE:\n"
+        "        with DEV1_QUEUE:\n"
+        "            step()\n"),
+    "trial_b.py": (
+        "from devqueues import DEV0_QUEUE, DEV1_QUEUE\n"
+        "\n"
+        "def enqueue_collective_racing(step):\n"
+        "    # the race: device 1 first — the all-reduce on device 0\n"
+        "    # now waits behind trial A while A waits behind us\n"
+        "    with DEV1_QUEUE:\n"
+        "        with DEV0_QUEUE:\n"
+        "            step()\n"),
+}
+
+
+class TestH7LockOrder:
+    def test_pr2_deadlock_fixture_is_caught_with_witness(self, tmp_path):
+        """THE acceptance fixture: the reconstructed PR-2 collective-
+        enqueue deadlock must be caught, and the finding must print
+        the cross-module witness path (both modules named, both
+        acquire sites located)."""
+        root = _tree(tmp_path, PR2_FIXTURE)
+        found = analyze_paths([root])
+        h7 = _unsup(found, "H7")
+        assert len(h7) == 1, [f.render() for f in found]
+        msg = h7[0].message
+        assert "lock-order cycle" in msg
+        # module-by-module: both trial modules appear in the witness,
+        # with their file:line acquire sites
+        assert "trial_a" in msg and "trial_b" in msg
+        assert "trial_a.py:5" in msg or "trial_a.py:6" in msg
+        assert "trial_b.py:7" in msg or "trial_b.py:8" in msg
+        assert "DEV0_QUEUE" in msg and "DEV1_QUEUE" in msg
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        fixture = dict(PR2_FIXTURE)
+        fixture["trial_b.py"] = fixture["trial_b.py"].replace(
+            "with DEV1_QUEUE:\n        with DEV0_QUEUE:",
+            "with DEV0_QUEUE:\n        with DEV1_QUEUE:")
+        root = _tree(tmp_path, fixture)
+        assert _unsup(analyze_paths([root]), "H7") == []
+
+    def test_transitive_cross_module_cycle(self, tmp_path):
+        """A serve-shaped lock held into collective_launch while the
+        launch holder calls back into a serve-lock taker: the cycle
+        exists only across the call graph."""
+        root = _tree(tmp_path, {
+            "mesh.py": (
+                "import threading\n"
+                "from serve import publish_status\n"
+                "LAUNCH_LOCK = threading.Lock()\n"
+                "def launch(program):\n"
+                "    with LAUNCH_LOCK:\n"
+                "        program()\n"
+                "        publish_status()\n"),
+            "serve.py": (
+                "import threading\n"
+                "from mesh import launch\n"
+                "STATUS_LOCK = threading.Lock()\n"
+                "def publish_status():\n"
+                "    with STATUS_LOCK:\n"
+                "        pass\n"
+                "def dispatch(program):\n"
+                "    with STATUS_LOCK:\n"
+                "        launch(program)\n")})
+        found = analyze_paths([root])
+        h7 = _unsup(found, "H7")
+        assert any("LAUNCH_LOCK" in f.message
+                   and "STATUS_LOCK" in f.message
+                   and "via" in f.message for f in h7), \
+            [f.render() for f in found]
+
+    def test_reentry_through_call_chain(self, tmp_path):
+        root = _tree(tmp_path, {"m.py": (
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def notify():\n"
+            "    with LOCK:\n"
+            "        pass\n"
+            "def work():\n"
+            "    with LOCK:\n"
+            "        notify()\n")})
+        h7 = _unsup(analyze_paths([root]), "H7")
+        assert any("re-entry" in f.message for f in h7)
+
+    def test_suppressed_with_reason(self, tmp_path):
+        # the finding anchors at the acquired-while-holding site: the
+        # INNER with of the first witness edge (trial_a holds DEV0,
+        # acquires DEV1)
+        fixture = dict(PR2_FIXTURE)
+        fixture["trial_a.py"] = fixture["trial_a.py"].replace(
+            "        with DEV1_QUEUE:\n",
+            "        # sparkdl-lint: allow[H7] -- fixture: order "
+            "proven safe by the global launch lock\n"
+            "        with DEV1_QUEUE:\n")
+        root = _tree(tmp_path, fixture)
+        found = analyze_paths([root])
+        assert _unsup(found, "H7") == []
+        sup = _sup(found, "H7")
+        assert len(sup) == 1
+        assert "proven safe" in sup[0].suppression
+
+
+# ---------------------------------------------------------------------------
+# H8 — blocking under a lock
+
+
+class TestH8BlockingUnderLock:
+    def test_direct_sleep_under_lock(self):
+        src = ("import threading, time\n"
+               "LOCK = threading.Lock()\n"
+               "def f():\n"
+               "    with LOCK:\n"
+               "        time.sleep(0.5)\n")
+        found = analyze_source(src, "fixture.py")
+        hits = _unsup(found, "H8")
+        assert len(hits) == 1
+        assert hits[0].line == 5
+        assert "time.sleep" in hits[0].message
+
+    def test_device_sync_under_lock(self):
+        src = ("import threading, jax\n"
+               "LOCK = threading.Lock()\n"
+               "def drain(res):\n"
+               "    with LOCK:\n"
+               "        return jax.device_get(res)\n")
+        assert len(_unsup(analyze_source(src, "fixture.py"), "H8")) == 1
+
+    def test_transitive_block_under_lock_cross_module(self, tmp_path):
+        """The lock is in one module, the blocking op two calls away
+        in another — the finding must print the chain."""
+        root = _tree(tmp_path, {
+            "holder.py": (
+                "import threading\n"
+                "from worker import do_work\n"
+                "LOCK = threading.Lock()\n"
+                "def guarded():\n"
+                "    with LOCK:\n"
+                "        do_work()\n"),
+            "worker.py": (
+                "from io_layer import fetch\n"
+                "def do_work():\n"
+                "    fetch()\n"),
+            "io_layer.py": (
+                "import urllib.request\n"
+                "def fetch():\n"
+                "    urllib.request.urlopen('http://x')\n")})
+        h8 = _unsup(analyze_paths([root]), "H8")
+        assert len(h8) >= 1
+        msg = next(f.message for f in h8 if "do_work" in f.message)
+        assert "fetch" in msg and "urlopen" in msg
+
+    def test_blocking_outside_lock_is_clean(self):
+        src = ("import threading, time\n"
+               "LOCK = threading.Lock()\n"
+               "def f():\n"
+               "    with LOCK:\n"
+               "        x = 1\n"
+               "    time.sleep(0.5)\n")
+        assert _unsup(analyze_source(src, "fixture.py"), "H8") == []
+
+    def test_queue_get_under_lock(self):
+        src = ("import threading\n"
+               "LOCK = threading.Lock()\n"
+               "def f(work_queue):\n"
+               "    with LOCK:\n"
+               "        return work_queue.get()\n")
+        assert len(_unsup(analyze_source(src, "fixture.py"), "H8")) == 1
+
+    def test_suppressed(self):
+        src = ("import threading, time\n"
+               "LOCK = threading.Lock()\n"
+               "def f():\n"
+               "    with LOCK:\n"
+               "        time.sleep(0.5)"
+               "  # sparkdl-lint: allow[H8] -- rate limiter: the hold"
+               " is the product\n")
+        found = analyze_source(src, "fixture.py")
+        assert _unsup(found, "H8") == []
+        assert len(_sup(found, "H8")) == 1
+
+    def test_meta_dispatcher_wait_is_allowlisted_not_invisible(self):
+        """The serve dispatcher's intentional coalescing
+        Condition.wait must APPEAR as a suppressed H8 finding (the
+        allowlist-not-skipped discipline, H1 precedent)."""
+        found = analyze_paths([os.path.join(PKG_DIR, "serve")])
+        h8 = [f for f in found if f.rule == "H8"]
+        assert any("RequestQueue.collect" in (f.qualname or "")
+                   for f in h8), [f.render() for f in h8]
+        assert all(f.suppressed for f in h8), \
+            [f.render() for f in h8 if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# H9 — contract drift
+
+
+class TestH9ContractDrift:
+    def test_fake_registry_key_names_the_doc_table(self, tmp_path):
+        """THE round-trip: inject an undocumented registry key and the
+        failure must name the doc table to edit."""
+        bad = tmp_path / "rogue.py"
+        bad.write_text(
+            "def publish(reg):\n"
+            "    reg.counter('zzz.totally_undocumented_key').add()\n")
+        found = analyze_paths([str(bad)], docs_root=REPO_ROOT)
+        h9 = _unsup(found, "H9")
+        assert len(h9) == 1, [f.render() for f in found]
+        assert "zzz.totally_undocumented_key" in h9[0].message
+        assert "docs/OBSERVABILITY.md" in h9[0].message \
+            or "docs/SERVING.md" in h9[0].message
+        assert str(bad.name) in h9[0].path
+
+    def test_documented_key_passes(self, tmp_path):
+        good = tmp_path / "ok.py"
+        good.write_text(
+            "def publish(reg):\n"
+            "    reg.counter('collective.launches').add()\n")
+        assert _unsup(analyze_paths([str(good)],
+                                    docs_root=REPO_ROOT), "H9") == []
+
+    def test_fstring_key_matches_wildcard_doc_row(self, tmp_path):
+        """`slo.{name}.burn_rate` must satisfy the documented
+        `slo.<objective>.burn_rate` row."""
+        good = tmp_path / "ok.py"
+        good.write_text(
+            "def publish(reg, name):\n"
+            "    reg.gauge(f'slo.{name}.burn_rate').set(1.0)\n")
+        assert _unsup(analyze_paths([str(good)],
+                                    docs_root=REPO_ROOT), "H9") == []
+
+    def test_undocumented_env_var_trips(self, tmp_path):
+        bad = tmp_path / "rogue.py"
+        bad.write_text(
+            "import os\n"
+            "def f():\n"
+            "    return os.environ.get('SPARKDL_TPU_NOT_A_REAL_KNOB')\n")
+        h9 = _unsup(analyze_paths([str(bad)], docs_root=REPO_ROOT),
+                    "H9")
+        assert len(h9) == 1
+        assert "SPARKDL_TPU_NOT_A_REAL_KNOB" in h9[0].message
+
+    def test_doc_side_stale_row_detected(self, tmp_path):
+        """A documented-but-gone registry key fails pointing at the
+        DOC row — exercised against a synthetic docs tree so the real
+        docs stay authoritative for the meta-test."""
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "OBSERVABILITY.md").write_text(
+            "| key | kind | meaning |\n"
+            "|-----|------|---------|\n"
+            "| `real.key` | counter | exists |\n"
+            "| `ghost.key` | counter | no longer published |\n")
+        (docs / "SERVING.md").write_text("nothing\n")
+        (docs / "PERFORMANCE.md").write_text("nothing\n")
+        # the doc-side direction only arms on a full-package view:
+        # the marker module is obs/registry.py
+        pkg = tmp_path / "obs"
+        pkg.mkdir()
+        reg = pkg / "registry.py"
+        reg.write_text(
+            "def publish(registry):\n"
+            "    registry.counter('real.key').add()\n")
+        found = analyze_paths([str(reg)], docs_root=str(tmp_path))
+        h9 = _unsup(found, "H9")
+        assert len(h9) == 1, [f.render() for f in found]
+        assert "ghost.key" in h9[0].message
+        assert h9[0].path.endswith("OBSERVABILITY.md")
+
+    def test_fixture_tree_without_docs_skips_h9(self, tmp_path):
+        bad = tmp_path / "rogue.py"
+        bad.write_text(
+            "def publish(reg):\n"
+            "    reg.counter('zzz.undocumented').add()\n")
+        # no docs_root and no docs/ up-tree from tmp: H9 must not run
+        assert _unsup(analyze_paths([str(bad)]), "H9") == []
+
+    def test_suppressed_with_reason(self, tmp_path):
+        bad = tmp_path / "rogue.py"
+        bad.write_text(
+            "def publish(reg):\n"
+            "    reg.counter('zzz.scratch_key').add()"
+            "  # sparkdl-lint: allow[H9] -- scratch key for a local "
+            "experiment, not a contract\n")
+        found = analyze_paths([str(bad)], docs_root=REPO_ROOT)
+        assert _unsup(found, "H9") == []
+        assert len(_sup(found, "H9")) == 1
+
+    def test_names_overlap_semantics(self):
+        assert names_overlap("serve.*", "serve.latency_p50_ms")
+        assert names_overlap("autotune.knob.*.*",
+                             "autotune.knob.*.*")
+        assert names_overlap("engine.stage.*.*",
+                             "engine.stage.*.seconds")
+        assert not names_overlap("serve.queue_rows", "ship.rows")
+        assert not names_overlap("serve", "serve.rows")
+
+
+# ---------------------------------------------------------------------------
+# the result cache
+
+
+class TestResultCache:
+    def _run(self, targets, cache):
+        stats: dict = {}
+        found = analyze_paths(targets, cache_path=cache,
+                              cache_stats=stats)
+        return found, stats
+
+    def test_second_run_hits_and_findings_match(self, tmp_path):
+        src = tmp_path / "m.py"
+        src.write_text("import jax\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        cache = str(tmp_path / "cache.json")
+        first, s1 = self._run([str(src)], cache)
+        second, s2 = self._run([str(src)], cache)
+        assert s1 == {**s1, "hits": 0, "misses": 1}
+        assert s2 == {**s2, "hits": 1, "misses": 0}
+        assert [f.render() for f in first] == \
+            [f.render() for f in second]
+
+    def test_touched_file_reanalyzes(self, tmp_path):
+        src = tmp_path / "m.py"
+        src.write_text("x = 1\n")
+        cache = str(tmp_path / "cache.json")
+        found, _ = self._run([str(src)], cache)
+        assert found == []
+        src.write_text("import jax\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        found, stats = self._run([str(src)], cache)
+        assert stats["misses"] == 1
+        assert len(_unsup(found, "H1")) == 1
+
+    def test_new_suppression_invalidates_via_hash(self, tmp_path):
+        """Adding an inline allow[] edits the file, so the hash keys a
+        fresh analysis — a cache must never pin a stale verdict."""
+        src = tmp_path / "m.py"
+        src.write_text("import jax\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        cache = str(tmp_path / "cache.json")
+        found, _ = self._run([str(src)], cache)
+        assert len(_unsup(found, "H1")) == 1
+        src.write_text("import jax\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)"
+                       "  # sparkdl-lint: allow[H1] -- test drain\n")
+        found, _ = self._run([str(src)], cache)
+        assert _unsup(found, "H1") == []
+        assert len(_sup(found, "H1")) == 1
+
+    def test_corrupt_cache_degrades_to_fresh_analysis(self, tmp_path):
+        src = tmp_path / "m.py"
+        src.write_text("import jax\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        cache = tmp_path / "cache.json"
+        cache.write_text("{ not json ]")
+        found, stats = self._run([str(src)], str(cache))
+        assert len(_unsup(found, "H1")) == 1
+        assert stats["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI --json schema (what the ci.sh analyzer gate consumes)
+
+
+class TestCliJson:
+    def test_json_schema_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading, time\n"
+                       "LOCK = threading.Lock()\n"
+                       "def f():\n"
+                       "    with LOCK:\n"
+                       "        time.sleep(1)\n")
+        env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+        r = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.analysis", "--json",
+             "--no-cache", str(bad)],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 1
+        d = json.loads(r.stdout)
+        for key in ("findings", "unsuppressed", "suppressed", "rules",
+                    "by_rule", "targets", "cache"):
+            assert key in d, sorted(d)
+        assert d["unsuppressed"] == 1
+        assert d["by_rule"]["H8"]["unsuppressed"] == 1
+        assert d["cache"]["enabled"] is False
+
+    def test_json_cache_stats_round_trip(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        cache = str(tmp_path / "c.json")
+        env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+        for expect_hits in (0, 1):
+            r = subprocess.run(
+                [sys.executable, "-m", "sparkdl_tpu.analysis",
+                 "--json", "--cache", cache, str(ok)],
+                capture_output=True, text=True, env=env)
+            assert r.returncode == 0, r.stderr
+            d = json.loads(r.stdout)
+            assert d["cache"]["hits"] == expect_hits
+
+    def test_list_rules_covers_all_nine(self):
+        env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+        r = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.analysis",
+             "--list-rules"],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 0
+        for rule in ("H1", "H2", "H3", "H4", "H5", "H6", "H7", "H8",
+                     "H9"):
+            assert f"{rule}:" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the package-level meta pins (nine rules, tools/examples included)
+
+
+class TestMetaNineRules:
+    def test_package_tools_examples_lint_clean_all_rules(self):
+        """THE acceptance gate: zero unsuppressed findings under all
+        nine rules across the package + tools/ + examples/."""
+        targets = [PKG_DIR]
+        for extra in ("tools", "examples"):
+            d = os.path.join(REPO_ROOT, extra)
+            if os.path.isdir(d):
+                targets.append(d)
+        found = analyze_paths(targets)
+        unsup = [f for f in found if not f.suppressed]
+        assert unsup == [], "\n".join(f.render() for f in unsup)
+
+    def test_real_package_has_no_h7_cycles(self):
+        found = analyze_paths([PKG_DIR])
+        assert _unsup(found, "H7") == [], \
+            [f.render() for f in _unsup(found, "H7")]
+
+    def test_native_build_hold_is_suppressed_not_invisible(self):
+        """The one real H8 the first whole-program run surfaced — the
+        native shim's g++ build under the load lock — must APPEAR as
+        a suppressed finding with its justification."""
+        found = analyze_paths([os.path.join(PKG_DIR, "native")])
+        h8 = [f for f in found if f.rule == "H8"]
+        assert any(f.suppressed and "g++" in f.suppression
+                   for f in h8), [f.render() for f in h8]
+
+    def test_collective_launch_is_one_lock_identity(self, tmp_path):
+        """`with collective_launch(mesh)` canonicalizes to ONE global
+        lock id wherever it is spelled — the PR-2 fix's ordering
+        point must not fragment per importing module."""
+        root = _tree(tmp_path, {
+            "a.py": ("from sparkdl_tpu.parallel.mesh import "
+                     "collective_launch\n"
+                     "def f(mesh, prog):\n"
+                     "    with collective_launch(mesh):\n"
+                     "        prog()\n")})
+        g = build_graph([os.path.join(root, "a.py")])
+        f = next(v for v in g.functions.values() if v.qualname == "f")
+        assert f.acquires[0].lock == "collective_launch"

@@ -15,9 +15,14 @@
 #      a bench refactor that drops pipeline_bound_by, a ceiling key,
 #      the host-copy counters, or the serve block (docs/SERVING.md)
 #      fails HERE instead of failing the next TPU round's driver
-#      parse. Runs under SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard
-#      enforces the aligned ship path's zero-copy claim at runtime,
-#      not just in the counters.
+#      parse. The FULL result is read from the bench result FILE
+#      (SPARKDL_TPU_BENCH_RESULT — bench.py's post-r05 contract); the
+#      stdout tail is separately gated to be the compact headline
+#      line (<1,500 chars, parseable, carrying result_path) the
+#      driver's 2,000-char tail window needs. Runs under
+#      SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard enforces the
+#      aligned ship path's zero-copy claim at runtime, not just in
+#      the counters.
 #   5. autotune gate (docs/PERFORMANCE.md): the smoke JSON's
 #      "autotune" block must show the closed-loop controller SETTLED
 #      — ≤2 knob changes after its settle window, zero oscillations —
@@ -53,8 +58,14 @@
 #      recovery /metricsz must scrape as valid Prometheus text.
 #  10. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
 #      H2 retrace, H3 locks, H4 quiesce, H5 clock discipline, H6
-#      metric cardinality) must report ZERO unsuppressed findings,
-#      plus the ruff baseline when installed
+#      metric cardinality, plus the whole-program passes H7 lock-order
+#      cycles / H8 blocking-under-lock / H9 docs contract drift) must
+#      report ZERO unsuppressed findings across the package AND
+#      tools/ + examples/, plus the ruff baseline when installed
+#  11. analyzer machine contract: `--json` output schema, and the
+#      per-file result cache's correctness — a cold run misses, a
+#      second run hits every file, a touched file (and only it)
+#      re-analyzes, with identical findings either way
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -70,7 +81,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/10] native shim build =="
+echo "== [1/11] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -79,13 +90,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/10] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/11] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/10] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/11] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/10] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/11] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -94,13 +105,31 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/10] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
-SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_smoke.json
+echo "== [4/11] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
+  SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
+  python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
 python - <<'EOF'
 import json
 
+# the driver-tail contract (the r05 lesson): the LAST stdout line must
+# be a compact headline that fits the driver's 2,000-char tail window
+# and points at the full result file
+with open("/tmp/sparkdl_bench_smoke_stdout.txt") as f:
+    tail = f.read().strip().splitlines()[-1]
+assert len(tail) < 1500, \
+    f"bench headline line is {len(tail)} chars (driver tail is 2,000)"
+head = json.loads(tail)
+for k in ("metric", "value", "unit", "vs_baseline", "result_path",
+          "schema_version"):
+    assert k in head, f"bench headline missing {k!r}: {sorted(head)}"
+assert head["result_path"] == "/tmp/sparkdl_bench_smoke.json", head
+
+# the FULL result comes from the file (SPARKDL_TPU_BENCH_RESULT)
 with open("/tmp/sparkdl_bench_smoke.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
+    d = json.load(f)
+# headline and full result must agree on the metric they headline
+assert head["metric"] == d["metric"] and head["value"] == d["value"]
 
 # Every key a round-over-round reader or the driver contract consumes.
 # Missing keys here mean the next TPU round's numbers silently lose a
@@ -156,12 +185,12 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/10] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/11] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
 with open("/tmp/sparkdl_bench_smoke.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
+    d = json.load(f)
 at = d["autotune"]
 required = ["armed", "strategy", "baseline_strategy", "baseline_ips",
             "tuned_ips", "noise_band_pct", "decisions",
@@ -195,18 +224,19 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/10] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/11] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/10] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/11] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
-  SPARKDL_TPU_BENCH_TINY=1 python bench.py > /tmp/sparkdl_bench_obs.json
+  SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
+  python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
 python - <<'EOF'
 import json
 
 with open("/tmp/sparkdl_bench_obs.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
+    d = json.load(f)
 obs = d["obs"]
 assert obs["trace_armed"] is True, obs
 assert isinstance(obs["trace_events"], int) and obs["trace_events"] > 0, obs
@@ -293,12 +323,12 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/10] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/11] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
 with open("/tmp/sparkdl_bench_smoke.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
+    d = json.load(f)
 # the tails block (docs/OBSERVABILITY.md): request p50/p99 from the
 # armed-request-log serve pass, with the p99 specimen attributed
 # across the named phases — a p99 an operator cannot attribute is a
@@ -403,7 +433,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/10] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/11] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -527,7 +557,71 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/10] static analysis (sparkdl-lint + ruff baseline) =="
-tools/lint.sh sparkdl_tpu
+echo "== [10/11] static analysis (sparkdl-lint + ruff baseline) =="
+# no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
+tools/lint.sh
+
+echo "== [11/11] analyzer machine contract (--json schema + cache correctness) =="
+rm -f /tmp/sparkdl_lint_ci_cache.json
+SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+
+env = dict(os.environ)
+
+
+def run_json(*extra):
+    r = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.analysis", "--json",
+         *extra, "sparkdl_tpu", "tools", "examples"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-2000:])
+    return json.loads(r.stdout)
+
+
+# --json schema: the machine contract CI and editors consume
+d1 = run_json()
+for key in ("findings", "unsuppressed", "suppressed", "rules",
+            "by_rule", "targets", "cache"):
+    assert key in d1, f"--json missing {key!r}: {sorted(d1)}"
+assert d1["unsuppressed"] == 0, d1["findings"]
+assert d1["suppressed"] > 0, "expected the known suppressed findings"
+assert set(d1["rules"]) >= {"H1", "H2", "H3", "H4", "H5", "H6",
+                            "H7", "H8", "H9"}, d1["rules"]
+for f in d1["findings"]:
+    for k in ("rule", "path", "line", "col", "message", "suppressed"):
+        assert k in f, (k, f)
+
+# cache correctness: cold run missed everything ...
+assert d1["cache"]["enabled"] is True, d1["cache"]
+assert d1["cache"]["hits"] == 0 and d1["cache"]["misses"] > 0, \
+    d1["cache"]
+
+# ... a second run hits every file with IDENTICAL findings ...
+d2 = run_json()
+assert d2["cache"]["misses"] == 0, d2["cache"]
+assert d2["cache"]["hits"] == d1["cache"]["misses"], \
+    (d1["cache"], d2["cache"])
+assert d2["unsuppressed"] == d1["unsuppressed"]
+assert d2["suppressed"] == d1["suppressed"]
+
+# ... and touching one file re-analyzes that file and only it
+victim = os.path.join("sparkdl_tpu", "serve", "batching.py")
+os.utime(victim)
+d3 = run_json()
+assert d3["cache"]["misses"] == 1, d3["cache"]
+assert d3["cache"]["hits"] == d2["cache"]["hits"] - 1, \
+    (d2["cache"], d3["cache"])
+assert d3["suppressed"] == d1["suppressed"]
+
+print(json.dumps({"analyzer_gate": "ok",
+                  "files": d1["cache"]["misses"],
+                  "suppressed": d1["suppressed"],
+                  "by_rule": {k: v for k, v in d1["by_rule"].items()
+                              if v["suppressed"]}}))
+EOF
 
 echo "== ci.sh: ALL GREEN =="

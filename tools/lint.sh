@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Static-analysis entry point: sparkdl-lint (the repo-specific
-# hot-path rules, docs/LINT.md) plus the generic ruff/mypy baseline
-# from pyproject.toml when those tools are installed (they are NOT
-# hard deps — the lint gate must be green from a fresh clone with no
+# hot-path rules H1-H6 plus the whole-program concurrency passes
+# H7-H9, docs/LINT.md) plus the generic ruff/mypy baseline from
+# pyproject.toml when those tools are installed (they are NOT hard
+# deps — the lint gate must be green from a fresh clone with no
 # network, so missing tools skip with a notice instead of failing).
 #
-# Usage: tools/lint.sh [paths...]        # default: sparkdl_tpu/
+# Usage: tools/lint.sh [paths...]   # default: sparkdl_tpu/ tools/
+#                                   #          examples/
 # Exit: non-zero iff sparkdl-lint finds an unsuppressed finding or an
 #       installed ruff/mypy reports errors.
 
@@ -13,9 +15,16 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-targets=("${@:-sparkdl_tpu}")
+if [ "$#" -eq 0 ]; then
+  # the default sweep covers everything the repo ships AND drives:
+  # the CLI scripts hold no locks, but they call the hot paths, and a
+  # deadlock witness that starts in an example is still a deadlock
+  targets=(sparkdl_tpu tools examples)
+else
+  targets=("$@")
+fi
 
-echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality) =="
+echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality / H7 lock cycles / H8 blocking-under-lock / H9 contract drift) =="
 python -m sparkdl_tpu.analysis "${targets[@]}"
 
 if command -v ruff >/dev/null 2>&1; then
