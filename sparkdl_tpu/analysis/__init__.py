@@ -69,6 +69,32 @@ mtime+hash so the ci.sh gate stays fast):
   an undocumented publish fails, and so does a documented-but-gone
   name.
 
+Three rules consume the whole-program **effect system**
+(``effects.py``: a bounded-depth transitive effect set per function —
+registry writes, spans, logging, clocks/RNG, transfers, I/O, lock
+acquires, mutation of captured state — with witness chains):
+
+* **H10 — effectful call reachable from jit**: any effect
+  transitively reachable from a ``jax.jit``/``pjit``-traced body
+  through resolved call edges, printed module-by-module; plus
+  mutable state (lists/dicts/instance attrs) captured into a jitted
+  function — the stale-value/retrace hazard the lexical H2 cannot
+  see.
+* **H11 — resource lifecycle**: an object whose class defines a
+  terminator (``close``/``quiesce``/``shutdown``/``disarm``) — plus
+  ``open()``/tempfile handles and obs-singleton ``arm()``s —
+  constructed in a scope must reach its terminator there or escape
+  (returned, stored, registered, passed on).
+* **H12 — exception-flow accounting** (``serve/``, ``obs/``,
+  ``runtime/``): an ``except`` that swallows — ``pass``, bare
+  ``continue``, or log-only — must record a failure counter/SLO
+  outcome on the handler path or carry an inline suppression (PR 7's
+  population-separation fix as a static invariant).
+
+CI annotation: ``--sarif out.sarif`` writes SARIF 2.1.0;
+``--changed-only`` (``tools/lint.sh --fast``) lints only
+git-dirty files for the pre-commit loop.
+
 Findings suppress inline with a justification::
 
     jax.device_get(x)  # sparkdl-lint: allow[H1] -- epoch-end drain
@@ -87,8 +113,10 @@ from sparkdl_tpu.analysis.callgraph import (
     build_graph,
     scan_module,
 )
+from sparkdl_tpu.analysis.effects import may_effect
 from sparkdl_tpu.analysis.findings import Finding, format_findings
 from sparkdl_tpu.analysis.rules import RULES, rule_doc
+from sparkdl_tpu.analysis.sarif import to_sarif, write_sarif
 from sparkdl_tpu.analysis.suppress import DEFAULT_ALLOWLIST, AllowEntry
 from sparkdl_tpu.analysis.walker import (
     ALL_RULES,
@@ -109,6 +137,9 @@ __all__ = [
     "build_graph",
     "format_findings",
     "iter_python_files",
+    "may_effect",
     "rule_doc",
     "scan_module",
+    "to_sarif",
+    "write_sarif",
 ]

@@ -12,7 +12,11 @@ deadlock witness that starts in an example is still a deadlock.
 ``--json`` emits the machine schema CI gates: findings, counts,
 per-rule totals, and the per-file cache's hit/miss accounting (the
 cache is on by default — ``SPARKDL_TPU_LINT_CACHE`` names the file,
-``--no-cache`` disables it).
+``--no-cache`` disables it). ``--sarif out.sarif`` additionally writes
+SARIF 2.1.0 for CI review annotation; ``--changed-only`` restricts the
+run to files ``git status --porcelain`` reports dirty (the
+``tools/lint.sh --fast`` pre-commit loop), falling back to a full run
+outside a checkout.
 """
 
 from __future__ import annotations
@@ -20,11 +24,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from sparkdl_tpu.analysis.cache import default_cache_path
 from sparkdl_tpu.analysis.findings import format_findings
 from sparkdl_tpu.analysis.rules import rule_doc
+from sparkdl_tpu.analysis.sarif import write_sarif
 from sparkdl_tpu.analysis.walker import ALL_RULES, analyze_paths
 
 
@@ -51,15 +57,81 @@ def _default_targets() -> list:
     return targets
 
 
+def _git_dirty_files(root: str):
+    """Paths ``git status --porcelain`` reports dirty/changed in the
+    checkout governing ``root``, or None when there is none (no git,
+    not a repo, timeout) — the caller falls back to a full run.
+    Porcelain paths are TOPLEVEL-relative (the package may sit in a
+    subdirectory of a larger repo), so the toplevel is resolved first;
+    ``-z`` keeps unusual filenames un-quoted."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        toplevel = top.stdout.strip()
+        proc = subprocess.run(
+            ["git", "status", "--porcelain", "-z"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out = []
+    records = proc.stdout.split("\0")
+    i = 0
+    while i < len(records):
+        rec = records[i]
+        i += 1
+        if len(rec) < 4:
+            continue
+        status, path = rec[:2], rec[3:]
+        if "R" in status or "C" in status:
+            # -z rename/copy: "XY new\0old" — the NEW path is in this
+            # record; the following record is the original, skip it
+            i += 1
+        if path.endswith(".py"):
+            out.append(os.path.join(toplevel, path))
+    return out
+
+
+def _changed_only_targets(targets: list) -> list:
+    """The dirty ``.py`` files inside ``targets``, for the fast
+    pre-commit loop. Returns ``targets`` unchanged (full run) when no
+    git checkout governs them. NOTE: the whole-program passes
+    (H7/H8/H10/H11) then see only the changed modules — cross-module
+    witnesses that START in an unchanged file wait for the full run
+    (docs/LINT.md)."""
+    root = os.path.dirname(_package_dir())
+    dirty = _git_dirty_files(root)
+    if dirty is None:
+        print("sparkdl-lint: --changed-only outside a git checkout; "
+              "running the full target set", file=sys.stderr)
+        return targets
+    abs_targets = [os.path.abspath(t) for t in targets]
+    picked = []
+    for path in dirty:
+        ap = os.path.abspath(path)
+        if not os.path.isfile(ap):
+            continue        # deleted files have nothing to lint
+        for t in abs_targets:
+            if ap == t or ap.startswith(t.rstrip(os.sep) + os.sep):
+                picked.append(ap)
+                break
+    return picked
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sparkdl_tpu.analysis",
         description="sparkdl-lint: enforce the hot-path invariants "
                     "(H1 transfers, H2 retrace, H3 locks, H4 quiesce, "
-                    "H5 clocks, H6 cardinality) plus the whole-program "
-                    "concurrency passes (H7 lock-order cycles, H8 "
-                    "blocking under a lock, H9 docs contract drift). "
-                    "Rule reference: docs/LINT.md")
+                    "H5 clocks, H6 cardinality, H12 exception-flow "
+                    "accounting) plus the whole-program passes (H7 "
+                    "lock-order cycles, H8 blocking under a lock, H9 "
+                    "docs contract drift, H10 jit-purity closure, H11 "
+                    "resource lifecycle). Rule reference: docs/LINT.md")
     parser.add_argument(
         "paths", nargs="*",
         help="files/directories to lint (default: the sparkdl_tpu "
@@ -84,6 +156,16 @@ def main(argv=None) -> int:
         help="cache file (default: SPARKDL_TPU_LINT_CACHE or a "
              "per-user temp file)")
     parser.add_argument(
+        "--sarif", metavar="PATH", default=None,
+        help="additionally write findings as SARIF 2.1.0 (CI forges "
+             "annotate them at file:line in review)")
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only files `git status --porcelain` reports "
+             "dirty/changed (the fast pre-commit loop, "
+             "tools/lint.sh --fast); falls back to a full run "
+             "outside a checkout")
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit")
     args = parser.parse_args(argv)
@@ -98,6 +180,26 @@ def main(argv=None) -> int:
         if not os.path.exists(t):
             print(f"sparkdl-lint: no such path: {t}", file=sys.stderr)
             return 2
+    if args.changed_only:
+        targets = _changed_only_targets(targets)
+        if not targets:
+            print("sparkdl-lint: --changed-only: nothing changed, "
+                  "nothing to lint", file=sys.stderr)
+            if args.sarif:
+                write_sarif(args.sarif, [],
+                            args.rules or list(ALL_RULES))
+            if args.json or args.format == "json":
+                # the machine contract holds on the empty run too — a
+                # consumer json.loads()ing stdout must never crash
+                print(json.dumps({
+                    "findings": [], "unsuppressed": 0, "suppressed": 0,
+                    "rules": sorted(args.rules) if args.rules
+                    else sorted(ALL_RULES),
+                    "by_rule": {}, "targets": [],
+                    "cache": {"enabled": not args.no_cache,
+                              "path": None, "hits": 0, "misses": 0},
+                }, indent=2))
+            return 0
 
     cache_path = None if args.no_cache else \
         (args.cache or default_cache_path())
@@ -106,6 +208,11 @@ def main(argv=None) -> int:
                              cache_path=cache_path,
                              cache_stats=cache_stats)
     unsuppressed = [f for f in findings if not f.suppressed]
+    if args.sarif:
+        n = write_sarif(args.sarif, findings,
+                        args.rules or list(ALL_RULES))
+        print(f"sparkdl-lint: wrote {n} SARIF result(s) to "
+              f"{args.sarif}", file=sys.stderr)
     fmt = "json" if args.json else args.format
     if fmt == "json":
         shown = [f for f in findings

@@ -33,7 +33,10 @@ from sparkdl_tpu.analysis.contracts import CodeSurface
 from sparkdl_tpu.analysis.findings import Finding
 
 #: bump when rule logic or fact shape changes — stale entries miss
-ANALYZER_VERSION = 4
+#: (v5: the effect-system facts — ModuleFacts.effects — joined the
+#: per-file schema; a version bump MUST force a cold re-analysis,
+#: pinned by tests/test_effects.py)
+ANALYZER_VERSION = 5
 
 
 def default_cache_path() -> str:

@@ -37,6 +37,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from sparkdl_tpu.analysis import effects as _effects
 from sparkdl_tpu.analysis.locks import (
     CallEvent,
     FunctionFacts,
@@ -89,13 +90,18 @@ class ModuleFacts:
     module_locks: List[str] = field(default_factory=list)
     #: per-function facts, keyed "module::Qual"
     facts: Dict[str, FunctionFacts] = field(default_factory=dict)
+    #: per-function effect facts (effects.py), same keys as ``facts``
+    effects: Dict[str, "_effects.FunctionEffects"] = \
+        field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"module": self.module, "path": self.path,
                 "imports": self.imports, "classes": self.classes,
                 "functions": self.functions,
                 "module_locks": self.module_locks,
-                "facts": {k: f.to_dict() for k, f in self.facts.items()}}
+                "facts": {k: f.to_dict() for k, f in self.facts.items()},
+                "effects": {k: e.to_dict()
+                            for k, e in self.effects.items()}}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ModuleFacts":
@@ -106,6 +112,8 @@ class ModuleFacts:
                  module_locks=list(d.get("module_locks", [])))
         mf.facts = {k: FunctionFacts.from_dict(v)
                     for k, v in d["facts"].items()}
+        mf.effects = {k: _effects.FunctionEffects.from_dict(v)
+                      for k, v in d.get("effects", {}).items()}
         return mf
 
 
@@ -125,13 +133,24 @@ def _collect_imports(tree: ast.Module) -> Dict[str, str]:
 
 def scan_module(tree: ast.Module, path: str,
                 module: Optional[str] = None) -> ModuleFacts:
-    """One parsed module → its serializable program-analysis facts."""
+    """One parsed module → its serializable program-analysis facts
+    (call/lock facts for H7/H8 plus the effect/jit/capture/resource
+    facts the H10/H11 effect system runs on)."""
     module = module or module_name(path)
     mf = ModuleFacts(module=module, path=path)
     mf.imports = _collect_imports(tree)
     locks: ModuleLocks = discover_locks(tree, module)
+    #: class -> instance attrs bound to mutable containers (the
+    #: capture analysis consults the ENCLOSING class of a jitted fn)
+    cls_mutables: Dict[str, set] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls_mutables[node.name] = _effects.mutable_class_attrs(node)
+    #: def name -> fact keys (resolves `jax.jit(step)` call forms)
+    name_keys: Dict[str, List[str]] = {}
 
-    def scan_fn(fn, qualname: str, cls: Optional[str]):
+    def scan_fn(fn, qualname: str, cls: Optional[str],
+                enclosing_mutables: Dict[str, int]):
         scanner = FunctionScanner(module, path, cls, qualname, locks,
                                   mf.imports)
         scanner.scan(fn)
@@ -140,23 +159,89 @@ def scan_module(tree: ast.Module, path: str,
             key=key, module=module, path=path, qualname=qualname,
             line=fn.lineno, acquires=scanner.acquires,
             blocks=scanner.blocks, calls=scanner.calls)
+        fe = _effects.FunctionEffects(key=key)
+        eff = _effects.EffectScanner(qualname, mf.imports,
+                                     cls_mutables.get(cls or "", set()))
+        fe.effects = eff.scan(fn)
+        fe.resources = _effects._ResourceTracker(fn, qualname).run(
+            mf.imports)
+        fe.captures = _effects.scan_captures(
+            fn, cls_mutables.get(cls or "", set()), enclosing_mutables)
+        if any(_effects._is_jit_decorator(d)
+               for d in getattr(fn, "decorator_list", ())):
+            fe.jitted = True
+            fe.jit_line = fn.lineno
+        mf.effects[key] = fe
+        name_keys.setdefault(fn.name, []).append(key)
 
-    def walk_defs(body, prefix: str, cls: Optional[str]):
+    def iter_defs(body):
+        """Def/class statements anywhere in ``body``, descending into
+        compound statements (for/if/with/try/match) but never into
+        another def or class — a jitted step defined inside an epoch
+        loop (the streaming-estimator idiom) is still THIS scope's
+        def."""
         for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                yield node
+                continue
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    yield from iter_defs([child])
+                elif isinstance(child, ast.ExceptHandler):
+                    yield from iter_defs(child.body)
+                elif isinstance(child, ast.match_case):
+                    yield from iter_defs(child.body)
+
+    def walk_defs(body, prefix: str, cls: Optional[str],
+                  enclosing_mutables: Dict[str, int]):
+        for node in iter_defs(body):
             if isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
                 qual = f"{prefix}{node.name}" if prefix else node.name
-                scan_fn(node, qual, cls)
-                # nested defs get their own facts under a dotted qual
-                walk_defs(node.body, qual + ".", cls)
+                scan_fn(node, qual, cls, enclosing_mutables)
+                # nested defs get their own facts under a dotted qual;
+                # their capture analysis sees THIS function's mutable
+                # local bindings
+                walk_defs(node.body, qual + ".", cls,
+                          _effects._local_mutable_bindings(node))
             elif isinstance(node, ast.ClassDef):
                 methods = [m.name for m in node.body
                            if isinstance(m, (ast.FunctionDef,
                                              ast.AsyncFunctionDef))]
                 mf.classes[node.name] = methods
-                walk_defs(node.body, node.name + ".", node.name)
+                cls_mutables.setdefault(
+                    node.name, _effects.mutable_class_attrs(node))
+                walk_defs(node.body, node.name + ".", node.name, {})
 
-    walk_defs(tree.body, "", None)
+    walk_defs(tree.body, "", None, {})
+    # jit call forms: jax.jit(step), partial(jax.jit, ...)(step) —
+    # mark the named def(s) as jit roots (same resolution as H2)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _effects._jit_call(node):
+            args = node.args
+            if _effects._dotted(node.func) in _effects._PARTIAL_NAMES:
+                args = args[1:]
+        elif isinstance(node.func, ast.Call) and \
+                _effects._jit_call(node.func):
+            # partial(jax.jit, ...)(step): the OUTER call's args hold
+            # the traced function
+            args = node.args
+        else:
+            continue
+        for arg in args:
+            if isinstance(arg, ast.Name):
+                for key in name_keys.get(arg.id, ()):
+                    mf.effects[key].jitted = True
+                    mf.effects[key].jit_line = \
+                        mf.effects[key].jit_line or node.lineno
+    # captures only mean anything at a jit boundary — dropping the
+    # rest keeps the serialized facts (and the result cache) lean
+    for fe in mf.effects.values():
+        if not fe.jitted:
+            fe.captures = []
     mf.functions = [mf.facts[q].qualname for q in mf.facts
                     if "." not in mf.facts[q].qualname]
     mf.module_locks = sorted(locks.module_locks)

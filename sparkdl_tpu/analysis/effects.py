@@ -1,0 +1,944 @@
+"""Whole-program effect inference for the H10–H12 rules.
+
+The paper's ``TFInputGraph``/``IsolatedSession`` design exists because
+graph-boundary violations — hidden side effects crossing into a
+compiled graph — are the dominant failure class in pipeline
+frameworks, and tf.data (PAPERS.md) makes the same argument for input
+pipelines: correctness tooling must see *through* the call graph, not
+just at each call site. The per-file H2 rule is lexical (it flags a
+``time.time()`` written literally inside a jit body); this module
+closes the gap by computing, over the PR-8 call graph, a
+bounded-depth **transitive effect set** per function, with recorded
+witness chains like ``may_block`` has:
+
+* **direct effects** (:class:`EffectEvent`, one AST pass per
+  function): registry writes (``counter``/``gauge``/``reservoir``
+  factories), tracer spans + watchdog beats, logging, wall-clock
+  reads and ``time.sleep``, stateful host RNG, host↔device transfers,
+  file/socket/subprocess I/O, and Python-object mutation of captured
+  state (``self.X`` writes, mutating method calls on ``self``-rooted
+  receivers, writes to ``global``/``nonlocal`` names). Lock acquires
+  already live in :class:`~sparkdl_tpu.analysis.locks.FunctionFacts`
+  and join the closure from there.
+* **jit roots**: functions compiled by ``jax.jit``/``pjit`` —
+  decorator, ``partial(jax.jit, ...)``, or ``jax.jit(name)`` call
+  forms, same resolution contract as H2 — marked at scan time so the
+  program pass knows where a compiled-graph boundary starts.
+* **mutable captures** (:class:`CaptureEvent`): a jitted function
+  reading ``self.X`` where the class binds ``X`` to a list/dict/set,
+  or a closure variable its *enclosing* function binds to a mutable
+  literal — the stale-value/silent-retrace hazard H2 cannot see
+  (tracing bakes the captured value in; later mutation either goes
+  unseen or forces a retrace, depending on how it enters the trace).
+* **resource events** (:class:`ResourceEvent`): ``x = Ctor(...)``
+  where ``Ctor`` resolves (cross-module, through the symbol table) to
+  a class defining a terminator (``close``/``quiesce``/``shutdown``/
+  ``disarm``), plus builtin handle ctors (``open``,
+  ``tempfile.NamedTemporaryFile``, ``socket.socket``) and obs-singleton
+  ``.arm()`` calls — each with lexical *terminated* / *escaped*
+  verdicts (returned, stored on ``self`` or a global, subscripted into
+  a container, yielded, or passed to another function all count as
+  escapes: ownership moved, some other scope terminates it).
+
+Three rules consume the facts:
+
+* **H10 — effectful call reachable from jit**: any effect reachable
+  from a jit root through resolved call edges (``self.m()``, bare
+  names, ``mod.f()`` — the unique-method heuristic is deliberately
+  NOT followed here: a jit body calling ``opt.update(...)`` usually
+  targets a class *outside* the analyzed set, and a guessed in-repo
+  edge would manufacture false impurity), plus direct in-body effects
+  of the kinds H2's lexical pass does not cover (registry, mutation,
+  transfer, I/O, lock acquires), plus mutable captures. The witness
+  chain prints module-by-module.
+* **H11 — resource lifecycle**: a tracked resource constructed in a
+  scope must reach its terminator on the scope's normal paths or
+  escape; otherwise the finding names the terminator to call (or the
+  ``with`` form to use).
+* **H12 — exception-flow accounting** lives in ``rules.py`` (it is a
+  per-file pass) but is documented with these two because the three
+  ship as one effect-system PR.
+
+Everything here is plain-data serializable: the per-function effect
+facts ride the PR-8 per-file result cache (``ModuleFacts.effects``;
+the facts schema version in ``cache.py`` is bumped whenever this
+shape changes, which forces the cold re-analysis the cache tests pin).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkdl_tpu.analysis.findings import Finding
+
+#: transitive effect-closure depth bound — same rationale as
+#: callgraph.MAX_DEPTH (deep enough for every real chain, bounded so a
+#: pathological cycle costs nothing)
+MAX_DEPTH = 8
+
+# ---------------------------------------------------------------------------
+# shared helpers (kept local: effects must stay importable from
+# callgraph.scan_module without a cycle)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit",
+              "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _jit_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if name in _JIT_NAMES:
+        return True
+    if name in _PARTIAL_NAMES and call.args:
+        return _dotted(call.args[0]) in _JIT_NAMES
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _dotted(dec) in _JIT_NAMES:
+        return True
+    return isinstance(dec, ast.Call) and _jit_call(dec)
+
+
+# ---------------------------------------------------------------------------
+# events
+
+
+@dataclass
+class EffectEvent:
+    """One direct effect: ``what`` is the human description."""
+
+    what: str
+    kind: str                  # one of EFFECT_KINDS
+    line: int
+
+
+@dataclass
+class CaptureEvent:
+    """Mutable state captured into a jit-traced body."""
+
+    name: str                  # "self.history" / "accum"
+    kind: str                  # "instance-attr" | "closure"
+    line: int
+
+
+@dataclass
+class ResourceEvent:
+    """One tracked resource construction (or singleton arm) with the
+    scanner's lexical lifecycle verdict."""
+
+    var: str
+    ctor: str                  # display name ("ModelServer", "open")
+    line: int
+    kind: str                  # "ctor" | "open" | "arm"
+    terminated: bool = False
+    escaped: bool = False
+    #: resolved dotted import source for "ctor" kind ("" when local)
+    import_src: str = ""
+
+
+@dataclass
+class FunctionEffects:
+    """The serializable per-function effect summary."""
+
+    key: str                   # "module::Qual" (same key as facts)
+    jitted: bool = False
+    jit_line: int = 0
+    effects: List[EffectEvent] = field(default_factory=list)
+    captures: List[CaptureEvent] = field(default_factory=list)
+    resources: List[ResourceEvent] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key, "jitted": self.jitted,
+            "jit_line": self.jit_line,
+            "effects": [[e.what, e.kind, e.line] for e in self.effects],
+            "captures": [[c.name, c.kind, c.line]
+                         for c in self.captures],
+            "resources": [[r.var, r.ctor, r.line, r.kind,
+                           r.terminated, r.escaped, r.import_src]
+                          for r in self.resources],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FunctionEffects":
+        fe = cls(key=d["key"], jitted=d["jitted"],
+                 jit_line=d.get("jit_line", 0))
+        fe.effects = [EffectEvent(e[0], e[1], e[2])
+                      for e in d["effects"]]
+        fe.captures = [CaptureEvent(c[0], c[1], c[2])
+                       for c in d["captures"]]
+        fe.resources = [ResourceEvent(r[0], r[1], r[2], r[3], r[4],
+                                      r[5], r[6])
+                        for r in d["resources"]]
+        return fe
+
+
+#: every effect kind the closure tracks, with the one-line reading the
+#: H10 message leans on
+EFFECT_KINDS = {
+    "registry": "metrics-registry write",
+    "trace": "tracer span / watchdog beat",
+    "log": "logging",
+    "clock": "wall-clock read / sleep",
+    "rng": "stateful host RNG",
+    "transfer": "host<->device transfer",
+    "io": "file/socket/subprocess I/O",
+    "mutation": "mutation of captured Python state",
+    "lock": "lock acquisition",
+}
+
+
+# ---------------------------------------------------------------------------
+# direct-effect classification
+
+_REGISTRY_FACTORIES = {"counter", "gauge", "reservoir"}
+_TRACE_NAMES = {"span", "watchdog_watch"}
+_TRACE_ATTRS = {"span", "pulse"}
+_LOG_RECEIVERS = {"logger", "log", "logging"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_LOG_NAMES = {"print", "warn_once"}
+_CLOCK_DOTTED = {"time.time", "time.perf_counter", "time.monotonic",
+                 "time.sleep", "datetime.now", "datetime.utcnow",
+                 "datetime.datetime.now", "datetime.datetime.utcnow"}
+_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_RNG_DOTTED = {"os.urandom"}
+_TRANSFER_DOTTED = {"jax.device_get", "jax.device_put",
+                    "jax.block_until_ready", "timed_device_get"}
+_TRANSFER_ATTRS = {"block_until_ready", "timed_device_get",
+                   "device_put", "device_get"}
+_IO_DOTTED = {"open", "input", "socket.create_connection",
+              "urllib.request.urlopen", "subprocess.run",
+              "subprocess.check_output", "subprocess.check_call",
+              "subprocess.Popen", "os.remove", "os.replace",
+              "os.unlink", "os.makedirs", "shutil.rmtree",
+              "shutil.copy", "shutil.move"}
+_IO_ATTRS = {"recv", "accept", "communicate", "sendall"}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault",
+             "clear", "pop", "popleft", "add", "discard", "remove",
+             "appendleft"}
+
+#: literal / ctor forms that bind a MUTABLE value (the capture
+#: analysis and the class mutable-attr table share this test)
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "deque",
+                  "collections.deque", "defaultdict",
+                  "collections.defaultdict", "OrderedDict",
+                  "collections.OrderedDict", "Counter",
+                  "collections.Counter"}
+
+
+def _is_mutable_value(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) in _MUTABLE_CTORS
+    return False
+
+
+def classify_effect(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(description, kind) when this call IS a direct effect."""
+    name = _dotted(call.func)
+    attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else None
+    if attr in _REGISTRY_FACTORIES:
+        return (f"registry `{attr}(...)` write", "registry")
+    if name in _TRACE_NAMES or attr in _TRACE_ATTRS:
+        return (f"`{name or attr}(...)` tracer/watchdog effect",
+                "trace")
+    if name in _LOG_NAMES:
+        return (f"`{name}(...)`", "log")
+    if attr in _LOG_METHODS and isinstance(call.func.value,
+                                           (ast.Name, ast.Attribute)):
+        recv = (_dotted(call.func.value) or "").rsplit(".", 1)[-1]
+        if recv.lower() in _LOG_RECEIVERS or "logger" in recv.lower():
+            return (f"`{recv}.{attr}(...)` logging", "log")
+    if name in _CLOCK_DOTTED:
+        return (f"`{name}()`", "clock")
+    if name in _RNG_DOTTED or (name and
+                               name.startswith(_RNG_PREFIXES)):
+        return (f"`{name}(...)` stateful host RNG", "rng")
+    if name in _TRANSFER_DOTTED or attr in _TRANSFER_ATTRS:
+        return (f"`{name or attr}(...)` host<->device transfer",
+                "transfer")
+    if name in _IO_DOTTED or (name and name.endswith(".open")):
+        return (f"`{name}(...)` I/O", "io")
+    if attr in _IO_ATTRS:
+        return (f"`.{attr}(...)` I/O", "io")
+    if name == "warnings.warn":
+        return ("`warnings.warn(...)`", "log")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# class / scope pre-passes
+
+
+def mutable_class_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Instance attrs the class binds to a mutable container
+    (``self.X = []`` / ``{}`` / ``deque()`` anywhere in a method)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_mutable_value(
+                node.value):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.add(tgt.attr)
+    return out
+
+
+def _local_mutable_bindings(fn: ast.AST) -> Dict[str, int]:
+    """``name -> line`` for names this function binds to a mutable
+    literal/ctor OUTSIDE its nested defs — what a nested jitted def
+    would capture by closure."""
+    out: Dict[str, int] = {}
+
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign) and _is_mutable_value(
+                    stmt.value):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, stmt.lineno)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    walk([child])
+                elif isinstance(child, (ast.ExceptHandler,)):
+                    walk(child.body)
+
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    walk(body)
+    return out
+
+
+def _walk_scope(stmts):
+    """Yield nodes WITHOUT descending into nested def/class bodies
+    (``ast.walk`` has no pruning): the scope's own statements only.
+    The nested def node itself IS yielded — the escape checks need to
+    see it — but what happens inside it belongs to that function's
+    own scan, not this one's."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return set()
+    names = {a.arg for a in args.args + args.kwonlyargs
+             + args.posonlyargs}
+    for special in (args.vararg, args.kwarg):
+        if special is not None:
+            names.add(special.arg)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# resource lifecycle bookkeeping
+
+#: methods whose presence makes a class a tracked resource, and whose
+#: call on the variable counts as reaching the terminator
+TERMINATORS = ("close", "quiesce", "shutdown", "disarm")
+
+#: builtin handle constructors tracked even without an analyzed class
+_HANDLE_CTORS = {"open", "tempfile.NamedTemporaryFile",
+                 "tempfile.TemporaryFile", "socket.socket"}
+
+#: obs singleton factories whose ``.arm()`` opens a disarm lifecycle
+ARM_FACTORIES = {"tracer", "watchdog", "recorder", "request_log",
+                 "controller"}
+
+#: context managers that adopt the resource (``with closing(x):``)
+_ADOPTING_CMS = {"closing", "contextlib.closing", "ExitStack"}
+
+
+class _ResourceTracker:
+    """Per-function lexical lifecycle analysis: candidate constructions
+    first, then a termination/escape sweep over the same body."""
+
+    def __init__(self, fn: ast.AST, qualname: str):
+        self.fn = fn
+        self.qualname = qualname
+        self.events: List[ResourceEvent] = []
+        self._by_var: Dict[str, ResourceEvent] = {}
+        self._globals: Set[str] = set()
+        #: local var -> arm-factory name (``wd = watchdog()``)
+        self._arm_vars: Dict[str, str] = {}
+
+    def run(self, imports: Dict[str, str]) -> List[ResourceEvent]:
+        body = self.fn.body if isinstance(self.fn.body, list) \
+            else [self.fn.body]
+        self._collect(body, imports)
+        self._collect_arms(body)
+        if self._by_var:
+            self._sweep(body)
+        return self.events
+
+    # -- candidate collection ------------------------------------------------
+
+    def _collect(self, stmts, imports: Dict[str, str]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Global):
+                self._globals.update(stmt.names)
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self._candidate(stmt.targets[0].id, stmt.value,
+                                stmt.lineno, imports)
+            # `with Ctor() as x:` is its own termination — never a
+            # candidate; `with open(..) as f` likewise
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self._collect([child], imports)
+                elif isinstance(child, ast.ExceptHandler):
+                    self._collect(child.body, imports)
+                elif isinstance(child, ast.match_case):
+                    self._collect(child.body, imports)
+
+    def _candidate(self, var: str, call: ast.Call, line: int,
+                   imports: Dict[str, str]):
+        name = _dotted(call.func)
+        if name is None:
+            return
+        if name.rsplit(".", 1)[-1] in ARM_FACTORIES:
+            self._arm_vars[var] = name.rsplit(".", 1)[-1]
+            return
+        if name in _HANDLE_CTORS:
+            ev = ResourceEvent(var, name, line, "open")
+        else:
+            last = name.rsplit(".", 1)[-1]
+            if not last[:1].isupper():
+                return      # ctor heuristic: classes are CapWords
+            src = imports.get(name.split(".")[0], "")
+            if "." in name and src:
+                src = f"{src}.{last}"
+            elif src:
+                pass        # from-import: src is already pkg.mod.Class
+            ev = ResourceEvent(var, last, line, "ctor",
+                               import_src=src)
+        # a rebound name tracks its LAST construction (the earlier one
+        # is a separate leak this lexical pass does not chase)
+        self._by_var[var] = ev
+        self.events.append(ev)
+
+    def _collect_arms(self, body):
+        """``wd.arm(...)`` on an arm-factory var, or the direct
+        ``watchdog().arm(...)`` form, opens a disarm lifecycle. An arm
+        inside a NESTED def belongs to that function's own scan — this
+        walk prunes def bodies."""
+        for node in _walk_scope(body):
+            if not (isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute)
+                    and node.func.attr == "arm"):
+                continue
+            recv = node.func.value
+            var = factory = None
+            if isinstance(recv, ast.Name) and \
+                    recv.id in self._arm_vars:
+                var, factory = recv.id, self._arm_vars[recv.id]
+            elif isinstance(recv, ast.Call):
+                name = (_dotted(recv.func) or "").rsplit(".", 1)[-1]
+                if name in ARM_FACTORIES:
+                    var, factory = f"{name}()", name
+            if var is None:
+                continue
+            ev = ResourceEvent(var, factory, node.lineno, "arm")
+            self._by_var.setdefault(var, ev)
+            self.events.append(ev)
+
+    # -- termination / escape sweep ------------------------------------------
+
+    def _names_in(self, node: ast.AST) -> Set[str]:
+        """Names in ``node`` EXCLUDING method-call receivers:
+        ``return s.submit(x)`` returns submit's result, not ``s`` —
+        the receiver position is use, never escape."""
+        receivers = {id(n.func.value) for n in ast.walk(node)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Attribute)
+                     and isinstance(n.func.value, ast.Name)}
+        return {n.id for n in ast.walk(node)
+                if isinstance(n, ast.Name) and id(n) not in receivers}
+
+    def _sweep(self, stmts):
+        tracked = set(self._by_var)
+        for ev in self._by_var.values():
+            if ev.var in self._globals:
+                ev.escaped = True   # stored in module state
+        for node in _walk_scope(stmts):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                # a nested def capturing the var keeps it alive in
+                # a scope this pass cannot see — treat as escape;
+                # _walk_scope does NOT descend into it, so a
+                # terminator inside a (maybe never-called) nested
+                # def cannot silence the outer scope's verdict
+                for name in self._names_in(node) & tracked:
+                    self._by_var[name].escaped = True
+                continue
+            if isinstance(node, ast.Return) and node.value:
+                for name in self._names_in(node.value) & tracked:
+                    self._by_var[name].escaped = True
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                    and node.value:
+                for name in self._names_in(node.value) & tracked:
+                    self._by_var[name].escaped = True
+            elif isinstance(node, ast.Assign):
+                value_names = self._names_in(node.value) & tracked
+                if not value_names:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute,
+                                        ast.Subscript)):
+                        # self.x = srv / registry[k] = srv:
+                        # ownership moved to longer-lived state
+                        for name in value_names:
+                            self._by_var[name].escaped = True
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name) and \
+                            ctx.id in tracked:
+                        self._by_var[ctx.id].terminated = True
+            elif isinstance(node, ast.Call):
+                self._sweep_call(node, tracked)
+
+    def _sweep_call(self, call: ast.Call, tracked: Set[str]):
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name) and func.value.id in tracked:
+            if func.attr in TERMINATORS or func.attr in (
+                    "stop", "cancel", "terminate", "__exit__"):
+                self._by_var[func.value.id].terminated = True
+            return      # receiver position is use, not escape
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Call):
+            # `watchdog().disarm()` closes the `watchdog().arm()` form
+            name = (_dotted(func.value.func) or "").rsplit(".", 1)[-1]
+            key = f"{name}()"
+            if key in tracked and func.attr in TERMINATORS:
+                self._by_var[key].terminated = True
+                return
+        name = _dotted(func)
+        if name and name.rsplit(".", 1)[-1] in _ADOPTING_CMS:
+            for arg in call.args:
+                if isinstance(arg, ast.Name) and arg.id in tracked:
+                    self._by_var[arg.id].terminated = True
+            return
+        # the var passed as an ARGUMENT anywhere (weakref.finalize,
+        # atexit.register, container.append, helper(x)) → ownership
+        # shared with a scope this lexical pass cannot see: escape
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for name in self._names_in(arg) & tracked:
+                self._by_var[name].escaped = True
+
+
+# ---------------------------------------------------------------------------
+# the per-function effect scan
+
+
+class EffectScanner:
+    """One function body → direct effects + resource events. Nested
+    defs are skipped (they are scanned as their own functions);
+    lambdas are walked in place (they run in this frame)."""
+
+    def __init__(self, qualname: str, imports: Dict[str, str],
+                 cls_mutable_attrs: Set[str]):
+        self.qualname = qualname
+        self.imports = imports
+        self.cls_mutable_attrs = cls_mutable_attrs
+        self.effects: List[EffectEvent] = []
+
+    def scan(self, fn: ast.AST) -> List[EffectEvent]:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        self._globals: Set[str] = set()
+        self._nonlocals: Set[str] = set()
+        self._walk(body)
+        return self.effects
+
+    def _walk(self, stmts):
+        for stmt in stmts:
+            self._visit(stmt)
+
+    def _visit(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Global):
+            self._globals.update(stmt.names)
+        elif isinstance(stmt, ast.Nonlocal):
+            self._nonlocals.update(stmt.names)
+        elif isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                self._check_mutation_target(tgt)
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._check_mutation_target(tgt)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._visit(child)
+            elif isinstance(child, ast.ExceptHandler):
+                self._walk(child.body)
+            elif isinstance(child, ast.match_case):
+                self._walk(child.body)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child)
+
+    def _check_mutation_target(self, tgt: ast.AST):
+        root = tgt
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if isinstance(tgt, (ast.Attribute, ast.Subscript)) and \
+                isinstance(root, ast.Name) and root.id == "self":
+            self.effects.append(EffectEvent(
+                f"write to `{_display(tgt)}`", "mutation",
+                tgt.lineno))
+        elif isinstance(tgt, ast.Name) and (
+                tgt.id in self._globals or tgt.id in self._nonlocals):
+            self.effects.append(EffectEvent(
+                f"write to {'global' if tgt.id in self._globals else 'nonlocal'} "
+                f"`{tgt.id}`", "mutation", tgt.lineno))
+
+    def _scan_expr(self, expr: ast.AST):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = classify_effect(node)
+            if hit is not None:
+                self.effects.append(EffectEvent(
+                    hit[0], hit[1], node.lineno))
+                continue
+            # mutating method call on self-rooted state
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _MUTATORS:
+                root = func.value
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "self":
+                    self.effects.append(EffectEvent(
+                        f"`{_display(func)}(...)` mutates instance "
+                        "state", "mutation", node.lineno))
+
+
+def _display(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:       # pragma: no cover - unparse is py3.9+
+        return _dotted(node) or "<expr>"
+
+
+def scan_captures(fn: ast.AST, cls_mutable_attrs: Set[str],
+                  enclosing_mutables: Dict[str, int]
+                  ) -> List[CaptureEvent]:
+    """Mutable state a (jitted) function body captures: ``self.X``
+    loads where the class binds ``X`` mutably, and free-variable loads
+    of names the ENCLOSING function binds to a mutable literal."""
+    out: List[CaptureEvent] = []
+    params = _param_names(fn)
+    locals_: Set[str] = set(params)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    # first pass: local bindings shadow enclosing names — scope-pruned
+    # (a NESTED def's local `accum = ...` must not shadow this
+    # function's genuine capture of the enclosing `accum`; nested defs
+    # run their own capture scan)
+    for node in _walk_scope(body):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    locals_.add(tgt.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            target = node.target
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name):
+                    locals_.add(n.id)
+    seen: Set[str] = set()
+    for node in _walk_scope(body):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load) \
+                and node.attr in cls_mutable_attrs:
+            name = f"self.{node.attr}"
+            if name not in seen:
+                seen.add(name)
+                out.append(CaptureEvent(name, "instance-attr",
+                                        node.lineno))
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load) and node.id not in locals_ \
+                and node.id in enclosing_mutables:
+            if node.id not in seen:
+                seen.add(node.id)
+                out.append(CaptureEvent(node.id, "closure",
+                                        node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the transitive closure (mirrors CallGraph.may_block / may_acquire)
+
+
+def _short_lock(lock: str) -> str:
+    mod, _, attr = lock.partition("::")
+    mod = mod[len("sparkdl_tpu."):] if mod.startswith("sparkdl_tpu.") \
+        else mod
+    return f"{mod}:{attr}" if attr else mod
+
+
+def _effects_index(graph) -> Dict[str, FunctionEffects]:
+    idx: Dict[str, FunctionEffects] = {}
+    for m in graph.modules.values():
+        idx.update(m.effects)
+    return idx
+
+
+def may_effect(graph, key: str,
+               idx: Optional[Dict[str, FunctionEffects]] = None,
+               depth: int = MAX_DEPTH,
+               _memo: Optional[dict] = None,
+               _seen: Optional[Set[str]] = None
+               ) -> Dict[Tuple[str, str], Tuple[str, ...]]:
+    """``(kind, what) -> witness chain`` for every effect a call into
+    ``key`` may perform — its own direct effects plus everything
+    reachable through resolved call edges (unique-method guesses
+    excluded; see the module docstring). The chain is a tuple of
+    qualified names ending at the function holding the effect."""
+    idx = _effects_index(graph) if idx is None else idx
+    memo = {} if _memo is None else _memo
+    if key in memo:
+        return memo[key]
+    f = graph.functions.get(key)
+    if f is None or depth <= 0:
+        return {}
+    seen = _seen if _seen is not None else set()
+    if key in seen:
+        return {}
+    seen.add(key)
+    out: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+    fe = idx.get(key)
+    if fe is not None:
+        for e in fe.effects:
+            out.setdefault((e.kind, e.what), (graph.short(key),))
+    for acq in f.acquires:
+        out.setdefault(("lock", f"acquires {_short_lock(acq.lock)}"),
+                       (graph.short(key),))
+    for call in f.calls:
+        if call.kind == "method":
+            continue    # no unique-method guessing in the closure
+        target = graph.resolve(f, call)
+        if target is None or target == key:
+            continue
+        for ek, chain in may_effect(graph, target, idx, depth - 1,
+                                    memo, seen).items():
+            out.setdefault(ek, (graph.short(key),) + chain)
+    seen.discard(key)
+    if _seen is None or depth == MAX_DEPTH:
+        memo[key] = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# H10 — effectful call reachable from a jit-traced body
+
+
+#: direct in-body effect kinds H10 reports — the others (clock, rng,
+#: log/print, trace spans) are H2's lexical beat; double-flagging one
+#: line under two rules would demand two suppressions for one decision
+_H10_DIRECT_KINDS = {"registry", "mutation", "transfer", "io"}
+
+
+def check_h10(graph) -> List[Finding]:
+    idx = _effects_index(graph)
+    memo: dict = {}
+    findings: List[Finding] = []
+    for key, fe in sorted(idx.items()):
+        if not fe.jitted:
+            continue
+        f = graph.functions.get(key)
+        if f is None:
+            continue
+        # direct effects of the kinds H2 cannot or does not flag
+        seen_kinds: Set[str] = set()
+        for e in fe.effects:
+            if e.kind not in _H10_DIRECT_KINDS or e.kind in seen_kinds:
+                continue
+            seen_kinds.add(e.kind)
+            findings.append(Finding(
+                rule="H10", path=f.path, line=e.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"{e.what} inside jit-traced "
+                    f"`{f.qualname}`: {EFFECT_KINDS[e.kind]} runs at "
+                    "TRACE time only — once per compilation, never "
+                    "per step — so the compiled graph silently drops "
+                    "it; hoist the effect outside the traced body "
+                    "(suppress: `# sparkdl-lint: allow[H10] -- "
+                    "<why>`)")))
+        # transitive effects through resolved calls
+        for call in f.calls:
+            if call.kind == "method":
+                continue
+            target = graph.resolve(f, call)
+            if target is None or target == key:
+                continue
+            for (kind, what), chain in sorted(
+                    may_effect(graph, target, idx,
+                               _memo=memo).items()):
+                if kind in seen_kinds:
+                    continue
+                seen_kinds.add(kind)
+                full = " -> ".join((graph.short(key),) + chain)
+                findings.append(Finding(
+                    rule="H10", path=f.path, line=call.line, col=0,
+                    qualname=f.qualname,
+                    message=(
+                        f"jit-traced `{f.qualname}` reaches "
+                        f"{EFFECT_KINDS[kind]} ({what}) through the "
+                        f"call chain {full} — the effect executes at "
+                        "TRACE time only and the compiled program "
+                        "carries none of it per step (graph-boundary "
+                        "violation, the TFInputGraph failure class); "
+                        "make the callee pure or move the effect "
+                        "outside the jit (suppress: `# sparkdl-lint: "
+                        "allow[H10] -- <why>`)")))
+        # mutable captures: the stale-value / retrace hazard
+        for cap in fe.captures:
+            what = ("mutable instance attribute"
+                    if cap.kind == "instance-attr"
+                    else "mutable closure variable")
+            findings.append(Finding(
+                rule="H10", path=f.path, line=cap.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"jit-traced `{f.qualname}` captures {what} "
+                    f"`{cap.name}`: tracing bakes the captured value "
+                    "into the compiled program — later mutation is "
+                    "either silently ignored (stale value) or forces "
+                    "a retrace per mutation; pass it as an argument "
+                    "or freeze it to a tuple/scalar (suppress: "
+                    "`# sparkdl-lint: allow[H10] -- <why this value "
+                    "is effectively constant>`)")))
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# H11 — resource lifecycle
+
+
+def _class_index(graph) -> Dict[str, List[List[str]]]:
+    """class name -> method lists across the analyzed set (for the
+    unique-class fallback: package ``__init__`` re-exports hide the
+    defining module from the import table)."""
+    idx: Dict[str, List[List[str]]] = {}
+    for m in graph.modules.values():
+        for cls, methods in m.classes.items():
+            idx.setdefault(cls, []).append(methods)
+    return idx
+
+
+def _resolve_resource_class(graph, ev: ResourceEvent, module: str,
+                            classes: Dict[str, List[List[str]]]
+                            ) -> Optional[str]:
+    """The terminator method name when ``ev``'s ctor resolves to a
+    tracked resource class, else None."""
+    if ev.kind == "open":
+        return "close"
+    if ev.kind == "arm":
+        return "disarm"
+    candidates = []
+    mf = graph.modules.get(module)
+    if mf is not None and ev.ctor in mf.classes:
+        candidates.append(mf.classes[ev.ctor])
+    if not candidates and ev.import_src:
+        mod, _, cls = ev.import_src.rpartition(".")
+        src = graph._match_module(mod) if mod else None
+        if src is not None:
+            methods = graph.modules[src].classes.get(cls)
+            if methods is not None:
+                candidates.append(methods)
+    if not candidates:
+        # unique-class fallback (the H7/H8 unique-method spirit):
+        # exactly one analyzed class with this name, else no verdict
+        defs = classes.get(ev.ctor, [])
+        if len(defs) == 1:
+            candidates.append(defs[0])
+    for methods in candidates:
+        for term in TERMINATORS:
+            if term in methods:
+                return term
+    return None
+
+
+def check_h11(graph) -> List[Finding]:
+    idx = _effects_index(graph)
+    classes = _class_index(graph)
+    findings: List[Finding] = []
+    for key, fe in sorted(idx.items()):
+        f = graph.functions.get(key)
+        if f is None:
+            continue
+        low = f.qualname.rsplit(".", 1)[-1].lower()
+        if "arm" == low or low in ("autoarm", "disarm"):
+            continue    # an arm method IS the lifecycle implementation
+        for ev in fe.resources:
+            if ev.terminated or ev.escaped:
+                continue
+            module = key.partition("::")[0]
+            term = _resolve_resource_class(graph, ev, module, classes)
+            if term is None:
+                continue
+            if ev.kind == "arm":
+                what = (f"`{ev.var}.arm(...)` arms the {ev.ctor} "
+                        "singleton")
+                fix = (f"pair it with `{ev.var}.disarm()` (a "
+                       "try/finally), or arm process-wide at entry "
+                       "and suppress")
+            else:
+                what = (f"`{ev.var} = {ev.ctor}(...)` constructs a "
+                        "resource")
+                fix = (f"call `{ev.var}.{term}()` on every normal "
+                       f"path (a `with`/`try-finally`), return it, "
+                       "or store it on longer-lived state")
+            findings.append(Finding(
+                rule="H11", path=f.path, line=ev.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"{what} whose terminator `{term}()` is never "
+                    "reached in this scope and the object does not "
+                    "escape (not returned / stored / registered) — "
+                    "a leaked lifecycle keeps threads, sockets, or "
+                    f"arm state alive past the scope; {fix} "
+                    "(suppress: `# sparkdl-lint: allow[H11] -- "
+                    "<who terminates it>`)")))
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings

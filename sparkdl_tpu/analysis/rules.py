@@ -15,6 +15,7 @@ to suppress inline WITH a justification, which is itself documentation.
 from __future__ import annotations
 
 import ast
+import os
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from sparkdl_tpu.analysis.findings import Finding
@@ -535,8 +536,7 @@ class _H5Clock(_ScopedVisitor):
 
 
 def check_h5(tree: ast.AST, path: str) -> List[Finding]:
-    norm = path.replace("\\", "/")
-    if not any(p in norm for p in _H5_PATHS):
+    if not _path_in(path, _H5_PATHS):
         return []
     v = _H5Clock(path)
     v.visit(tree)
@@ -614,6 +614,110 @@ def check_h6(tree: ast.AST, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# H12 — exception-flow accounting (serve/obs/runtime hot paths)
+
+# PR 7's population-separation fix established the invariant: every
+# failure on a serving/observability hot path must LAND somewhere an
+# operator can see — a failure counter, an SLO outcome, a re-raise, a
+# recorded error field. An `except` that swallows (pass, bare
+# continue, or log-only: logs rotate away, counters don't) breaks the
+# accounting chain that makes `serve.failures`, the availability burn
+# rate, and the flight recorder's triggers trustworthy. The rule is
+# PATH-scoped to the hot paths; swallows elsewhere stay H4's
+# (cleanup-path) business.
+
+_H12_PATHS = ("sparkdl_tpu/serve/", "sparkdl_tpu/obs/",
+              "sparkdl_tpu/runtime/")
+_H12_LOG_NAMES = {"print", "warn_once"}
+_H12_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                    "critical", "log"}
+
+
+def _h12_is_log_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if name in _H12_LOG_NAMES or name == "warnings.warn":
+        return True
+    if name and name.startswith("logging."):
+        return True
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _H12_LOG_METHODS:
+        recv = call.func.value
+        # the chained form: logging.getLogger(__name__).warning(...) —
+        # the receiver is a CALL, so _dotted() can't name it
+        if isinstance(recv, ast.Call):
+            recv_fn = _dotted(recv.func) or ""
+            return recv_fn.rsplit(".", 1)[-1] == "getLogger"
+        recv_name = (_dotted(recv) or "").lower()
+        return "log" in recv_name or recv_name.startswith("warnings")
+    return False
+
+
+def _h12_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when every statement in the handler is accounting-free:
+    pass / bare continue / docstring / import / a log-only call. Any
+    raise, return, assignment (the error lands in state), counter
+    ``.inc()``/``.add()``, ``record_failure``, ``set_exception`` —
+    anything that BINDS the failure to an observable outcome — makes
+    the handler accountable and clean."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Import,
+                             ast.ImportFrom)):
+            continue
+        if isinstance(stmt, ast.Expr):
+            if isinstance(stmt.value, ast.Constant):
+                continue
+            if isinstance(stmt.value, ast.Call) and \
+                    _h12_is_log_call(stmt.value):
+                continue
+        return False
+    return True
+
+
+class _H12ExceptionFlow(_ScopedVisitor):
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if _h12_swallows(node):
+            kind = ("bare `continue`" if any(
+                isinstance(s, ast.Continue) for s in node.body)
+                else "log-only" if any(
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Call)
+                    for s in node.body)
+                else "`pass`")
+            self.flag(
+                "H12", node,
+                f"{kind} exception handler on a serve/obs/runtime hot "
+                "path: the failure reaches no counter, SLO outcome, "
+                "or error state — the accounting chain (serve."
+                "failures, availability burn, flight triggers) "
+                "silently loses it; record a failure counter/SLO "
+                "outcome on the handler path (the PR-7 population-"
+                "separation contract), or suppress with "
+                "`# sparkdl-lint: allow[H12] -- <why this failure "
+                "needs no accounting>`")
+        self.generic_visit(node)
+
+
+def _path_in(path: str, prefixes) -> bool:
+    """Is ``path`` inside one of the package-relative ``prefixes``?
+    Checked against the path as given AND its absolute form — linting
+    ``obs/`` from inside the package dir must not silently skip a
+    path-scoped rule."""
+    for cand in (path, os.path.abspath(path)):
+        norm = cand.replace("\\", "/")
+        if any(p in norm for p in prefixes):
+            return True
+    return False
+
+
+def check_h12(tree: ast.AST, path: str) -> List[Finding]:
+    if not _path_in(path, _H12_PATHS):
+        return []
+    v = _H12ExceptionFlow(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
@@ -623,6 +727,7 @@ RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
     "H4": check_h4,
     "H5": check_h5,
     "H6": check_h6,
+    "H12": check_h12,
 }
 
 _RULE_DOCS = {
@@ -659,6 +764,27 @@ _RULE_DOCS = {
           "(docs/OBSERVABILITY.md, docs/SERVING.md, "
           "docs/PERFORMANCE.md), BOTH directions — undocumented "
           "publishes and documented-but-gone names both fail",
+    "H10": "effectful call reachable from jit (whole-program): any "
+           "effect — registry writes, spans, logging, clocks/RNG, "
+           "transfers, I/O, lock acquires, mutation of captured "
+           "state — transitively reachable from a jax.jit/pjit-traced "
+           "body through resolved call edges, with the witness chain "
+           "printed; plus mutable state (lists/dicts/instance attrs) "
+           "captured into a jitted function — the stale-value/"
+           "retrace hazard the lexical H2 cannot see",
+    "H11": "resource lifecycle (whole-program): an object whose class "
+           "defines close/quiesce/shutdown/disarm — plus open()/"
+           "tempfile handles and obs-singleton arm()s — constructed "
+           "in a scope must reach its terminator there or escape "
+           "(returned, stored on self/a global, registered, passed "
+           "on); a leaked lifecycle keeps threads/sockets/arm state "
+           "alive past the scope",
+    "H12": "exception-flow accounting (sparkdl_tpu/serve/, obs/, "
+           "runtime/): an except that swallows — pass, bare "
+           "continue, or log-only — must record a failure counter/"
+           "SLO outcome on the handler path or carry an inline "
+           "suppression (the PR-7 population-separation fix as a "
+           "static invariant)",
 }
 
 

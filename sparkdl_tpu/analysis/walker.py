@@ -2,15 +2,16 @@
 
 Two layers of rules run per invocation:
 
-* **per-file** (H1–H6, :data:`~sparkdl_tpu.analysis.rules.RULES`) —
-  one AST pass each over each module; results (and the callgraph/lock
-  facts + published-surface extraction the program layer needs) are
-  cacheable per file by mtime+hash (:mod:`.cache`).
+* **per-file** (H1–H6 + H12, :data:`~sparkdl_tpu.analysis.rules.RULES`)
+  — one AST pass each over each module; results (and the
+  callgraph/lock/effect facts + published-surface extraction the
+  program layer needs) are cacheable per file by mtime+hash
+  (:mod:`.cache`).
 * **whole-program** (H7/H8 over the
-  :class:`~sparkdl_tpu.analysis.callgraph.CallGraph`, H9 over the
-  merged published surface vs the repo docs) — always re-run, over
-  the cheap per-file facts; their verdicts depend on every analyzed
-  module at once.
+  :class:`~sparkdl_tpu.analysis.callgraph.CallGraph`, H10/H11 over
+  the effect facts riding it, H9 over the merged published surface vs
+  the repo docs) — always re-run, over the cheap per-file facts;
+  their verdicts depend on every analyzed module at once.
 
 Suppression is uniform: every finding — per-file or program — that
 lands on a line of an analyzed python file honors the inline

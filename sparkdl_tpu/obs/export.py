@@ -194,6 +194,7 @@ class TelemetryServer:
                     from sparkdl_tpu.obs.slo import slo_tracker
                     slo_tracker().publish(self._registry)
                 except Exception as e:
+                    self._registry.counter("telemetry.errors").add()
                     logger.debug("telemetry: slo refresh failed: %s",
                                  e)
                 body = render_prometheus(self._registry).encode()
@@ -219,11 +220,15 @@ class TelemetryServer:
                             "application/json")
         except Exception:
             # the health surface must never take the process down (and
-            # a broken probe should read as a 500, not a hang)
+            # a broken probe should read as a 500, not a hang) — but a
+            # failing surface must COUNT its failures where the next
+            # successful scrape sees them (H12)
+            self._registry.counter("telemetry.errors").add()
             logger.exception("telemetry: %s handler failed", path)
             try:
                 self._reply(handler, 500, b'{"error": "internal"}',
                             "application/json")
+            # sparkdl-lint: allow[H12] -- root failure counted in telemetry.errors above; the reply failing means the peer hung up, and there is no socket left to account anything to
             except Exception as e:
                 logger.debug("telemetry: error reply failed: %s", e)
 

@@ -289,6 +289,7 @@ class StallWatchdog:
             if rec.armed:
                 rec.dump(reason=f"watchdog stall: {source!r} made no "
                                 f"progress for > {threshold:.3f}s")
+        # sparkdl-lint: allow[H12] -- the stall IS accounted (watchdog.stalls counter + ERROR log fired before this call); the dump is best-effort forensics on top
         except Exception:
             # the watchdog must survive a failed postmortem — the
             # stall log + counter above already happened
@@ -351,6 +352,11 @@ class StallWatchdog:
             try:
                 self.check_once()
             except Exception:
+                # a watchdog that cannot complete its monitor pass is
+                # silently not protecting anything — count it where a
+                # scrape can alert on it (H12 accounting)
+                default_registry().counter(
+                    "watchdog.monitor_errors").add()
                 logger.exception("watchdog: monitor pass failed")
 
     # -- pickle discipline (StageMetrics precedent) --------------------------

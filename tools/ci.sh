@@ -58,14 +58,23 @@
 #      recovery /metricsz must scrape as valid Prometheus text.
 #  10. static analysis: sparkdl-lint (docs/LINT.md — H1 transfers,
 #      H2 retrace, H3 locks, H4 quiesce, H5 clock discipline, H6
-#      metric cardinality, plus the whole-program passes H7 lock-order
-#      cycles / H8 blocking-under-lock / H9 docs contract drift) must
-#      report ZERO unsuppressed findings across the package AND
-#      tools/ + examples/, plus the ruff baseline when installed
+#      metric cardinality, H12 exception-flow accounting, plus the
+#      whole-program passes H7 lock-order cycles / H8
+#      blocking-under-lock / H9 docs contract drift / H10 jit-purity
+#      closure / H11 resource lifecycle) must report ZERO unsuppressed
+#      findings across the package AND tools/ + examples/, plus the
+#      ruff baseline when installed
 #  11. analyzer machine contract: `--json` output schema, and the
 #      per-file result cache's correctness — a cold run misses, a
 #      second run hits every file, a touched file (and only it)
 #      re-analyzes, with identical findings either way
+#  12. effect-system gate (docs/LINT.md): the seeded fixture for each
+#      of H10 (jitted fn transitively reaching a registry counter
+#      through two modules, witness chain printed) / H11 (unclosed
+#      ModelServer) / H12 (swallowing serve handler) must be CAUGHT,
+#      the package + tools/ + examples/ must be clean under all
+#      twelve rules, --sarif must emit well-formed SARIF 2.1.0, and
+#      --changed-only must smoke (the tools/lint.sh --fast loop)
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -81,7 +90,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/11] native shim build =="
+echo "== [1/12] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -90,13 +99,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/11] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/12] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/11] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/12] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/11] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/12] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -105,7 +114,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/11] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/12] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -185,7 +194,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/11] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/12] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -224,11 +233,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/11] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/12] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/11] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/12] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -323,7 +332,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/11] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/12] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -433,7 +442,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/11] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/12] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -557,11 +566,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/11] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/12] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/11] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/12] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -590,7 +599,8 @@ for key in ("findings", "unsuppressed", "suppressed", "rules",
 assert d1["unsuppressed"] == 0, d1["findings"]
 assert d1["suppressed"] > 0, "expected the known suppressed findings"
 assert set(d1["rules"]) >= {"H1", "H2", "H3", "H4", "H5", "H6",
-                            "H7", "H8", "H9"}, d1["rules"]
+                            "H7", "H8", "H9", "H10", "H11", "H12"}, \
+    d1["rules"]
 for f in d1["findings"]:
     for k in ("rule", "path", "line", "col", "message", "suppressed"):
         assert k in f, (k, f)
@@ -623,5 +633,102 @@ print(json.dumps({"analyzer_gate": "ok",
                   "by_rule": {k: v for k, v in d1["by_rule"].items()
                               if v["suppressed"]}}))
 EOF
+
+echo "== [12/12] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+python - <<'EOF'
+import json
+import os
+import tempfile
+
+from sparkdl_tpu.analysis import analyze_paths
+
+# seeded fixtures: each of the three new rules must CATCH its shape
+with tempfile.TemporaryDirectory() as d:
+    def w(name, src):
+        with open(os.path.join(d, name), "w") as f:
+            f.write(src)
+
+    # H10: jitted fn -> helper module -> metrics module counter
+    w("metrics_mod.py", "def bump(reg):\n"
+                        "    reg.counter('train.steps').add()\n")
+    w("helper_mod.py", "from metrics_mod import bump\n"
+                       "def helper(x, reg):\n"
+                       "    bump(reg)\n"
+                       "    return x\n")
+    w("train_mod.py", "import jax\n"
+                      "from helper_mod import helper\n"
+                      "@jax.jit\n"
+                      "def step(x, reg):\n"
+                      "    return helper(x, reg)\n")
+    # H10 capture: mutable instance attr into a jitted method
+    w("cap_mod.py", "import jax\n"
+                    "class T:\n"
+                    "    def __init__(self):\n"
+                    "        self.hist = []\n"
+                    "    @jax.jit\n"
+                    "    def traced(self, x):\n"
+                    "        return x + len(self.hist)\n")
+    # H11: unclosed ModelServer
+    w("srv_mod.py", "class ModelServer:\n"
+                    "    def submit(self, x):\n"
+                    "        return x\n"
+                    "    def close(self):\n"
+                    "        pass\n")
+    w("leak_mod.py", "from srv_mod import ModelServer\n"
+                     "def leaky(x):\n"
+                     "    s = ModelServer()\n"
+                     "    s.submit(x)\n")
+    found = analyze_paths([d], cache_path=None)
+    by_rule = {}
+    for f in found:
+        if not f.suppressed:
+            by_rule.setdefault(f.rule, []).append(f)
+    h10 = by_rule.get("H10", [])
+    assert any("helper_mod:helper" in f.message
+               and "metrics_mod:bump" in f.message
+               for f in h10), [f.render() for f in h10]
+    assert any("self.hist" in f.message for f in h10), \
+        [f.render() for f in h10]
+    assert any("ModelServer" in f.message
+               for f in by_rule.get("H11", [])), by_rule.keys()
+
+# H12: swallowing handler in a serve-scoped module
+from sparkdl_tpu.analysis import analyze_source
+h12 = [f for f in analyze_source(
+    "def dispatch(q):\n"
+    "    try:\n"
+    "        q.pop()\n"
+    "    except Exception:\n"
+    "        pass\n", "sparkdl_tpu/serve/fixture.py", rules=["H12"])
+    if not f.suppressed]
+assert len(h12) == 1, h12
+print(json.dumps({"effect_fixtures": "ok",
+                  "h10": len(h10), "h11": 1, "h12": 1}))
+EOF
+# twelve-rule cleanliness is step 10's gate; here: SARIF + fast loop
+python -m sparkdl_tpu.analysis --sarif /tmp/sparkdl_lint.sarif \
+  sparkdl_tpu tools examples
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_lint.sarif") as f:
+    doc = json.load(f)
+assert doc["version"] == "2.1.0", doc.get("version")
+assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+[run] = doc["runs"]
+rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+assert {"H1", "H10", "H11", "H12"} <= rules, sorted(rules)
+for res in run["results"]:
+    assert res["ruleId"] in rules
+    assert res["message"]["text"]
+    [loc] = res["locations"]
+    assert loc["physicalLocation"]["region"]["startLine"] >= 1
+# the package is lint-clean, so every SARIF result is a suppression
+assert all("suppressions" in r for r in run["results"]), \
+    [r["ruleId"] for r in run["results"] if "suppressions" not in r]
+print(json.dumps({"sarif_gate": "ok",
+                  "results": len(run["results"])}))
+EOF
+tools/lint.sh --fast
 
 echo "== ci.sh: ALL GREEN =="

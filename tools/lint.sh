@@ -1,19 +1,35 @@
 #!/usr/bin/env bash
 # Static-analysis entry point: sparkdl-lint (the repo-specific
-# hot-path rules H1-H6 plus the whole-program concurrency passes
-# H7-H9, docs/LINT.md) plus the generic ruff/mypy baseline from
+# hot-path rules H1-H6 + H12 plus the whole-program passes H7-H11,
+# docs/LINT.md) plus the generic ruff/mypy baseline from
 # pyproject.toml when those tools are installed (they are NOT hard
 # deps — the lint gate must be green from a fresh clone with no
 # network, so missing tools skip with a notice instead of failing).
 #
-# Usage: tools/lint.sh [paths...]   # default: sparkdl_tpu/ tools/
+# Usage: tools/lint.sh [--fast] [paths...]
+#                                   # default: sparkdl_tpu/ tools/
 #                                   #          examples/
+#        --fast: lint only files git reports dirty/changed
+#                (sparkdl-lint --changed-only, the pre-commit loop;
+#                whole-program witnesses that start in an unchanged
+#                file wait for the full run). ruff/mypy are SKIPPED
+#                in --fast mode — they have no changed-only notion
+#                here and would sweep the full tree, defeating the
+#                loop's point.
 # Exit: non-zero iff sparkdl-lint finds an unsuppressed finding or an
 #       installed ruff/mypy reports errors.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+lint_flags=()
+fast=0
+if [ "${1:-}" = "--fast" ]; then
+  lint_flags+=(--changed-only)
+  fast=1
+  shift
+fi
 
 if [ "$#" -eq 0 ]; then
   # the default sweep covers everything the repo ships AND drives:
@@ -24,17 +40,21 @@ else
   targets=("$@")
 fi
 
-echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality / H7 lock cycles / H8 blocking-under-lock / H9 contract drift) =="
-python -m sparkdl_tpu.analysis "${targets[@]}"
+echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality / H7 lock cycles / H8 blocking-under-lock / H9 contract drift / H10 jit-purity closure / H11 resource lifecycle / H12 exception-flow accounting) =="
+python -m sparkdl_tpu.analysis ${lint_flags[@]+"${lint_flags[@]}"} "${targets[@]}"
 
-if command -v ruff >/dev/null 2>&1; then
+if [ "$fast" = "1" ]; then
+  echo "== ruff/mypy: skipped in --fast mode (full sweep: tools/lint.sh) =="
+elif command -v ruff >/dev/null 2>&1; then
   echo "== ruff (pyproject baseline) =="
   ruff check "${targets[@]}"
 else
   echo "== ruff: not installed, skipped (pip install ruff to enable) =="
 fi
 
-if command -v mypy >/dev/null 2>&1; then
+if [ "$fast" = "1" ]; then
+  :
+elif command -v mypy >/dev/null 2>&1; then
   echo "== mypy (pyproject baseline, loose) =="
   mypy "${targets[@]}"
 else
