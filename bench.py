@@ -769,6 +769,11 @@ def main() -> None:
     # /statusz and the flight bundle carry
     obs_block["slo"] = _slo().status()
     obs_block["request_log"] = _rlog().status()
+    # the resilience layer's drill/recovery state (docs/RESILIENCE.md):
+    # injection config + per-site counts, retry/shed totals, live
+    # circuit verdicts — literally the same renderer /statusz and the
+    # flight bundle use, so a bench row and a postmortem cannot drift
+    resilience_block = obs_flight.resilience_state()
     if trc.armed:
         trace_path = os.environ.get("SPARKDL_TPU_TRACE_EXPORT",
                                     "/tmp/sparkdl_tpu_trace.json")
@@ -842,6 +847,7 @@ def main() -> None:
         "serve": serve,
         "tails": tails,
         "autotune": autotune,
+        "resilience": resilience_block,
         "infeed_race": infeed_race,
         **({"tpu_fallback": ("tunneled TPU backend did not initialize; "
                              "CPU numbers are compute-bound on this "

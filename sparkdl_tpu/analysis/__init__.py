@@ -90,6 +90,12 @@ acquires, mutation of captured state — with witness chains):
   ``continue``, or log-only — must record a failure counter/SLO
   outcome on the handler path or carry an inline suppression (PR 7's
   population-separation fix as a static invariant).
+* **H13 — unbounded retry loops** (``serve/``, ``runtime/``,
+  ``data/``, ``resilience/``): a ``while True`` whose except handler
+  swallows and loops again with no escape — re-attempts must be
+  bounded and backed-off (``resilience.RetryPolicy``: attempts +
+  exponential backoff + retry budget), never a bare spin on a
+  failing dependency.
 
 CI annotation: ``--sarif out.sarif`` writes SARIF 2.1.0;
 ``--changed-only`` (``tools/lint.sh --fast``) lints only

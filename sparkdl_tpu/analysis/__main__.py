@@ -128,10 +128,11 @@ def main(argv=None) -> int:
         description="sparkdl-lint: enforce the hot-path invariants "
                     "(H1 transfers, H2 retrace, H3 locks, H4 quiesce, "
                     "H5 clocks, H6 cardinality, H12 exception-flow "
-                    "accounting) plus the whole-program passes (H7 "
-                    "lock-order cycles, H8 blocking under a lock, H9 "
-                    "docs contract drift, H10 jit-purity closure, H11 "
-                    "resource lifecycle). Rule reference: docs/LINT.md")
+                    "accounting, H13 unbounded retry loops) plus the "
+                    "whole-program passes (H7 lock-order cycles, H8 "
+                    "blocking under a lock, H9 docs contract drift, "
+                    "H10 jit-purity closure, H11 resource lifecycle). "
+                    "Rule reference: docs/LINT.md")
     parser.add_argument(
         "paths", nargs="*",
         help="files/directories to lint (default: the sparkdl_tpu "

@@ -718,6 +718,121 @@ def check_h12(tree: ast.AST, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# H13 — unbounded retry loops (serve/runtime/data/resilience paths)
+
+# PR 11's resilience contract: every re-attempt on a hot path runs
+# under the shared RetryPolicy — bounded attempts, exponential
+# backoff, a retry budget (resilience/policy.py). The shape that
+# breaks all three at once is the bare `while True: try/except` whose
+# handler swallows AND continues: on sustained failure it spins
+# forever, unthrottled, amplifying the load on the exact dependency
+# that is already failing. The rule flags an unbounded-test loop
+# (`while True` / `while 1`) containing an except handler with no
+# escape (no raise/break/return reachable in the handler): on the
+# exception path, nothing ever ends the loop. Loops whose handler
+# re-raises, breaks, or returns — including RetryPolicy.call, whose
+# handler re-raises on grant() refusal — are clean by construction.
+
+_H13_PATHS = ("sparkdl_tpu/serve/", "sparkdl_tpu/runtime/",
+              "sparkdl_tpu/data/", "sparkdl_tpu/resilience/")
+
+_H13_SCOPE_STOPS = (ast.FunctionDef, ast.AsyncFunctionDef,
+                    ast.ClassDef, ast.Lambda)
+
+
+def _h13_unbounded(node: ast.While) -> bool:
+    return isinstance(node.test, ast.Constant) \
+        and node.test.value in (True, 1)
+
+
+def _h13_handlers(stmts, out: List[ast.ExceptHandler]) -> None:
+    """Except handlers whose swallow retries THIS unbounded loop:
+    everything reachable in its body except nested defs (a callback's
+    control flow is the callee's) and nested unbounded whiles (their
+    own visit). Nested BOUNDED loops (for / `while cond`) descend —
+    a per-iteration-bounded inner loop still re-enters the outer
+    `while True` forever when its handler swallows."""
+    for s in stmts:
+        if isinstance(s, _H13_SCOPE_STOPS):
+            continue
+        if isinstance(s, ast.While) and _h13_unbounded(s):
+            continue
+        if isinstance(s, ast.Try):
+            out.extend(s.handlers)
+            _h13_handlers(s.body, out)
+            _h13_handlers(s.orelse, out)
+            _h13_handlers(s.finalbody, out)
+            for h in s.handlers:
+                _h13_handlers(h.body, out)
+        elif isinstance(s, (ast.If, ast.While)):
+            _h13_handlers(s.body, out)
+            _h13_handlers(s.orelse, out)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            _h13_handlers(s.body, out)
+            _h13_handlers(s.orelse, out)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            _h13_handlers(s.body, out)
+        elif isinstance(s, ast.Match):
+            for case in s.cases:
+                _h13_handlers(case.body, out)
+
+
+def _h13_escapes(stmts, loop_depth: int = 0) -> bool:
+    """Does any raise/return — or a break that actually exits the
+    flagged loop — sit on this handler's own paths? Nested defs are
+    excluded (their control flow is the callee's), and ``loop_depth``
+    tracks handler-internal loops so a `break` that only exits an
+    inner for/while is NOT read as escaping the unbounded one."""
+    for s in stmts:
+        if isinstance(s, _H13_SCOPE_STOPS):
+            continue
+        if isinstance(s, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(s, ast.Break) and loop_depth == 0:
+            return True
+        child_depth = loop_depth + 1 if isinstance(
+            s, (ast.For, ast.AsyncFor, ast.While)) else loop_depth
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, _H13_SCOPE_STOPS):
+                continue
+            if _h13_escapes([child], child_depth):
+                return True
+    return False
+
+
+class _H13RetryLoops(_ScopedVisitor):
+    def visit_While(self, node: ast.While):
+        if _h13_unbounded(node):
+            handlers: List[ast.ExceptHandler] = []
+            _h13_handlers(node.body, handlers)
+            for handler in handlers:
+                if not _h13_escapes(handler.body):
+                    self.flag(
+                        "H13", handler,
+                        "retry-shaped `while True` on a serve/runtime"
+                        "/data path: this except handler swallows and "
+                        "loops again with no escape (raise/break/"
+                        "return) — on sustained failure the loop "
+                        "spins forever, unthrottled, amplifying load "
+                        "on the failing dependency. Re-attempts must "
+                        "be bounded and backed-off: run them under "
+                        "resilience.RetryPolicy (attempts + "
+                        "exponential backoff + retry budget, "
+                        "docs/RESILIENCE.md), or suppress with "
+                        "`# sparkdl-lint: allow[H13] -- <what bounds "
+                        "and paces this loop>`")
+        self.generic_visit(node)
+
+
+def check_h13(tree: ast.AST, path: str) -> List[Finding]:
+    if not _path_in(path, _H13_PATHS):
+        return []
+    v = _H13RetryLoops(path)
+    v.visit(tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
@@ -728,6 +843,7 @@ RULES: Dict[str, Callable[[ast.AST, str], List[Finding]]] = {
     "H5": check_h5,
     "H6": check_h6,
     "H12": check_h12,
+    "H13": check_h13,
 }
 
 _RULE_DOCS = {
@@ -785,6 +901,12 @@ _RULE_DOCS = {
            "SLO outcome on the handler path or carry an inline "
            "suppression (the PR-7 population-separation fix as a "
            "static invariant)",
+    "H13": "unbounded retry loops (sparkdl_tpu/serve/, runtime/, "
+           "data/, resilience/): a `while True` whose except handler "
+           "swallows and loops again with no escape — re-attempts "
+           "must be bounded and backed-off (resilience.RetryPolicy: "
+           "attempts + exponential backoff + retry budget), never a "
+           "bare spin on a failing dependency",
 }
 
 

@@ -34,62 +34,30 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
-from sparkdl_tpu.obs import span
+from sparkdl_tpu.obs import default_registry, span
+from sparkdl_tpu.resilience.errors import (
+    default_retryable_exceptions,
+    is_deterministic_jax_error,
+)
+from sparkdl_tpu.resilience.faults import maybe_fail
+from sparkdl_tpu.resilience.policy import RetryPolicy
+
+# NOTE: the retryable taxonomy moved to resilience/errors.py (one
+# shared Transient-vs-Permanent split for the engine AND the serve
+# layer); `default_retryable_exceptions` / `is_deterministic_jax_error`
+# stay importable from this module for existing callers.
 
 logger = logging.getLogger(__name__)
 
-
-def default_retryable_exceptions() -> Tuple[type, ...]:
-    """Exception families a partition re-run can plausibly fix.
-
-    ``OSError`` covers disk and Arrow IO. The jax runtime-error family
-    covers transient device failures — a dropped PJRT tunnel connection
-    mid-partition (realistic in this very environment), a preempted
-    device — which re-run cleanly because sources re-load from disk and
-    stages are pure. jax errors carrying a DETERMINISTIC status code
-    (INVALID_ARGUMENT, a genuine RESOURCE_EXHAUSTED allocation failure,
-    ...) are filtered out by :func:`is_deterministic_jax_error` even
-    though the class is listed here. Python-level user errors (bad
-    column names, trace-time shape mismatches) are never retried.
-    """
-    excs = [OSError]
-    try:
-        from jax.errors import JaxRuntimeError
-        excs.append(JaxRuntimeError)
-    except ImportError:  # pragma: no cover - jax is a hard dep in env
-        pass
-    return tuple(excs)
-
-
-# Status codes that mean "this exact program will fail this exact way
-# again" — re-running the partition cannot help, so time-to-failure must
-# not triple and the retry warning must not suggest transience.
-# (RESOURCE_EXHAUSTED: a program whose allocations exceed HBM fails
-# deterministically; transient allocator races surface as INTERNAL or
-# UNAVAILABLE in PJRT.)
-_DETERMINISTIC_JAX_STATUSES = (
-    "INVALID_ARGUMENT", "NOT_FOUND", "ALREADY_EXISTS", "PERMISSION_DENIED",
-    "FAILED_PRECONDITION", "OUT_OF_RANGE", "UNIMPLEMENTED",
-    "RESOURCE_EXHAUSTED", "UNAUTHENTICATED",
-)
-
-
-def is_deterministic_jax_error(exc: BaseException) -> bool:
-    """True when a jax/PJRT runtime error carries a status code that a
-    re-run cannot fix. XlaRuntimeError IS JaxRuntimeError; the absl
-    status name is searched as a ``NAME:`` token in the message's first
-    line rather than only at position 0 — wrapping layers commonly
-    prefix context ("Execution failed: INVALID_ARGUMENT: ...")."""
-    try:
-        from jax.errors import JaxRuntimeError
-    except ImportError:  # pragma: no cover
-        return False
-    if not isinstance(exc, JaxRuntimeError):
-        return False
-    msg = str(exc).lstrip()
-    first_line = msg.splitlines()[0] if msg else ""
-    return any(f"{s}:" in first_line
-               for s in _DETERMINISTIC_JAX_STATUSES)
+#: the engine's retry pacing: short backoff (partition re-runs are
+#: batch work racing nothing), generous budget (ratio 1.0 bounds
+#: sustained amplification at 2x offered load — the serve layer's
+#: latency-sensitive 0.2 would starve long scans with sparse
+#: transients)
+ENGINE_RETRY_BASE_BACKOFF_S = 0.02
+ENGINE_RETRY_MAX_BACKOFF_S = 1.0
+ENGINE_RETRY_BUDGET_RATIO = 1.0
+ENGINE_RETRY_BUDGET_CAP = 16.0
 
 
 def _concat_batches(frags: Sequence[pa.RecordBatch]) -> pa.RecordBatch:
@@ -137,11 +105,16 @@ class LocalEngine:
     Transient failures are retried ``max_retries`` times before
     propagating — the counterpart of Spark's task retry, which gave the
     reference free retry of inference partitions (SURVEY §5 "failure
-    detection"). The retryable set defaults to
-    :func:`default_retryable_exceptions` (IO + jax/PJRT transients) and
-    is configurable via ``retryable_exceptions``. Deterministic errors
-    (bad column names, shape mismatches) propagate immediately and
-    unchanged.
+    detection"). Retry runs on the shared
+    :class:`~sparkdl_tpu.resilience.policy.RetryPolicy` (bounded
+    attempts, exponential backoff with deterministic jitter, a retry
+    budget bounding sustained amplification; each granted retry counts
+    ``engine.retries``). The retryable set defaults to
+    :func:`default_retryable_exceptions` (IO + jax/PJRT transients +
+    the typed ``TransientError`` family) and is configurable via
+    ``retryable_exceptions``. Deterministic errors (bad column names,
+    shape mismatches, jax statuses a re-run cannot fix) propagate
+    immediately and unchanged.
     """
 
     def __init__(self, num_workers: Optional[int] = None,
@@ -168,10 +141,28 @@ class LocalEngine:
             else default_retryable_exceptions())
         # optional sparkdl_tpu.utils.StageMetrics for per-stage timing
         self.stage_metrics = stage_metrics
+        # ONE policy per engine, shared by every pool worker and the
+        # consumer-thread stream stages: the budget only bounds retry
+        # amplification if the retrying threads share the bucket
+        # (resilience/policy.py)
+        self.retry_policy = RetryPolicy(
+            attempts=1 + max(0, self.max_retries),
+            base_backoff_s=ENGINE_RETRY_BASE_BACKOFF_S,
+            max_backoff_s=ENGINE_RETRY_MAX_BACKOFF_S,
+            budget_ratio=ENGINE_RETRY_BUDGET_RATIO,
+            budget_cap=ENGINE_RETRY_BUDGET_CAP,
+            retryable=self._retryable)
         self._pool = ThreadPoolExecutor(
             max_workers=self.num_workers,
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
+
+    def _retryable(self, exc: BaseException) -> bool:
+        """The engine's retry classifier: inside the configured
+        exception set AND not a deterministic jax status (re-running a
+        program whose shapes are wrong just triples time-to-failure)."""
+        return (isinstance(exc, self.retryable_exceptions)
+                and not is_deterministic_jax_error(exc))
 
     # Locks and thread pools don't pickle; frames normally drop their
     # engine before shipping (frame.Source pickles engine=None), but an
@@ -192,6 +183,9 @@ class LocalEngine:
         self._device_lock = threading.Lock()
 
     def _run_stage(self, stage, batch, index, timings) -> pa.RecordBatch:
+        # fault-injection site (resilience/faults.py; disarmed: one
+        # armed-check): every stage apply, pooled and stream paths
+        maybe_fail("engine.stage_apply")
         # every stage call lands on the tracer's "engine" lane
         # (obs/trace.py — a no-op when SPARKDL_TPU_TRACE is unset)
         with span(f"stage:{stage.name}", lane="engine",
@@ -211,6 +205,9 @@ class LocalEngine:
         # Buffer stage timings locally and flush only on success, so a
         # retried partition doesn't double-count its completed stages.
         timings = [] if self.stage_metrics is not None else None
+        # fault-injection site: the partition's source read (the
+        # worker-death drill for ROADMAP item 1's multi-host plan)
+        maybe_fail("engine.source_load")
         with span("source.load", lane="engine", partition=index):
             batch = source.load()
         for stage in plan:
@@ -230,18 +227,23 @@ class LocalEngine:
         logical = getattr(source, "logical_index", None)
         if logical is not None:
             index = logical
-        attempts = 1 + max(0, self.max_retries)
-        for attempt in range(attempts):
-            try:
-                return self._run_once(source, plan, index)
-            except self.retryable_exceptions as e:
-                if is_deterministic_jax_error(e):
-                    raise
-                if attempt + 1 >= attempts:
-                    raise
-                logger.warning(
-                    "partition attempt %d/%d failed (%s); retrying",
-                    attempt + 1, attempts, e)
+        # the shared RetryPolicy owns attempts/backoff/budget
+        # (resilience/policy.py): a transient partition failure
+        # re-runs cleanly from its source; deterministic errors and
+        # budget exhaustion propagate typed
+        return self.retry_policy.call(
+            lambda: self._run_once(source, plan, index),
+            key=f"partition:{index}",
+            on_retry=self._log_retry(f"partition {index}"))
+
+    def _log_retry(self, what: str):
+        def on_retry(attempt, exc, delay_s):
+            default_registry().counter("engine.retries").add()
+            logger.warning(
+                "%s attempt %d/%d failed (%s); retrying in %.3fs",
+                what, attempt, 1 + max(0, self.max_retries), exc,
+                delay_s)
+        return on_retry
 
     @staticmethod
     def _rechunkable(stage) -> bool:
@@ -374,28 +376,25 @@ class LocalEngine:
 
     def _apply_stream_stage(self, stage, batch, index) -> pa.RecordBatch:
         """Run one stage call on the consumer thread with the same
-        retry/metrics semantics as the pooled path. Retrying here is
-        pure: the input block is already materialized (no source
-        re-load), and stage fns are pure by the plan contract."""
-        attempts = 1 + max(0, self.max_retries)
-        for attempt in range(attempts):
-            try:
-                timings = [] if self.stage_metrics is not None else None
-                if stage.kind == "device":
-                    with self._device_lock:
-                        out = self._run_stage(stage, batch, index, timings)
-                else:
+        retry/metrics semantics as the pooled path (the shared
+        RetryPolicy). Retrying here is pure: the input block is
+        already materialized (no source re-load), and stage fns are
+        pure by the plan contract."""
+        def once():
+            timings = [] if self.stage_metrics is not None else None
+            if stage.kind == "device":
+                with self._device_lock:
                     out = self._run_stage(stage, batch, index, timings)
-                if timings:
-                    for name, seconds, rows in timings:
-                        self.stage_metrics.add(name, seconds, rows)
-                return out
-            except self.retryable_exceptions as e:
-                if is_deterministic_jax_error(e) or attempt + 1 >= attempts:
-                    raise
-                logger.warning(
-                    "stream stage %s attempt %d/%d failed (%s); retrying",
-                    stage.name, attempt + 1, attempts, e)
+            else:
+                out = self._run_stage(stage, batch, index, timings)
+            if timings:
+                for name, seconds, rows in timings:
+                    self.stage_metrics.add(name, seconds, rows)
+            return out
+
+        return self.retry_policy.call(
+            once, key=f"stream:{stage.name}",
+            on_retry=self._log_retry(f"stream stage {stage.name}"))
 
     def _stream_plain(self, stream, stage):
         for idx, batch in stream:

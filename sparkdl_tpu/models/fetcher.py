@@ -18,6 +18,8 @@ from typing import Any, Optional
 
 from flax import serialization
 
+from sparkdl_tpu.resilience.faults import maybe_fail
+
 DEFAULT_CACHE_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "sparkdl_tpu", "models")
 
@@ -68,6 +70,11 @@ class ModelFetcher:
             expected_sha256: Optional[str] = None) -> Any:
         """Load cached params into the structure of ``template``,
         verifying content hash (stored sidecar, or explicit)."""
+        # fault-injection site (resilience/faults.py): model-weight
+        # I/O — the cold-start drill (a fetch that fails transiently
+        # retries at its caller; a corrupt blob fails the hash check
+        # below loudly either way)
+        maybe_fail("model.fetch")
         path = self._path(fileName)
         with open(path, "rb") as f:
             blob = f.read()

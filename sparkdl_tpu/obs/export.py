@@ -261,6 +261,12 @@ class TelemetryServer:
             # postmortem never disagree
             "slo": slo_tracker().status(),
             "request_log": request_log().status(),
+            # the resilience layer's drill/recovery state: fault-
+            # injection config + per-site counts, live circuit
+            # verdicts, retry/shed totals (docs/RESILIENCE.md) — same
+            # shape as the flight bundle's section, so a curl and a
+            # postmortem never disagree
+            "resilience": _flight.resilience_state(),
             "servers": servers,
             "metrics_count": len(self._registry.snapshot()),
         }

@@ -178,6 +178,37 @@ def _request_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def resilience_state() -> dict:
+    """The resilience layer's drill/recovery state — injection config
+    + per-site counts (resilience/faults.py), every live session's
+    circuit verdict, and the retry/shed totals — ONE shape shared by
+    the flight bundle, ``/statusz``, and bench's ``resilience`` block
+    (docs/RESILIENCE.md), so a bench row, a curl, and a postmortem
+    never disagree; degrades like every probe."""
+    try:
+        from sparkdl_tpu.resilience import faults
+        out: Dict[str, Any] = {"faults": faults.state()}
+        snap = default_registry().snapshot()
+        out["totals"] = {
+            k: snap[k] for k in (
+                "faults.injected", "serve.retries", "serve.shed",
+                "serve.shed_rows", "serve.circuit_rejections",
+                "engine.retries", "resilience.retries",
+                "resilience.budget_denied") if k in snap}
+        circuits: Dict[str, Any] = {}
+        for server in live_servers():
+            try:
+                for name, sess in getattr(server, "_sessions",
+                                          {}).items():
+                    circuits[name] = sess.circuit.status()
+            except Exception as e:
+                circuits["error"] = f"{type(e).__name__}: {e}"
+        out["circuits"] = circuits
+        return out
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -292,6 +323,7 @@ class FlightRecorder:
             "autotune": _autotune_state(),
             "slo": _slo_state(),
             "requests": _request_state(),
+            "resilience": resilience_state(),
             "extra": extra or {},
         }
 

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkdl_tpu.obs import default_registry, span
 from sparkdl_tpu.obs import watchdog as _watchdog
+from sparkdl_tpu.resilience.faults import maybe_fail
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -57,6 +58,11 @@ class _CollectiveLaunch:
         self._lock = lock
 
     def __enter__(self):
+        # fault-injection site (resilience/faults.py): fires BEFORE
+        # any acquire, so an injected launch failure exercises the
+        # caller's recovery without ever holding (or leaking) the
+        # process lock
+        maybe_fail("collective.launch")
         t0 = time.perf_counter()
         held = False
         # anything that raises WHILE the lock is held (span recording,

@@ -1,0 +1,364 @@
+"""Retry policy + circuit breaking: the shared recovery mechanics.
+
+One :class:`RetryPolicy` implements every bounded re-attempt in the
+tree (the engine's partition retry, the serve dispatcher's micro-batch
+re-dispatch), so attempts, backoff, and amplification control cannot
+drift between layers:
+
+* **bounded attempts** — a try is granted only while ``attempt <
+  attempts``; exhaustion re-raises the original error unchanged.
+* **exponential backoff + deterministic jitter** — delay doubles per
+  attempt up to ``max_backoff_s``, stretched by a jitter fraction
+  derived from a CRC of ``(seed, key, attempt)``: reproducible in
+  tests and drills (no wall-clock, no process-global RNG — the
+  autotune/H5 discipline), yet de-synchronized across keys so a
+  thundering herd of retries doesn't re-converge on the dependency it
+  just knocked over.
+* **retry budget** — a token bucket: every protected call deposits
+  ``budget_ratio`` tokens (capped), every granted retry spends one.
+  Under sustained failure the retry rate is therefore bounded at
+  ``budget_ratio`` × the offered call rate — a failing dependency can
+  never see its load *amplified* by its callers' retries (the
+  Finagle/gRPC retry-budget discipline). Exhaustion raises the typed
+  :class:`RetryBudgetExhausted` (a ``PermanentError`` — outer layers
+  must not retry the refusal to retry).
+* **deadline awareness** — a retry whose backoff would land past the
+  caller's deadline is not granted: the original error propagates
+  while the deadline still has value to the caller.
+
+:class:`CircuitBreaker` is the serve layer's per-``ModelSession``
+fail-fast state machine: ``closed`` (normal) → ``open`` after
+``failure_threshold`` consecutive dispatch failures (submissions shed
+immediately with the typed :class:`CircuitOpen` instead of queueing
+toward a dead model and burning their deadlines) → ``half_open`` after
+``reset_timeout_s`` (up to ``half_open_probes`` requests pass through
+as probes) → ``closed`` again on a probe success, straight back to
+``open`` on a probe failure. State publishes as the
+``serve.circuit_state`` gauge (0 closed / 1 open / 2 half-open) and
+rides ``/statusz`` + flight bundles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+from sparkdl_tpu.obs.registry import default_registry
+from sparkdl_tpu.resilience.errors import (
+    PermanentError,
+    TransientError,
+    is_transient,
+)
+
+
+class RetryBudgetExhausted(PermanentError):
+    """The retry budget denied a retry that attempts/backoff would
+    have granted — the failing dependency is already saturated with
+    re-attempts. Typed permanent: retrying the refusal amplifies the
+    exact load the budget exists to bound. Carries the original
+    failure as ``__cause__``."""
+
+
+class CircuitOpen(TransientError):
+    """The session's circuit breaker is open: the model failed
+    ``failure_threshold`` consecutive dispatches and new submissions
+    are shed fast-and-typed instead of burning their deadline in a
+    queue the dispatcher cannot serve. Transient by classification —
+    a later, BACKED-OFF attempt may find the circuit half-open and
+    probe through (docs/RESILIENCE.md)."""
+
+
+class RetryPolicy:
+    """Bounded, budgeted, deterministically-jittered retry (module
+    docstring). One instance is shared by every thread retrying
+    against the same dependency — the token bucket only bounds
+    amplification if the callers share it."""
+
+    # sparkdl-lint H3 contract: the token bucket is hit from every
+    # retrying thread at once — writes hold self._lock
+    _lock_guards = ("tokens",)
+
+    def __init__(self, attempts: int = 3,
+                 base_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 jitter_frac: float = 0.25,
+                 budget_ratio: float = 0.2,
+                 budget_cap: float = 8.0,
+                 retryable: Optional[Callable[[BaseException], bool]]
+                 = None,
+                 seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        if base_backoff_s < 0 or max_backoff_s < base_backoff_s:
+            raise ValueError(
+                f"need 0 <= base_backoff_s <= max_backoff_s, got "
+                f"{base_backoff_s}/{max_backoff_s}")
+        if not 0.0 <= jitter_frac <= 1.0:
+            raise ValueError(
+                f"jitter_frac must be in [0, 1], got {jitter_frac}")
+        if budget_ratio <= 0 or budget_cap < 1:
+            raise ValueError(
+                f"need budget_ratio > 0 and budget_cap >= 1, got "
+                f"{budget_ratio}/{budget_cap}")
+        self.attempts = int(attempts)
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter_frac = float(jitter_frac)
+        self.budget_ratio = float(budget_ratio)
+        self.budget_cap = float(budget_cap)
+        self.seed = int(seed)
+        # the bucket starts FULL: the first failure after a quiet
+        # period always has budget — the bound is on sustained
+        # amplification, not on ever retrying at all
+        self.tokens = float(budget_cap)
+        self._retryable = retryable if retryable is not None \
+            else is_transient
+        self._sleep = sleep
+        self._lock = threading.Lock()
+
+    # -- the pieces (the serve dispatcher composes these itself) -------------
+
+    def backoff_s(self, attempt: int, key: str = "") -> float:
+        """Delay before re-attempt number ``attempt`` (1-based count
+        of failures so far): exponential up to ``max_backoff_s``, plus
+        the deterministic jitter fraction for ``(seed, key,
+        attempt)`` — same inputs, same delay, forever."""
+        if attempt < 1:
+            raise ValueError(f"attempt must be >= 1, got {attempt}")
+        base = min(self.base_backoff_s * (2.0 ** (attempt - 1)),
+                   self.max_backoff_s)
+        frac = (zlib.crc32(f"{self.seed}:{key}:{attempt}".encode())
+                % 1000) / 999.0
+        return base * (1.0 + self.jitter_frac * frac)
+
+    def deposit(self) -> None:
+        """One protected call started: earn ``budget_ratio`` tokens
+        (capped). Callers using the low-level pieces call this once
+        per protected operation, NOT per attempt."""
+        with self._lock:
+            self.tokens = min(self.budget_cap,
+                              self.tokens + self.budget_ratio)
+
+    def try_spend(self) -> bool:
+        """Spend one retry token if available."""
+        with self._lock:
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+    def grant(self, attempt: int, exc: BaseException, key: str = "",
+              deadline: Optional[float] = None) -> Optional[float]:
+        """The retry decision after failure number ``attempt``:
+        the backoff delay to sleep when granted; ``None`` when the
+        attempt cap, the classifier, or the deadline says the original
+        error should propagate; raises :class:`RetryBudgetExhausted`
+        (chained) when only the budget stands in the way."""
+        if attempt >= self.attempts or not self._retryable(exc):
+            return None
+        delay = self.backoff_s(attempt, key)
+        if deadline is not None \
+                and time.perf_counter() + delay >= deadline:
+            # the retry would outlive the deadline: fail NOW, while
+            # the typed error still reaches the caller in time to act
+            return None
+        if not self.try_spend():
+            default_registry().counter(
+                "resilience.budget_denied").add()
+            raise RetryBudgetExhausted(
+                f"retry budget exhausted for {key or 'call'!r} "
+                f"(attempt {attempt}/{self.attempts}, ratio="
+                f"{self.budget_ratio}): the dependency is saturated "
+                "with re-attempts; shed or back off at the caller "
+                "(docs/RESILIENCE.md)") from exc
+        default_registry().counter("resilience.retries").add()
+        return delay
+
+    # -- the whole loop ------------------------------------------------------
+
+    def call(self, fn: Callable, key: str = "",
+             deadline: Optional[float] = None,
+             on_retry: Optional[Callable] = None):
+        """Run ``fn()`` under the policy: returns its result, retries
+        classified-transient failures within attempts/budget/deadline
+        (sleeping the jittered backoff between tries), re-raises the
+        original error on exhaustion. ``on_retry(attempt, exc,
+        delay_s)`` observes each granted retry (logging, metrics).
+        ``deadline`` is an absolute ``time.perf_counter()`` instant."""
+        self.deposit()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as exc:
+                attempt += 1
+                delay = self.grant(attempt, exc, key=key,
+                                   deadline=deadline)
+                if delay is None:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, exc, delay)
+                self._sleep(delay)
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        # a bound sleep is config, not state; the default travels as
+        # None and is re-bound on arrival
+        if state["_sleep"] is time.sleep:
+            state["_sleep"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        if self._sleep is None:
+            self._sleep = time.sleep
+        self._lock = threading.Lock()
+
+
+#: circuit states, with the gauge encoding (``serve.circuit_state``)
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+CIRCUIT_HALF_OPEN = "half_open"
+_STATE_CODES = {CIRCUIT_CLOSED: 0, CIRCUIT_OPEN: 1, CIRCUIT_HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Per-dependency fail-fast state machine (module docstring).
+    ``allow()`` gates admissions; ``record_success()`` /
+    ``record_failure()`` feed it outcomes. All transitions hold the
+    lock; the clock is injectable for deterministic tests."""
+
+    # sparkdl-lint H3 contract: submitters call allow() while the
+    # dispatcher records outcomes — every state write holds self._lock
+    _lock_guards = ("state", "consecutive_failures", "opens",
+                    "probes_inflight")
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 1.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.perf_counter):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got "
+                f"{failure_threshold}")
+        if reset_timeout_s <= 0:
+            raise ValueError(
+                f"reset_timeout_s must be positive, got "
+                f"{reset_timeout_s}")
+        if half_open_probes < 1:
+            raise ValueError(
+                f"half_open_probes must be >= 1, got "
+                f"{half_open_probes}")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = int(half_open_probes)
+        self.state = CIRCUIT_CLOSED
+        self.consecutive_failures = 0
+        self.opens = 0
+        self.probes_inflight = 0
+        self._opened_at = 0.0
+        self._last_probe_at = 0.0
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """May a new request pass? Closed: always. Open: no — until
+        ``reset_timeout_s`` has elapsed, which flips to half-open.
+        Half-open: yes for up to ``half_open_probes`` in-flight
+        probes, no beyond — but a probe window older than
+        ``reset_timeout_s`` with no outcome re-opens: a probe that
+        died BEFORE dispatch (rejected at the queue, expired, shed,
+        abandoned by shutdown) produces no ``record_*`` call, and a
+        breaker that waited on it forever would wedge every future
+        submit on a long-recovered model."""
+        with self._lock:
+            if self.state == CIRCUIT_CLOSED:
+                return True
+            now = self._clock()
+            if self.state == CIRCUIT_OPEN:
+                if now - self._opened_at < self.reset_timeout_s:
+                    return False
+                self.state = CIRCUIT_HALF_OPEN
+                self.probes_inflight = 0
+            if self.probes_inflight < self.half_open_probes:
+                self.probes_inflight += 1
+                self._last_probe_at = now
+                return True
+            if now - self._last_probe_at >= self.reset_timeout_s:
+                # the outstanding probe(s) never produced an outcome —
+                # self-heal by opening a fresh probe window instead of
+                # staying wedged
+                self.probes_inflight = 1
+                self._last_probe_at = now
+                return True
+            return False
+
+    def record_success(self) -> None:
+        """One dispatch succeeded: failures reset; a half-open probe
+        success closes the circuit."""
+        with self._lock:
+            self.consecutive_failures = 0
+            self.probes_inflight = 0
+            self.state = CIRCUIT_CLOSED
+
+    def record_failure(self) -> None:
+        """One dispatch failed: a half-open probe failure re-opens
+        immediately; closed trips open at ``failure_threshold``
+        consecutive failures."""
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == CIRCUIT_HALF_OPEN or (
+                    self.state == CIRCUIT_CLOSED
+                    and self.consecutive_failures
+                    >= self.failure_threshold):
+                if self.state != CIRCUIT_OPEN:
+                    self.opens += 1
+                self.state = CIRCUIT_OPEN
+                self._opened_at = self._clock()
+                self.probes_inflight = 0
+
+    @property
+    def state_code(self) -> int:
+        """The ``serve.circuit_state`` gauge encoding (0 closed /
+        1 open / 2 half-open)."""
+        return _STATE_CODES[self.state]
+
+    def status(self) -> dict:
+        """``/statusz`` / flight-bundle shape."""
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "opens": self.opens,
+                "failure_threshold": self.failure_threshold,
+                "reset_timeout_s": self.reset_timeout_s,
+            }
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        if state["_clock"] is time.perf_counter:
+            state["_clock"] = None
+        # perf_counter origins are per-process: a shipped breaker
+        # arrives closed-or-open by value but its open timestamp is
+        # meaningless there — re-anchor so a deserialized OPEN circuit
+        # waits a full reset_timeout before probing
+        state["_opened_at"] = 0.0
+        state["_last_probe_at"] = 0.0
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        if self._clock is None:
+            self._clock = time.perf_counter
+        if self.state == CIRCUIT_OPEN:
+            self._opened_at = self._clock()
+        self._lock = threading.Lock()

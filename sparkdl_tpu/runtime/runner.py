@@ -96,6 +96,7 @@ from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import default_registry, span, timed_device_get
 from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
+from sparkdl_tpu.resilience.faults import maybe_fail
 from sparkdl_tpu.runtime.sanitize import ship_guard
 
 # In-flight device batches before the oldest result is fetched, for the
@@ -448,6 +449,11 @@ def drain_bounded(pending: "collections.deque", sink: SlabSink,
     ``limit`` remain enqueued (the backpressure half of async
     dispatch)."""
     while len(pending) > limit:
+        # fault-injection site (resilience/faults.py): the result
+        # drain — a dropped link mid-device_get is the realistic
+        # tunnel failure. The batch stays queued: a retried run()
+        # re-dispatches from its own inputs, never from this queue.
+        maybe_fail("ship.drain")
         sink.write(*pending.popleft())
 
 
@@ -548,6 +554,10 @@ def dispatch_chunks(fn, params, chunks, strategy: str, max_inflight: int,
                     break
                 valid, chunk, placed_ok = nxt[0], nxt[1], False
             watchdog_pulse(wd_source)
+            # fault-injection site: one chunk's input-side placement/
+            # dispatch (strategy-independent, so drills hit every
+            # backend the same way; disarmed: one armed-check)
+            maybe_fail("ship.device_put")
             if not placed_ok and place is not None:
                 put_t0 = time.perf_counter() if phases is not None \
                     else 0.0

@@ -19,18 +19,21 @@ invariants the offline layers enforce (docs/SERVING.md):
   obs lane.
 """
 
+from sparkdl_tpu.resilience.policy import CircuitOpen
 from sparkdl_tpu.serve.batching import (
     DeadlineExceeded,
     Request,
     RequestQueue,
     ServerClosed,
     ServerOverloaded,
+    ShedForPriority,
 )
 from sparkdl_tpu.serve.config import ServeConfig
 from sparkdl_tpu.serve.metrics import ServeMetrics
 from sparkdl_tpu.serve.server import ModelServer, ModelSession
 
 __all__ = [
+    "CircuitOpen",
     "DeadlineExceeded",
     "ModelServer",
     "ModelSession",
@@ -40,4 +43,5 @@ __all__ = [
     "ServeMetrics",
     "ServerClosed",
     "ServerOverloaded",
+    "ShedForPriority",
 ]

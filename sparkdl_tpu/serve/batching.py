@@ -42,8 +42,22 @@ from sparkdl_tpu.obs import span
 
 
 class ServerOverloaded(RuntimeError):
-    """The bounded queue cannot admit this request — the caller sheds
-    load or retries later; the server never grows the queue instead."""
+    """The bounded queue cannot admit this request (or it was shed to
+    admit a higher-priority one / protect a burning availability
+    budget). The server never grows the queue instead; the caller's
+    contract is priority + backed-off retry (docs/RESILIENCE.md):
+    submit latency-critical traffic with a higher ``priority=`` class
+    — saturation sheds lowest-priority-first — and re-submit shed work
+    under a bounded, backed-off policy
+    (:class:`~sparkdl_tpu.resilience.policy.RetryPolicy`), never a
+    tight resubmit loop."""
+
+
+class ShedForPriority(ServerOverloaded):
+    """The request was shed by the SLO-aware admission machinery
+    specifically for its priority class (burn-driven early shed) —
+    distinguishable from a plain full-queue rejection so the
+    ``serve.shed`` accounting stays honest."""
 
 
 class DeadlineExceeded(TimeoutError):
@@ -71,13 +85,18 @@ class Request:
     the dispatcher's. ``None`` disarmed — the no-op regime."""
 
     __slots__ = ("inputs", "n", "deadline", "submitted", "future",
-                 "taken", "timeline", "_slabs", "_done_rows")
+                 "taken", "timeline", "priority", "_slabs",
+                 "_done_rows")
 
     def __init__(self, inputs: Dict[str, np.ndarray], n: int,
-                 deadline: Optional[float], timeline=None):
+                 deadline: Optional[float], timeline=None,
+                 priority: int = 0):
         self.inputs = inputs
         self.n = n
         self.deadline = deadline          # absolute perf_counter instant
+        # SLO-aware admission class (docs/RESILIENCE.md): higher =
+        # more important; saturation sheds lowest-priority-first
+        self.priority = int(priority)
         # ONE clock read with the timeline when present: the latency
         # the reservoir observes and the timeline's phase sum must be
         # the same number, not two reads apart
@@ -172,21 +191,101 @@ class RequestQueue:
 
     # -- producers -----------------------------------------------------------
 
-    def offer(self, req: Request, max_rows: int) -> int:
+    def offer(self, req: Request, max_rows: int,
+              burn_rate: float = 0.0,
+              watermark_rows: Optional[int] = None
+              ) -> Tuple[int, List[Request]]:
         """Admit ``req`` or raise the typed rejection; returns the
-        post-admission queue depth in rows (for the gauge)."""
+        post-admission queue depth in rows (for the gauge) plus the
+        lower-priority requests SHED to make room — removed from the
+        queue here, failed by the caller OUTSIDE the lock (failing a
+        future can run caller callbacks, which must never re-enter the
+        queue under its own condition).
+
+        SLO-aware admission (docs/RESILIENCE.md), lowest-priority
+        first:
+
+        * **saturation displacement** — when admission would overflow
+          ``max_rows``, queued not-yet-dispatched requests of STRICTLY
+          lower priority are shed (lowest class first, newest first
+          within a class) until the arrival fits; if shedding cannot
+          free enough rows, the arrival itself is rejected.
+        * **burn-driven early shed** — while the availability error
+          budget is burning (``burn_rate >= 1.0``, read from the live
+          SLO gauges by the caller) and the queue sits above
+          ``watermark_rows``, an arrival of strictly lower priority
+          than the highest class currently queued is rejected at
+          admission: under overload it would likely expire anyway,
+          and every expiry burns more of exactly the budget being
+          protected.
+        """
         with self._lock:
             if self.closing:
                 raise ServerClosed("server is closed to new requests")
+            victims: List[Request] = []
             if self.rows + req.n > max_rows:
-                raise ServerOverloaded(
-                    f"queue holds {self.rows} rows; admitting "
-                    f"{req.n} more would exceed max_queue_rows="
-                    f"{max_rows} — shed load or retry")
+                victims = self._pick_victims(req.priority,
+                                             self.rows + req.n
+                                             - max_rows)
+                if victims is None:
+                    raise ServerOverloaded(
+                        f"queue holds {self.rows} rows; admitting "
+                        f"{req.n} more would exceed max_queue_rows="
+                        f"{max_rows} and no lower-priority rows are "
+                        "queued to shed — submit latency-critical "
+                        "traffic with a higher priority= class, and "
+                        "retry shed work with bounded backoff "
+                        "(resilience.RetryPolicy, docs/RESILIENCE.md)"
+                        " — never a tight resubmit loop")
+                for v in victims:
+                    self._q.remove(v)
+                    self.rows -= v.n - v.taken
+            elif (burn_rate >= 1.0 and watermark_rows is not None
+                    and self.rows + req.n > watermark_rows
+                    and req.priority < self._max_queued_priority()):
+                raise ShedForPriority(
+                    f"availability error budget is burning (burn rate "
+                    f"{burn_rate:.2f} >= 1) and the queue is past its "
+                    f"shed watermark ({self.rows} rows): priority "
+                    f"{req.priority} sheds below the highest queued "
+                    "class — raise priority= for latency-critical "
+                    "traffic, retry with bounded backoff "
+                    "(resilience.RetryPolicy, docs/RESILIENCE.md)")
             self._q.append(req)
             self.rows += req.n
             self._cond.notify()
-            return self.rows
+            return self.rows, victims
+
+    def _pick_victims(self, priority: int,
+                      overflow: int) -> Optional[List[Request]]:
+        """Holding self._lock: the strictly-lower-priority,
+        not-yet-dispatched requests to shed for an ``overflow``-row
+        admission — lowest class first, newest first within a class
+        (the oldest of a class has waited longest and keeps its
+        place). None when shedding cannot free enough rows. Requests
+        with rows already placed in a micro-batch (``taken > 0``) are
+        never shed: their device work is already paid for."""
+        candidates = sorted(
+            (r for r in self._q
+             if r.priority < priority and r.taken == 0
+             and not r.future.done()),
+            key=lambda r: (r.priority, -r.submitted))
+        victims: List[Request] = []
+        freed = 0
+        for r in candidates:
+            if freed >= overflow:
+                break
+            victims.append(r)
+            freed += r.n
+        if freed < overflow:
+            return None
+        return victims
+
+    def _max_queued_priority(self) -> int:
+        """Holding self._lock: the highest priority class with live
+        queued rows (-1 on an empty queue)."""
+        return max((r.priority for r in self._q
+                    if not r.future.done()), default=-1)
 
     def depth(self) -> int:
         with self._lock:

@@ -20,6 +20,17 @@ operator owns (docs/SERVING.md "the queueing model"):
   dispatcher to finish the queued work before giving up (with a
   warning — never a hang).
 
+Resilience knobs (docs/RESILIENCE.md): ``dispatch_retries`` /
+``retry_base_backoff_s`` / ``retry_budget_ratio`` parameterize the
+per-session :class:`~sparkdl_tpu.resilience.policy.RetryPolicy` a
+failed micro-batch re-dispatches surviving requests under;
+``circuit_failure_threshold`` / ``circuit_reset_s`` /
+``circuit_probes`` parameterize the per-session circuit breaker
+(closed → open → half-open) that sheds submissions against a
+persistently broken model fast-and-typed; ``shed_watermark_frac`` is
+the queue-fullness fraction above which a burning availability budget
+starts shedding lowest-priority arrivals at admission.
+
 Frozen + lock-free, so the config pickles as-is: a shipped
 :class:`~sparkdl_tpu.serve.server.ModelServer` carries its config
 across the wire while workers/locks/queues drop (the StageMetrics
@@ -41,6 +52,16 @@ class ServeConfig:
     max_queue_rows: int = 4096
     default_deadline_s: Optional[float] = None
     drain_timeout_s: float = 30.0
+    # resilience (docs/RESILIENCE.md): micro-batch re-dispatch ...
+    dispatch_retries: int = 2
+    retry_base_backoff_s: float = 0.01
+    retry_budget_ratio: float = 0.2
+    # ... circuit breaking ...
+    circuit_failure_threshold: int = 5
+    circuit_reset_s: float = 1.0
+    circuit_probes: int = 1
+    # ... and SLO-aware admission
+    shed_watermark_frac: float = 0.5
 
     def __post_init__(self):
         if self.max_wait_s < 0:
@@ -59,3 +80,31 @@ class ServeConfig:
             raise ValueError(
                 f"drain_timeout_s must be positive, got "
                 f"{self.drain_timeout_s}")
+        if self.dispatch_retries < 0:
+            raise ValueError(
+                f"dispatch_retries must be >= 0, got "
+                f"{self.dispatch_retries}")
+        if self.retry_base_backoff_s < 0:
+            raise ValueError(
+                f"retry_base_backoff_s must be >= 0, got "
+                f"{self.retry_base_backoff_s}")
+        if self.retry_budget_ratio <= 0:
+            raise ValueError(
+                f"retry_budget_ratio must be positive, got "
+                f"{self.retry_budget_ratio}")
+        if self.circuit_failure_threshold < 1:
+            raise ValueError(
+                f"circuit_failure_threshold must be >= 1, got "
+                f"{self.circuit_failure_threshold}")
+        if self.circuit_reset_s <= 0:
+            raise ValueError(
+                f"circuit_reset_s must be positive, got "
+                f"{self.circuit_reset_s}")
+        if self.circuit_probes < 1:
+            raise ValueError(
+                f"circuit_probes must be >= 1, got "
+                f"{self.circuit_probes}")
+        if not 0.0 < self.shed_watermark_frac <= 1.0:
+            raise ValueError(
+                f"shed_watermark_frac must be in (0, 1], got "
+                f"{self.shed_watermark_frac}")

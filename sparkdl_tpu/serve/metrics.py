@@ -33,7 +33,8 @@ class ServeMetrics:
     # concurrently — every counter write holds self._lock
     _lock_guards = ("requests", "rows", "batches", "batch_rows",
                     "batch_capacity_rows", "rejections",
-                    "deadline_misses", "failures")
+                    "deadline_misses", "failures", "retries", "shed",
+                    "shed_rows", "circuit_rejections")
 
     def __init__(self):
         self.requests = 0
@@ -50,6 +51,14 @@ class ServeMetrics:
         # over successful requests only, availability over the rest
         # (pinned by tests/test_request_obs.py).
         self.failures = 0
+        # resilience counters (docs/RESILIENCE.md): granted micro-
+        # batch re-dispatches; requests/rows shed by priority
+        # displacement or the burn-driven admission gate; submissions
+        # refused by an open circuit breaker
+        self.retries = 0
+        self.shed = 0
+        self.shed_rows = 0
+        self.circuit_rejections = 0
         self._latency = Reservoir("serve.latency_seconds")
         self._lock = threading.Lock()
 
@@ -71,6 +80,19 @@ class ServeMetrics:
     def add_failure(self) -> None:
         with self._lock:
             self.failures += 1
+
+    def add_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def add_shed(self, rows: int) -> None:
+        with self._lock:
+            self.shed += 1
+            self.shed_rows += rows
+
+    def add_circuit_rejection(self) -> None:
+        with self._lock:
+            self.circuit_rejections += 1
 
     def add_batch(self, valid_rows: int, capacity_rows: int) -> None:
         with self._lock:
@@ -112,7 +134,11 @@ class ServeMetrics:
                     "batches": self.batches,
                     "rejections": self.rejections,
                     "deadline_misses": self.deadline_misses,
-                    "failures": self.failures}
+                    "failures": self.failures,
+                    "retries": self.retries,
+                    "shed": self.shed,
+                    "shed_rows": self.shed_rows,
+                    "circuit_rejections": self.circuit_rejections}
         vals["batch_fill_ratio"] = round(self.batch_fill_ratio, 4)
         p50, p99 = self._latency.quantiles((0.5, 0.99))
         vals["latency_p50_ms"] = round(p50 * 1e3, 3)
@@ -133,7 +159,11 @@ class ServeMetrics:
                     "serve.batches": self.batches,
                     "serve.rejections": self.rejections,
                     "serve.deadline_misses": self.deadline_misses,
-                    "serve.failures": self.failures}
+                    "serve.failures": self.failures,
+                    "serve.retries": self.retries,
+                    "serve.shed": self.shed,
+                    "serve.shed_rows": self.shed_rows,
+                    "serve.circuit_rejections": self.circuit_rejections}
         vals["serve.batch_fill_ratio"] = self.batch_fill_ratio
         p50, p99 = self._latency.quantiles((0.5, 0.99))
         vals["serve.latency_p50_ms"] = p50 * 1e3

@@ -63,6 +63,13 @@ from sparkdl_tpu.obs.slo import slo_tracker
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.parallel.inference import ShardedBatchRunner
 from sparkdl_tpu.parallel.mesh import mesh_has_collectives
+from sparkdl_tpu.resilience.errors import is_transient
+from sparkdl_tpu.resilience.faults import maybe_fail
+from sparkdl_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpen,
+    RetryPolicy,
+)
 from sparkdl_tpu.runtime.runner import (
     BatchRunner,
     ChunkPhases,
@@ -77,6 +84,7 @@ from sparkdl_tpu.serve.batching import (
     RequestQueue,
     ServerClosed,
     ServerOverloaded,
+    ShedForPriority,
 )
 from sparkdl_tpu.serve.config import ServeConfig
 from sparkdl_tpu.serve.metrics import ServeMetrics
@@ -112,6 +120,21 @@ class ModelSession:
         # attempted, True/False = runner.warmup()'s last answer (False
         # means "nothing to warm", e.g. a host backend)
         self.warmed: Optional[bool] = None
+        # resilience (docs/RESILIENCE.md): the micro-batch re-dispatch
+        # policy — bounded attempts, deterministic-jitter backoff, a
+        # retry budget so a broken model can't see its load amplified
+        # by its own dispatcher — and the per-session circuit breaker
+        # that sheds submissions fast-and-typed once the model fails
+        # persistently
+        self.retry_policy = RetryPolicy(
+            attempts=1 + config.dispatch_retries,
+            base_backoff_s=config.retry_base_backoff_s,
+            max_backoff_s=max(config.retry_base_backoff_s * 8, 0.25),
+            budget_ratio=config.retry_budget_ratio)
+        self.circuit = CircuitBreaker(
+            failure_threshold=config.circuit_failure_threshold,
+            reset_timeout_s=config.circuit_reset_s,
+            half_open_probes=config.circuit_probes)
         self._queue = RequestQueue()
         self._staging = PadStaging()
         self._worker: Optional[threading.Thread] = None
@@ -130,11 +153,22 @@ class ModelSession:
     # -- submission (any thread) ---------------------------------------------
 
     def submit(self, inputs: Dict[str, np.ndarray],
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               priority: int = 0) -> Future:
         """Validate, admit, enqueue; returns the Future the dispatcher
-        will resolve. Raises ``ServerOverloaded`` (queue full),
+        will resolve. Raises ``ServerOverloaded`` (queue full, or this
+        request was shed for its priority class), ``CircuitOpen`` (the
+        session's breaker is shedding a persistently broken model),
         ``ServerClosed``, or ``ValueError`` (signature mismatch) —
         all BEFORE enqueue, so a rejected caller holds nothing.
+
+        ``priority`` is the SLO admission class (higher = more
+        important, default 0): under saturation the queue sheds
+        lowest-priority-first — a higher-priority arrival displaces
+        queued lower-priority requests instead of being flat-rejected,
+        and while the availability error budget is burning, arrivals
+        below the highest queued class shed at admission
+        (docs/RESILIENCE.md).
 
         Buffer ownership: the queued request BORROWS the caller's
         arrays until its future resolves (copying at admission would
@@ -144,6 +178,9 @@ class ModelSession:
         input is cast (copied) at admission and is safe to reuse."""
         mf = self.runner.model_fn
         sig = mf.input_signature
+        if int(priority) < 0:
+            raise ValueError(
+                f"priority must be >= 0, got {priority}")
         raw = {k: np.asarray(v) for k, v in inputs.items()}
         n = check_row_counts(raw)
         if n == 0:
@@ -163,6 +200,11 @@ class ModelSession:
                 raise ValueError(
                     f"model {mf.name!r} inputs {missing} missing "
                     f"from request inputs {sorted(raw)}")
+            if not self.circuit.allow():
+                # the inline fast path sheds like the queued path: an
+                # open breaker means this runner is failing
+                # persistently — fail fast and typed
+                self._reject_circuit_open(None)
             fut: Future = Future()
             t0 = time.perf_counter()
             try:
@@ -171,12 +213,15 @@ class ModelSession:
                 # the inline fast path is still a request outcome: a
                 # broken runner hammered with empty probes must show
                 # up as failures + availability burn, not zero-metric
-                # silence ("outcomes always feed the SLO tracker")
+                # silence ("outcomes always feed the SLO tracker") —
+                # and as circuit evidence
+                self.circuit.record_failure()
                 self.metrics.add_request(0)
                 self.metrics.add_failure()
                 slo_tracker().record(ok=False)
                 self.metrics.publish(default_registry())
                 raise
+            self.circuit.record_success()
             fut.set_result(out)
             self.metrics.add_request(0)
             slo_tracker().record(
@@ -237,7 +282,14 @@ class ModelSession:
             raise ServerOverloaded(
                 f"request of {n} rows can never be admitted: "
                 f"max_queue_rows={self.config.max_queue_rows}")
-        req = Request(cast, n, abs_deadline, timeline=tl)
+        if not self.circuit.allow():
+            # fast-and-typed shed: a persistently broken model must
+            # not queue new requests toward their deadline
+            # (docs/RESILIENCE.md; closed→open→half-open transitions
+            # live in resilience/policy.py)
+            self._reject_circuit_open(tl)
+        req = Request(cast, n, abs_deadline, timeline=tl,
+                      priority=int(priority))
         enq_attrs = {"rows": n, "model": self.name}
         if tl is not None:
             # visible arg + the Perfetto flow START: the dispatch
@@ -246,18 +298,45 @@ class ModelSession:
             # flow (obs/trace.py trace_events)
             enq_attrs.update(request_id=tl.rid, flow_id=tl.rid,
                              flow_ph="s")
+        # SLO-aware admission (docs/RESILIENCE.md): the queue sheds
+        # lowest-priority-first under saturation, and early while the
+        # availability budget is burning. The burn rate is read from
+        # the live slo.* gauge (published rate-limited by the serve
+        # loop, refreshed at scrape time) — status() scans the whole
+        # outcome window and must not run per submit.
+        burn = reg.gauge("slo.availability.burn_rate").value
+        watermark = int(self.config.max_queue_rows
+                        * self.config.shed_watermark_frac)
         try:
             with span("enqueue", lane="serve", **enq_attrs):
-                depth = self._queue.offer(req,
-                                          self.config.max_queue_rows)
-        except ServerOverloaded:
+                depth, victims = self._queue.offer(
+                    req, self.config.max_queue_rows,
+                    burn_rate=burn, watermark_rows=watermark)
+        except ServerOverloaded as e:
             self.metrics.add_rejection()
+            if isinstance(e, ShedForPriority):
+                self.metrics.add_shed(n)
             slo_tracker().record(ok=False)
             if tl is not None:
                 rlog.record(tl.finish(time.perf_counter(), "rejected"),
                             submitted=tl.submitted)
             self.metrics.publish(reg)
             raise
+        for v in victims:
+            # displaced for this higher-priority admission: shed
+            # typed, counted, and recorded as an availability event
+            # (never a latency sample)
+            if v.fail(ServerOverloaded(
+                    f"shed from the queue (priority {v.priority}) to "
+                    f"admit a priority-{req.priority} request under "
+                    f"saturation (model {self.name!r}) — retry with "
+                    "bounded backoff (resilience.RetryPolicy, "
+                    "docs/RESILIENCE.md) or raise priority=")):
+                self.metrics.add_shed(v.n)
+                slo_tracker().record(ok=False)
+                self._record_outcome(v, "shed")
+        if victims:
+            self.metrics.publish(reg)
         # AFTER a successful admission: a submit that can only be
         # rejected (closed/overloaded) must not churn a fresh
         # short-lived dispatcher thread per call. The queued request
@@ -322,6 +401,7 @@ class ModelSession:
                 if batch.parts:
                     try:
                         self._dispatch(batch)
+                    # sparkdl-lint: allow[H13] -- not a retry: the failed batch is failed right here (typed + accounted), never re-attempted by this loop; re-dispatch lives in _dispatch under the bounded, backed-off RetryPolicy, and this loop only continues to NEW work, paced by collect()'s blocking wait and exited via its None signal
                     except Exception as e:
                         # a failed dispatch fails ITS requests; the
                         # dispatcher keeps serving the rest of the queue
@@ -339,6 +419,12 @@ class ModelSession:
                                 slo_tracker().record(ok=False)
                                 self._record_outcome(req, "failed")
                 self.metrics.publish(reg)
+                # the breaker's state as a gauge (0 closed / 1 open /
+                # 2 half-open; last-writer-wins across sessions, the
+                # ship.inflight precedent — per-model state lives in
+                # /statusz and flight bundles)
+                reg.gauge("serve.circuit_state").set(
+                    self.circuit.state_code)
                 # error budgets ride the serve-gauge cadence, rate-
                 # limited: status() scans the whole outcome window,
                 # which a per-micro-batch loop must not pay per batch
@@ -360,8 +446,90 @@ class ModelSession:
                 tl.finish(time.perf_counter(), status),
                 submitted=tl.submitted)
 
+    def _reject_circuit_open(self, tl) -> None:
+        """Shed one submission against the open breaker: typed,
+        counted, an availability event — and cheap, which is the whole
+        point (no queueing toward a dead model)."""
+        self.metrics.add_circuit_rejection()
+        slo_tracker().record(ok=False)
+        if tl is not None:
+            # flow=False: never enqueued — no flow start exists to end
+            request_log().record(
+                tl.finish(time.perf_counter(), "circuit_open"),
+                submitted=tl.submitted, flow=False)
+        self.metrics.publish(default_registry())
+        st = self.circuit.status()
+        raise CircuitOpen(
+            f"model {self.name!r} circuit is {st['state']} after "
+            f"{st['consecutive_failures']} consecutive dispatch "
+            f"failures — shedding fast instead of burning your "
+            f"deadline; probes resume within "
+            f"{st['reset_timeout_s']}s (docs/RESILIENCE.md)")
+
     def _dispatch(self, batch: MicroBatch) -> None:
-        valid = batch.valid
+        """Run one collected micro-batch, re-dispatching on transient
+        failure (docs/RESILIENCE.md): a failed dispatch fails only the
+        requests that cannot survive a retry — everything whose
+        deadline still covers the backed-off re-attempt re-dispatches
+        as a smaller batch instead of the whole coalesced batch
+        failing. Attempts/backoff/budget come from the session
+        RetryPolicy; every outcome feeds the circuit breaker. The
+        autotune poll stays OUTSIDE this loop (in _serve_loop) — a
+        controller step must never ride a retry storm."""
+        parts = batch.parts
+        self.retry_policy.deposit()
+        attempt = 0
+        while True:
+            try:
+                self._dispatch_once(parts)
+                self.circuit.record_success()
+                return
+            except Exception as exc:
+                self.circuit.record_failure()
+                attempt += 1
+                # grant() raises RetryBudgetExhausted (typed, chained)
+                # when only the budget refuses; None = don't retry
+                # (permanent error, attempts exhausted)
+                delay = self.retry_policy.grant(
+                    attempt, exc, key=f"serve:{self.name}")
+                if delay is None:
+                    raise
+                horizon = time.perf_counter() + delay
+                survivors: List = []
+                for part in parts:
+                    req = part[0]
+                    if req.deadline is None or req.deadline > horizon:
+                        survivors.append(part)
+                    elif req.fail(exc):
+                        # no deadline budget left for the re-attempt:
+                        # this request's dispatch failure is final —
+                        # counted and recorded now, not after a retry
+                        # it cannot use
+                        self.metrics.add_failure()
+                        slo_tracker().record(ok=False)
+                        self._record_outcome(req, "failed")
+                if not survivors:
+                    raise
+                self.metrics.add_retry()
+                logger.warning(
+                    "serve dispatch for model %r failed (%s); "
+                    "re-dispatching %d/%d surviving requests in "
+                    "%.3fs (attempt %d/%d)",
+                    self.name, exc, len(survivors), len(parts),
+                    delay, attempt, self.retry_policy.attempts)
+                with span("retry_backoff", lane="serve",
+                          model=self.name, attempt=attempt,
+                          requests=len(survivors)):
+                    time.sleep(delay)
+                parts = survivors
+
+    def _dispatch_once(self, parts: List) -> None:
+        valid = sum(rows for _req, _lo, rows in parts)
+        # fault-injection site (resilience/faults.py): THE serve drill
+        # seam — an injected failure here exercises re-dispatch,
+        # circuit transitions, and the flight-recorder trigger exactly
+        # as a real runner failure would
+        maybe_fail("serve.dispatch")
         # per-request phase marks (armed requests only): staging is
         # the assemble below, device is the runner call — both accrue
         # to every request the micro-batch carries (that IS each
@@ -369,16 +537,16 @@ class ModelSession:
         # marks lands in the coalesce remainder, so the breakdown
         # always sums to the end-to-end latency
         track = any(req.timeline is not None
-                    for req, _lo, _rows in batch.parts)
+                    for req, _lo, _rows in parts)
         t0 = time.perf_counter() if track else 0.0
-        inputs = self._assemble(batch.parts, valid)
+        inputs = self._assemble(parts, valid)
         t1 = time.perf_counter() if track else 0.0
         fill = valid / self.chunk
-        attrs = {"rows": valid, "requests": len(batch.parts),
+        attrs = {"rows": valid, "requests": len(parts),
                  "fill": round(fill, 3), "model": self.name}
         phases = None
         if track:
-            rids = [req.rid for req, _lo, _rows in batch.parts
+            rids = [req.rid for req, _lo, _rows in parts
                     if req.timeline is not None]
             # the flow STEP: every request in this batch links its
             # enqueue span to this dispatch slice (split requests get
@@ -394,13 +562,13 @@ class ModelSession:
                 out = self.runner.run(inputs)
         t3 = time.perf_counter() if track else 0.0
         if track:
-            for req, _lo, _rows in batch.parts:
+            for req, _lo, _rows in parts:
                 if req.timeline is not None:
                     req.timeline.add_batch(t1 - t0, t3 - t2,
                                            detail=phases)
         batch_lo = 0
         completed: List[Request] = []
-        for req, req_lo, rows in batch.parts:
+        for req, req_lo, rows in parts:
             w0 = time.perf_counter() if req.timeline is not None \
                 else 0.0
             if req.write(out, batch_lo, req_lo, rows):
@@ -581,14 +749,18 @@ class ModelServer:
 
     def submit(self, inputs: Dict[str, np.ndarray],
                deadline: Optional[float] = None,
-               model: Optional[str] = None) -> Future:
+               model: Optional[str] = None,
+               priority: int = 0) -> Future:
         """Submit one request: ``{name: [n, *row_shape]}`` host arrays
         → Future resolving to ``{name: [n, *out_shape]}``. ``deadline``
         is seconds from now; a request still queued past it fails with
         ``DeadlineExceeded`` BEFORE any device time is spent. A full
-        queue raises ``ServerOverloaded`` immediately (backpressure —
-        the caller sheds or retries)."""
-        return self.session(model).submit(inputs, deadline)
+        queue raises ``ServerOverloaded`` immediately (backpressure);
+        ``priority`` is the SLO admission class — saturation sheds
+        lowest-priority-first, so latency-critical tenants submit with
+        a higher class (docs/RESILIENCE.md)."""
+        return self.session(model).submit(inputs, deadline,
+                                          priority=priority)
 
     def warmup(self) -> Dict[str, bool]:
         """Pre-trace every registered session at its device batch
@@ -628,6 +800,15 @@ class ModelServer:
                     # the LIVE coalesce window (autotune may have
                     # moved it off config.max_wait_s)
                     "max_wait_s": s.max_wait_s,
+                    # the breaker's live verdict (docs/RESILIENCE.md):
+                    # state/consecutive_failures/opens — how an
+                    # operator tells "shedding by design" from "wedged"
+                    "circuit": s.circuit.status(),
+                    "retry": {
+                        "attempts": s.retry_policy.attempts,
+                        "budget_tokens": round(
+                            s.retry_policy.tokens, 2),
+                    },
                     "runner": {
                         "type": type(s.runner).__name__,
                         "strategy": getattr(s.runner, "strategy",

@@ -722,3 +722,145 @@ class TestSanitizer:
         with pytest.raises(RuntimeError, match="boom"):
             with sanitize.ship_guard():
                 raise RuntimeError("boom")
+
+
+# ---------------------------------------------------------------------------
+# H13 — unbounded retry loops (serve/runtime/data/resilience paths)
+
+
+class TestH13RetryLoops:
+    PATH = "sparkdl_tpu/serve/fixture.py"
+
+    def test_bare_while_true_swallow_flagged(self):
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        except Exception:\n"
+               "            pass\n")
+        found = _hits(src, "H13", self.PATH)
+        assert len(found) == 1
+        assert "bounded and backed-off" in found[0].message
+
+    def test_while_one_log_and_continue_flagged(self):
+        src = ("import logging\n"
+               "def pump(q):\n"
+               "    while 1:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        except Exception as e:\n"
+               "            logging.warning('retrying: %s', e)\n"
+               "            continue\n")
+        assert len(_hits(src, "H13", self.PATH)) == 1
+
+    def test_handler_that_reraises_clean(self):
+        # the RetryPolicy.call shape: the handler re-raises when the
+        # grant is refused — bounded by construction
+        src = ("def call(fn, policy):\n"
+               "    attempt = 0\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return fn()\n"
+               "        except Exception as exc:\n"
+               "            attempt += 1\n"
+               "            delay = policy.grant(attempt, exc)\n"
+               "            if delay is None:\n"
+               "                raise\n"
+               "            policy.sleep(delay)\n")
+        assert _hits(src, "H13", self.PATH) == []
+
+    def test_handler_that_breaks_clean(self):
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        except Exception:\n"
+               "            break\n")
+        assert _hits(src, "H13", self.PATH) == []
+
+    def test_try_inside_nested_for_still_flagged(self):
+        # a per-iteration-bounded inner loop does not bound the OUTER
+        # while True: the swallow re-enters it forever
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        for item in q.batch():\n"
+               "            try:\n"
+               "                q.dispatch(item)\n"
+               "            except Exception:\n"
+               "                pass\n")
+        assert len(_hits(src, "H13", self.PATH)) == 1
+
+    def test_break_of_inner_loop_is_not_an_escape(self):
+        # the break exits the handler's own for, not the while True —
+        # the outer loop still spins forever on sustained failure
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        except Exception:\n"
+               "            for h in q.hooks:\n"
+               "                break\n")
+        assert len(_hits(src, "H13", self.PATH)) == 1
+
+    def test_nested_unbounded_while_flagged_once_at_its_own_loop(self):
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        while True:\n"
+               "            try:\n"
+               "                q.dispatch()\n"
+               "            except Exception:\n"
+               "                pass\n"
+               "        return\n")
+        assert len(_hits(src, "H13", self.PATH)) == 1
+
+    def test_bounded_for_loop_not_flagged(self):
+        src = ("def pump(q):\n"
+               "    for attempt in range(3):\n"
+               "        try:\n"
+               "            return q.dispatch()\n"
+               "        except Exception:\n"
+               "            pass\n")
+        assert _hits(src, "H13", self.PATH) == []
+
+    def test_nested_def_handlers_not_attributed_to_outer_loop(self):
+        # a callback defined inside the loop owns its own handlers
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        def cb():\n"
+               "            try:\n"
+               "                q.poke()\n"
+               "            except Exception:\n"
+               "                pass\n"
+               "        if not q.step(cb):\n"
+               "            return\n")
+        assert _hits(src, "H13", self.PATH) == []
+
+    def test_out_of_scope_path_ignored(self):
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        except Exception:\n"
+               "            pass\n")
+        assert _hits(src, "H13", "sparkdl_tpu/models/fixture.py") == []
+
+    def test_suppressed_with_justification(self):
+        src = ("def pump(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.dispatch()\n"
+               "        # sparkdl-lint: allow[H13] -- paced by q's blocking wait; exits via q.closed\n"
+               "        except Exception:\n"
+               "            q.note_failure()\n")
+        assert _hits(src, "H13", self.PATH) == []
+        assert len(_suppressed(src, "H13", self.PATH)) == 1
+
+    def test_serve_loop_suppression_is_visible_not_invisible(self):
+        """The package's one real H13 — the dispatcher's serve loop —
+        must APPEAR as a suppressed finding with its justification."""
+        found = analyze_paths(
+            [os.path.join(PKG_DIR, "serve")], cache_path=None)
+        h13 = [f for f in found if f.rule == "H13"]
+        assert any(f.suppressed and "RetryPolicy" in f.suppression
+                   for f in h13), [f.render() for f in h13]
+        assert not any(not f.suppressed for f in h13)

@@ -13,7 +13,7 @@ witness chain; a mutable-instance-attr capture is caught; an unclosed
 ModelServer is caught while every escape-analysis negative stays
 silent; a swallowing serve handler is caught while the
 counter-recording form is accepted; the real package + tools +
-examples are lint-clean under all twelve rules.
+examples are lint-clean under all thirteen rules (H13 rode in with ISSUE 11's resilience layer).
 """
 
 import json
@@ -783,7 +783,7 @@ class TestSarif:
         assert len(by_supp) == 1
         assert "test" in by_supp[0]["suppressions"][0]["justification"]
         assert any("suppressions" not in r for r in results)
-        # the full twelve-rule catalogue rides in the driver
+        # the full thirteen-rule catalogue rides in the driver
         ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
         assert {"H1", "H10", "H11", "H12"} <= ids
 
@@ -936,17 +936,17 @@ class TestCacheVersionBump:
 
 
 # ---------------------------------------------------------------------------
-# meta: the twelve-rule acceptance gate
+# meta: the thirteen-rule acceptance gate
 
 
-class TestMetaTwelveRules:
+class TestMetaThirteenRules:
     def test_all_rules_includes_the_effect_system(self):
-        assert {"H10", "H11", "H12"} <= set(ALL_RULES)
-        assert len(ALL_RULES) == 12
+        assert {"H10", "H11", "H12", "H13"} <= set(ALL_RULES)
+        assert len(ALL_RULES) == 13
 
-    def test_package_tools_examples_clean_under_twelve_rules(self):
+    def test_package_tools_examples_clean_under_thirteen_rules(self):
         """THE acceptance gate: zero unsuppressed findings under all
-        twelve rules across the package + tools/ + examples/."""
+        thirteen rules across the package + tools/ + examples/."""
         targets = [PKG_DIR]
         for extra in ("tools", "examples"):
             d = os.path.join(REPO_ROOT, extra)

@@ -46,10 +46,11 @@ from typing import List, Optional
 #: converged-config / decision detail on which targets and knobs the
 #: controller actually touched that round, the tails block's phase
 #: breakdown (and null p50/p99) on which requests the serve pass
-#: actually recorded, and the slo block's objectives on the env's
-#: objective config
+#: actually recorded, the slo block's objectives on the env's
+#: objective config, and the resilience block's per-site counts /
+#: circuit state on whether the round armed a fault drill
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
-                "autotune", "tails", "slo"}
+                "autotune", "tails", "slo", "resilience"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

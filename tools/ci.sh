@@ -73,8 +73,16 @@
 #      through two modules, witness chain printed) / H11 (unclosed
 #      ModelServer) / H12 (swallowing serve handler) must be CAUGHT,
 #      the package + tools/ + examples/ must be clean under all
-#      twelve rules, --sarif must emit well-formed SARIF 2.1.0, and
+#      thirteen rules, --sarif must emit well-formed SARIF 2.1.0, and
 #      --changed-only must smoke (the tools/lint.sh --fast loop)
+#  13. fault-drill gate (docs/RESILIENCE.md): with SPARKDL_TPU_FAULTS
+#      arming a 10% transient fault rate at the serve dispatch site,
+#      a concurrent soak must show faults.injected > 0 and
+#      serve.retries > 0 with ZERO lost requests (every future
+#      resolves — success or typed failure, none dropped or
+#      double-answered), /healthz back at 200 after the drill, and
+#      the availability burn rate back under 1.0 once the drill
+#      window rolls off — recovery proved, not asserted
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -90,7 +98,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/12] native shim build =="
+echo "== [1/13] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -99,13 +107,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/12] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/13] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/12] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/13] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/12] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/13] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -114,7 +122,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/12] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/13] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -194,7 +202,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/12] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/13] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -233,11 +241,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/12] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/13] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/12] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/13] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -332,7 +340,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/12] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/13] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -442,7 +450,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/12] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/13] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -566,11 +574,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/12] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/13] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/12] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/13] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -599,7 +607,8 @@ for key in ("findings", "unsuppressed", "suppressed", "rules",
 assert d1["unsuppressed"] == 0, d1["findings"]
 assert d1["suppressed"] > 0, "expected the known suppressed findings"
 assert set(d1["rules"]) >= {"H1", "H2", "H3", "H4", "H5", "H6",
-                            "H7", "H8", "H9", "H10", "H11", "H12"}, \
+                            "H7", "H8", "H9", "H10", "H11", "H12",
+                            "H13"}, \
     d1["rules"]
 for f in d1["findings"]:
     for k in ("rule", "path", "line", "col", "message", "suppressed"):
@@ -634,7 +643,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/12] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/13] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -730,5 +739,97 @@ print(json.dumps({"sarif_gate": "ok",
                   "results": len(run["results"])}))
 EOF
 tools/lint.sh --fast
+
+echo "== [13/13] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+SPARKDL_TPU_SLO_WINDOW_S=2 \
+  SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
+  python - <<'EOF'
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.obs.slo import slo_tracker
+from sparkdl_tpu.resilience import faults
+from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+assert faults.state()["armed"], "SPARKDL_TPU_FAULTS did not arm"
+
+def apply(params, inputs):
+    return {"y": np.asarray(inputs["x"], np.float32) * 2.0}
+
+mf = ModelFunction(apply, None, {"x": ((4,), np.float32)},
+                   output_names=["y"], backend="host")
+server = ModelServer(ServeConfig(
+    max_wait_s=0.001, max_queue_rows=4096,
+    dispatch_retries=3, retry_base_backoff_s=0.001))
+server.register("drill", mf, batch_size=16)
+tel = server.serve_telemetry()
+
+N_THREADS, N_REQ, ROWS = 4, 40, 8
+futures, lock = [], threading.Lock()
+
+def fire(tid):
+    rng = np.random.default_rng(tid)
+    for i in range(N_REQ):
+        # unique payload per request: the value IS the identity, so
+        # the zero-lost/zero-duplicate check below is exact
+        val = float(tid * N_REQ + i)
+        x = np.full((ROWS, 4), val, np.float32)
+        f = server.submit({"x": x})
+        with lock:
+            futures.append((val, f))
+
+workers = [threading.Thread(target=fire, args=(t,))
+           for t in range(N_THREADS)]
+for w in workers: w.start()
+for w in workers: w.join()
+
+ok = typed = 0
+for val, f in futures:
+    try:
+        out = f.result(timeout=60)
+        assert out["y"].shape == (ROWS, 4), out["y"].shape
+        assert np.allclose(out["y"], 2.0 * val), \
+            ("row identity corrupted", val, out["y"][0])
+        ok += 1
+    except Exception:
+        typed += 1      # typed failure: resolved, not lost
+assert ok + typed == N_THREADS * N_REQ, (ok, typed)
+assert ok > 0, "drill lost every request"
+
+snap = default_registry().snapshot()
+assert snap.get("faults.injected", 0) > 0, "no faults injected"
+assert snap.get("faults.serve.dispatch.injected", 0) > 0, snap
+assert snap.get("serve.retries", 0) > 0, \
+    "injected transients never exercised the re-dispatch path"
+
+# recovery: disarm, run clean traffic, let the drill window roll off
+faults.disarm()
+for i in range(10):
+    server.submit({"x": np.ones((ROWS, 4), np.float32)}).result(
+        timeout=60)
+time.sleep(2.2)         # SPARKDL_TPU_SLO_WINDOW_S=2
+slo_tracker().record(latency_s=0.001, ok=True)   # roll the window
+health = urllib.request.urlopen(tel.url("/healthz"), timeout=5)
+assert health.status == 200, health.status
+status = json.loads(urllib.request.urlopen(
+    tel.url("/statusz"), timeout=5).read())
+burn = status["slo"]["objectives"]["availability"]["burn_rate"]
+assert burn < 1.0, f"availability burn {burn} still >= 1 after drill"
+res = status["resilience"]
+assert res["totals"].get("faults.injected", 0) > 0, res
+server.close()
+print(json.dumps({
+    "fault_drill": "ok", "requests": ok + typed, "succeeded": ok,
+    "typed_failures": typed,
+    "injected": snap["faults.injected"],
+    "serve_retries": snap["serve.retries"],
+    "availability_burn_after": burn}))
+EOF
 
 echo "== ci.sh: ALL GREEN =="
