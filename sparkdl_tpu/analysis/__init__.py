@@ -97,6 +97,25 @@ acquires, mutation of captured state — with witness chains):
   exponential backoff + retry budget), never a bare spin on a
   failing dependency.
 
+Three rules consume the whole-program **device-dataflow layer**
+(``dataflow.py``: per-function replayable device-value tracking,
+propagated through assignments, returns, and resolved call edges;
+``hotpath.py``: hot = transitively reachable from the
+watchdog-instrumented runner/serve/engine/estimator loops, with
+witness chains):
+
+* **H14 — hot-path host sync**: a device-resident value
+  materialized on host (``np.asarray``, ``.item()``, ``float()``/
+  ``len()``, truthiness, iteration) inside a hot function, anywhere
+  except the sanctioned ``timed_device_get`` drain — the hot chain
+  is printed module-by-module.
+* **H15 — missing buffer donation**: a jit call whose device-array
+  argument is dead after the call but whose compile site declares
+  no ``donate_argnums`` — HBM double-buffered every step.
+* **H16 — dtype widening**: Python float / ``np.float64`` scalars
+  and dtype-less numpy ctors mixed into device arithmetic on a hot
+  path — a silent 2x payload tax on a link-bound pipeline.
+
 CI annotation: ``--sarif out.sarif`` writes SARIF 2.1.0;
 ``--changed-only`` (``tools/lint.sh --fast``) lints only
 git-dirty files for the pre-commit loop.

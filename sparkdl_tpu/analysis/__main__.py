@@ -131,7 +131,9 @@ def main(argv=None) -> int:
                     "accounting, H13 unbounded retry loops) plus the "
                     "whole-program passes (H7 lock-order cycles, H8 "
                     "blocking under a lock, H9 docs contract drift, "
-                    "H10 jit-purity closure, H11 resource lifecycle). "
+                    "H10 jit-purity closure, H11 resource lifecycle, "
+                    "H14 hot-path host syncs, H15 missing buffer "
+                    "donation, H16 dtype widening). "
                     "Rule reference: docs/LINT.md")
     parser.add_argument(
         "paths", nargs="*",
@@ -199,15 +201,18 @@ def main(argv=None) -> int:
                     "by_rule": {}, "targets": [],
                     "cache": {"enabled": not args.no_cache,
                               "path": None, "hits": 0, "misses": 0},
+                    "timing": {"per_rule_s": {}, "total_s": 0.0},
                 }, indent=2))
             return 0
 
     cache_path = None if args.no_cache else \
         (args.cache or default_cache_path())
     cache_stats: dict = {}
+    rule_stats: dict = {}
     findings = analyze_paths(targets, rules=args.rules,
                              cache_path=cache_path,
-                             cache_stats=cache_stats)
+                             cache_stats=cache_stats,
+                             rule_stats=rule_stats)
     unsuppressed = [f for f in findings if not f.suppressed]
     if args.sarif:
         n = write_sarif(args.sarif, findings,
@@ -234,6 +239,12 @@ def main(argv=None) -> int:
                         os.path.relpath(t).startswith("..") else t
                         for t in targets],
             "cache": cache_stats,
+            # the analyzer's own cost accounting: per-rule elapsed
+            # seconds (per-file rules summed over files; "scan" is the
+            # cached fact extraction) + total wall — CI pins that the
+            # H14-H16 dataflow closure stays cheap enough for the
+            # --changed-only fast loop
+            "timing": rule_stats,
         }, indent=2))
     else:
         out = format_findings(findings,

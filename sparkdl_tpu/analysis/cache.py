@@ -34,9 +34,11 @@ from sparkdl_tpu.analysis.findings import Finding
 
 #: bump when rule logic or fact shape changes — stale entries miss
 #: (v5: the effect-system facts — ModuleFacts.effects — joined the
-#: per-file schema; v6: rule H13 unbounded-retry-loops; a version
-#: bump MUST force a cold re-analysis, pinned by tests/test_effects.py)
-ANALYZER_VERSION = 6
+#: per-file schema; v6: rule H13 unbounded-retry-loops; v7: the
+#: device-dataflow facts — ModuleFacts.flows, rules H14–H16 — joined
+#: the per-file schema; a version bump MUST force a cold re-analysis,
+#: pinned by tests/test_effects.py)
+ANALYZER_VERSION = 7
 
 
 def default_cache_path() -> str:

@@ -37,6 +37,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from sparkdl_tpu.analysis import dataflow as _dataflow
 from sparkdl_tpu.analysis import effects as _effects
 from sparkdl_tpu.analysis.locks import (
     CallEvent,
@@ -93,6 +94,9 @@ class ModuleFacts:
     #: per-function effect facts (effects.py), same keys as ``facts``
     effects: Dict[str, "_effects.FunctionEffects"] = \
         field(default_factory=dict)
+    #: per-function device-dataflow facts (dataflow.py), same keys
+    flows: Dict[str, "_dataflow.DeviceFlow"] = \
+        field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"module": self.module, "path": self.path,
@@ -101,7 +105,9 @@ class ModuleFacts:
                 "module_locks": self.module_locks,
                 "facts": {k: f.to_dict() for k, f in self.facts.items()},
                 "effects": {k: e.to_dict()
-                            for k, e in self.effects.items()}}
+                            for k, e in self.effects.items()},
+                "flows": {k: fl.to_dict()
+                          for k, fl in self.flows.items()}}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ModuleFacts":
@@ -114,6 +120,8 @@ class ModuleFacts:
                     for k, v in d["facts"].items()}
         mf.effects = {k: _effects.FunctionEffects.from_dict(v)
                       for k, v in d.get("effects", {}).items()}
+        mf.flows = {k: _dataflow.DeviceFlow.from_dict(v)
+                    for k, v in d.get("flows", {}).items()}
         return mf
 
 
@@ -172,6 +180,7 @@ def scan_module(tree: ast.Module, path: str,
             fe.jitted = True
             fe.jit_line = fn.lineno
         mf.effects[key] = fe
+        mf.flows[key] = _dataflow.scan_flow(fn, key, mf.imports, cls)
         name_keys.setdefault(fn.name, []).append(key)
 
     def iter_defs(body):

@@ -1,0 +1,151 @@
+"""Hot-path classification for the H14–H16 throughput rules.
+
+"Hot" is not a vibe here — it is a reachability fact over the PR-8
+call graph. The roots are the loops the repo already treats as its
+steady-state inner loops, identified by the same instrumentation the
+runtime uses: any function that opens a stall-watchdog activity
+window or beats it (``obs.watchdog.watch`` / ``obs.watchdog.pulse``
+call sites — the runner dispatch/drain state machine, the serve
+dispatcher, and every estimator epoch/step loop already do), plus a
+short explicit table for the engine's consumer-thread stream/re-chunk
+path and the runner entry points, which are hot by construction but
+beat the watchdog one frame further down.
+
+Everything transitively reachable from a root through RESOLVED call
+edges (the same ``self.m`` / bare-name / ``mod.f`` / unique-method
+contract ``may_block`` uses, plus lexically-nested defs of the
+caller) is hot, and every hot function carries a recorded witness
+chain back to its root so an H14/H16 finding can print module-by-
+module WHY the analyzer considers the site hot — a throughput verdict
+an operator cannot retrace is a number, not a diagnosis.
+
+Cold by construction: ``tools/`` and ``examples/`` CLIs (they *call*
+the hot paths — hotness flows down the call graph from the roots, not
+up into callers), config/constructor paths, and anything only
+reachable through an edge the resolver refuses (ambiguous methods
+resolve to "no edge": a guessed hot edge would manufacture false
+throughput findings, while a missed one costs recall the fixtures
+pin).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+# NOTE: no import of callgraph here — callgraph imports dataflow,
+# which imports this module; the CallGraph is always passed in (the
+# same no-cycle discipline effects.py keeps).
+
+#: import sources whose call marks the calling function as a hot-loop
+#: root (the watchdog contract: watch() opens an activity window
+#: around a hot loop, pulse() beats it per unit of work)
+WATCHDOG_MARKERS = ("obs.watchdog.watch", "obs.watchdog.pulse")
+
+#: (module suffix, qualname, label): hot roots that do not beat the
+#: watchdog themselves but ARE the steady-state inner loop — the
+#: engine's consumer-thread stream/re-chunk path and the runner run()
+#: entries (their dispatch_chunks callee beats the watchdog one frame
+#: down; the entry's own body is equally per-partition hot)
+EXTRA_HOT_ROOTS: Tuple[Tuple[str, str, str], ...] = (
+    ("data.engine", "LocalEngine._stream_rechunk",
+     "the engine stream/re-chunk path"),
+    ("data.engine", "LocalEngine._stream_plain",
+     "the engine stream/re-chunk path"),
+    ("data.engine", "LocalEngine._run_once",
+     "the engine per-partition path"),
+    ("runtime.runner", "BatchRunner.run",
+     "the runner dispatch entry"),
+    ("runtime.runner", "SlabSink.write",
+     "the runner drain path (`write` is ambiguous across classes, so "
+     "the resolver refuses the drain_bounded edge)"),
+    ("parallel.inference", "ShardedBatchRunner.run",
+     "the sharded runner dispatch entry"),
+)
+
+#: default label for watchdog-marked roots
+WATCHDOG_LABEL = "opens/beats a stall-watchdog window (a hot loop)"
+
+
+def _short(key: str) -> str:
+    """`module::Qual` → the human `module:Qual` form, package prefix
+    trimmed (mirrors CallGraph.short without importing callgraph)."""
+    mod, _, qual = key.partition("::")
+    mod = mod[len("sparkdl_tpu."):] if mod.startswith("sparkdl_tpu.") \
+        else mod
+    return f"{mod}:{qual}" if qual else mod
+
+
+def _resolve(graph, caller, call) -> Optional[str]:
+    """graph.resolve plus the lexical nested-def rule: a bare name
+    that matches a def nested inside the caller binds there first
+    (the estimator's ``place()`` / ``run_step()`` idiom)."""
+    if call.kind == "name":
+        nested = f"{caller.module}::{caller.qualname}.{call.name}"
+        if nested in graph.functions:
+            return nested
+    return graph.resolve(caller, call)
+
+
+class HotPaths:
+    """The hot set + per-function witness chains over one CallGraph.
+
+    ``flows`` maps function key → the dataflow layer's per-function
+    facts (``dataflow.DeviceFlow``), whose ``hot_root`` flag records
+    the scan-time watchdog-marker detection.
+    """
+
+    def __init__(self, graph, flows: Dict[str, object]):
+        self.graph = graph
+        #: key -> witness chain (keys, root first, self last)
+        self.chains: Dict[str, Tuple[str, ...]] = {}
+        #: root key -> human label (why it is a root)
+        self.roots: Dict[str, str] = {}
+        for key, flow in flows.items():
+            if getattr(flow, "hot_root", False) and \
+                    key in graph.functions:
+                self.roots[key] = (getattr(flow, "root_label", "")
+                                   or WATCHDOG_LABEL)
+        for key, f in graph.functions.items():
+            for suffix, qual, label in EXTRA_HOT_ROOTS:
+                if f.qualname == qual and (
+                        f.module == suffix
+                        or f.module.endswith("." + suffix)):
+                    self.roots.setdefault(key, label)
+        self._close()
+
+    def _close(self) -> None:
+        """BFS the resolved call edges from every root: hotness flows
+        DOWN the call graph (a hot loop makes its callees hot; calling
+        a hot function does not heat the caller)."""
+        work = []
+        for root in sorted(self.roots):
+            self.chains[root] = (root,)
+            work.append(root)
+        while work:
+            key = work.pop(0)
+            f = self.graph.functions.get(key)
+            if f is None:
+                continue
+            for call in f.calls:
+                target = _resolve(self.graph, f, call)
+                if target is None or target in self.chains:
+                    continue
+                self.chains[target] = self.chains[key] + (target,)
+                work.append(target)
+
+    def is_hot(self, key: str) -> bool:
+        return key in self.chains
+
+    def chain(self, key: str) -> Tuple[str, ...]:
+        return self.chains.get(key, ())
+
+    def why(self, key: str) -> str:
+        """The printable module-by-module hot witness for ``key``:
+        ``root (label) -> hop -> ... -> key``."""
+        chain = self.chains.get(key)
+        if not chain:
+            return ""
+        root = chain[0]
+        label = self.roots.get(root, WATCHDOG_LABEL)
+        path = " -> ".join(_short(k) for k in chain)
+        return f"{path} (root {_short(root)}: {label})"

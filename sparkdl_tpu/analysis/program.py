@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from sparkdl_tpu.analysis.callgraph import CallGraph
+from sparkdl_tpu.analysis.dataflow import check_h14, check_h15, check_h16
 from sparkdl_tpu.analysis.effects import check_h10, check_h11
 from sparkdl_tpu.analysis.findings import Finding
 from sparkdl_tpu.analysis.locks import FunctionFacts
@@ -225,10 +226,15 @@ def _held_str(held: Tuple[str, ...]) -> str:
 #: the program-rule registry (walker.py runs these over the one
 #: CallGraph it builds per invocation; H9 lives in contracts.py
 #: because it needs the docs tree, not the call graph; H10/H11 live
-#: in effects.py with the effect closure they consume)
+#: in effects.py with the effect closure they consume; H14–H16 live
+#: in dataflow.py with the device-dataflow replay + hot-path
+#: classification they run on)
 PROGRAM_RULES = {
     "H7": check_h7,
     "H8": check_h8,
     "H10": check_h10,
     "H11": check_h11,
+    "H14": check_h14,
+    "H15": check_h15,
+    "H16": check_h16,
 }

@@ -907,6 +907,29 @@ _RULE_DOCS = {
            "must be bounded and backed-off (resilience.RetryPolicy: "
            "attempts + exponential backoff + retry budget), never a "
            "bare spin on a failing dependency",
+    "H14": "hot-path host sync (whole-program): a device-resident "
+           "value materialized on host — np.asarray/np.array, "
+           ".item()/.tolist(), float()/int()/bool()/len(), "
+           "truthiness, iteration — inside a function transitively "
+           "reachable from the runner dispatch/drain loops, the "
+           "serve dispatcher, the engine stream/re-chunk path, or "
+           "the estimator step loops (the watchdog-beating roots), "
+           "anywhere except the sanctioned timed_device_get drain; "
+           "the hot witness chain is printed module-by-module",
+    "H15": "missing buffer donation (whole-program): a call of a "
+           "jax.jit/ModelFunction.jitted()-compiled callable whose "
+           "device-array argument is dead after the call (last "
+           "lexical use, no escape, not loop-carried) but the "
+           "compile site declares no donate_argnums — XLA keeps the "
+           "input buffer alive instead of reusing its HBM for the "
+           "outputs (the parallel/train.py donate_argnums=(0,) "
+           "precedent)",
+    "H16": "dtype widening on a hot path (whole-program): Python "
+           "float / np.float64 scalars and dtype-less "
+           "np.zeros/ones/arange/asarray mixed into arithmetic with "
+           "a device-tracked value on a hot function — the promoted "
+           "float64 payload is a silent 2x byte tax on a link-bound "
+           "pipeline; pin the dtype at the producer",
 }
 
 

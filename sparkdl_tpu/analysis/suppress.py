@@ -81,6 +81,15 @@ DEFAULT_ALLOWLIST: Dict[str, Tuple[AllowEntry, ...]] = {
             "drain IS the artifact write (nothing downstream to "
             "overlap with)"),
     ),
+    "H14": (
+        AllowEntry(
+            "sparkdl_tpu/obs/trace.py", "timed_device_get",
+            "THE sanctioned hot-path drain (the H1 entry's "
+            "whole-program twin): every strategy funnels device "
+            "results to host through this one sync, spanned and "
+            "timed — a hot path may materialize HERE and nowhere "
+            "else"),
+    ),
     "H8": (
         AllowEntry(
             "sparkdl_tpu/serve/batching.py", "RequestQueue.collect",

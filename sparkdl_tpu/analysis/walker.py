@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ast
 import os
+import time
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from sparkdl_tpu.analysis import contracts
@@ -64,12 +65,17 @@ def iter_python_files(target: str) -> Iterator[str]:
                 yield os.path.join(dirpath, name)
 
 
-def _file_findings(tree: ast.AST, path: str,
-                   wanted: List[str]) -> List[Finding]:
+def _file_findings(tree: ast.AST, path: str, wanted: List[str],
+                   timing: Optional[Dict[str, float]] = None
+                   ) -> List[Finding]:
     findings: List[Finding] = []
     for rule in wanted:
         if rule in RULES:
+            t0 = time.perf_counter()
             findings.extend(RULES[rule](tree, path))
+            if timing is not None:
+                timing[rule] = timing.get(rule, 0.0) + \
+                    (time.perf_counter() - t0)
     return findings
 
 
@@ -131,13 +137,22 @@ def analyze_paths(targets: Sequence[str],
                   = None,
                   cache_path: Optional[str] = None,
                   docs_root: Optional[str] = None,
-                  cache_stats: Optional[dict] = None) -> List[Finding]:
+                  cache_stats: Optional[dict] = None,
+                  rule_stats: Optional[dict] = None) -> List[Finding]:
     """Analyze every python file under each target path: per-file
     rules (cached by mtime+hash when ``cache_path`` is given), then
     the whole-program passes (H7/H8 lock analysis over the combined
     call graph; H9 contract drift against the repo docs when a
     ``docs/`` tree governs the targets). ``cache_stats`` (a dict, when
-    given) receives the cache hit/miss accounting for CI gating."""
+    given) receives the cache hit/miss accounting for CI gating;
+    ``rule_stats`` receives the analyzer's own cost accounting —
+    ``per_rule_s`` (elapsed seconds per rule, per-file rules summed
+    across files; ``scan`` is the fact-extraction pass the program
+    rules run on) and ``total_s`` — so CI can pin that the dataflow
+    closure does not blow up the fast loop (cache hits skip the scan
+    entirely: cached facts replay, nothing recomputes)."""
+    t_start = time.perf_counter()
+    timing: Dict[str, float] = {}
     wanted = ([r.upper() for r in rules] if rules is not None
               else list(ALL_RULES))
     rules_key = ",".join(sorted(r for r in wanted if r in RULES))
@@ -176,9 +191,12 @@ def analyze_paths(targets: Sequence[str],
                             "(sparkdl-lint cannot vouch for a module "
                             "it cannot read)"))
                 continue
-            file_f = _file_findings(tree, display, wanted)
+            file_f = _file_findings(tree, display, wanted, timing)
+            t0 = time.perf_counter()
             facts = scan_module(tree, display)
             file_surface = contracts.extract_file_surface(display, tree)
+            timing["scan"] = timing.get("scan", 0.0) + \
+                (time.perf_counter() - t0)
             findings.extend(file_f)
             modules.append(facts)
             surface.merge(file_surface)
@@ -187,16 +205,37 @@ def analyze_paths(targets: Sequence[str],
 
     if any(r in PROGRAM_RULES for r in wanted) and modules:
         graph = CallGraph(modules)
+        if any(r in ("H14", "H15", "H16") for r in wanted):
+            # build the shared device-dataflow state (replay rounds +
+            # hot-path closure) under its OWN timing key — otherwise
+            # whichever consumer runs first (H14, alphabetically)
+            # books the whole construction and H15/H16 read as free
+            from sparkdl_tpu.analysis.dataflow import _flow_state
+            t0 = time.perf_counter()
+            _flow_state(graph)
+            timing["dataflow-closure"] = timing.get(
+                "dataflow-closure", 0.0) + (time.perf_counter() - t0)
         for rule in sorted(PROGRAM_RULES):
             if rule in wanted:
+                t0 = time.perf_counter()
                 findings.extend(PROGRAM_RULES[rule](graph))
+                timing[rule] = timing.get(rule, 0.0) + \
+                    (time.perf_counter() - t0)
     if "H9" in wanted and file_paths:
+        t0 = time.perf_counter()
         findings.extend(contracts.check_surface(
             surface, file_paths, docs_root=docs_root))
+        timing["H9"] = timing.get("H9", 0.0) + \
+            (time.perf_counter() - t0)
 
     _apply_suppressions(findings, indexes, allowlist)
     cache.save()
     if cache_stats is not None:
         cache_stats.update(cache.stats())
+    if rule_stats is not None:
+        rule_stats["per_rule_s"] = {
+            k: round(v, 6) for k, v in sorted(timing.items())}
+        rule_stats["total_s"] = round(
+            time.perf_counter() - t_start, 6)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -494,6 +494,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         ``data`` axis, state replicated; XLA psums grads over ICI) when
         ``useMesh`` and >1 device, else single-device.
 
+        Both forms donate the batch arguments ``(xb, yb)`` — sparkdl-
+        lint H15: the batch is freshly staged every step and dead
+        after the call, so XLA reuses its HBM for the step's outputs
+        instead of double-buffering it (the ``parallel/train.py``
+        ``donate_argnums`` precedent). The STATE arguments are
+        deliberately NOT donated: the streaming trainer's async
+        checkpoint save reads the live ``trainable``/``opt_state``
+        arrays between steps.
+
         Returns ``(jitted, batch_size, mesh)`` — mesh is None on the
         single-device path; callers that place arrays themselves
         (multi-host streaming) derive their shardings from THIS mesh so
@@ -510,9 +519,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             rep, dat = replicated(mesh), data_sharding(mesh)
             jitted = jax.jit(step,
                              in_shardings=(rep, rep, rep, dat, dat),
-                             out_shardings=(rep, rep, rep, rep))
+                             out_shardings=(rep, rep, rep, rep),
+                             donate_argnums=(3, 4))
             return jitted, batch_size, mesh
-        return jax.jit(step), batch_size, None
+        return jax.jit(step, donate_argnums=(3, 4)), batch_size, None
 
     @staticmethod
     def _prepare_targets(y: np.ndarray, loss, n_out: int) -> np.ndarray:
@@ -952,11 +962,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 history.append(float(np.mean(jax.device_get(losses))))
             if checkpointer is not None:
                 # live arrays, not device_get copies: jax arrays are
-                # immutable and the step doesn't donate, so the async
-                # save reads them safely — and multi-host orbax needs
-                # the global arrays to run its every-host-participates
-                # write protocol (a host-local numpy copy would not
-                # carry the global sharding)
+                # immutable and the step donates only its BATCH args
+                # (xb/yb — never the state, see _compile_step), so the
+                # async save reads them safely — and multi-host orbax
+                # needs the global arrays to run its every-host-
+                # participates write protocol (a host-local numpy copy
+                # would not carry the global sharding)
                 checkpointer.save(
                     len(history),
                     {"trainable": trainable,

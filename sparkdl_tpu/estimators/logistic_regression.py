@@ -418,13 +418,19 @@ class LogisticRegression(Estimator, HasLabelCol):
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        history = []
+        # sparkdl-lint H14: losses accumulate as DEVICE scalars — a
+        # per-step float(loss) would sync the host into every
+        # iteration and serialize the async step chain; one drain at
+        # the end lands the whole history
+        losses = []
         for it in range(self.getOrDefault("maxIter")):
             with span("step", lane="estimator", iteration=it,
                       rows=len(X)), \
                     watchdog_watch("estimator.step"):
                 params, opt_state, loss = step(params, opt_state)
-                history.append(float(loss))
+                losses.append(loss)
+        # the objective history leaves the device exactly once, here
+        history = [float(v) for v in jax.device_get(losses)]  # sparkdl-lint: allow[H1] -- end-of-fit history drain
         return params, history
 
     def _run_streaming(self, dataset, feat: str, bs: int):
@@ -522,7 +528,10 @@ class LogisticRegression(Estimator, HasLabelCol):
                         watchdog_watch("estimator.step"):
                     params, opt_state, loss = step(params, opt_state,
                                                    xb, yb, wb)
-                    losses.append(float(loss))
+                    # sparkdl-lint H14: keep the loss device-resident
+                    # — float(loss) here would sync every step; the
+                    # epoch boundary drains the whole list at once
+                    losses.append(loss)
 
             for batch in frame.stream():
                 if batch.num_rows == 0:
@@ -560,8 +569,10 @@ class LogisticRegression(Estimator, HasLabelCol):
                 run_step(xb, yb, wb)
             if not saw_rows:
                 raise ValueError("cannot fit on an empty dataset")
-            history.append(float(np.mean(losses)) if losses
-                           else float("nan"))
+            # the epoch's async step chain lands once, here
+            history.append(
+                float(np.mean(jax.device_get(losses))) if losses  # sparkdl-lint: allow[H1] -- epoch-boundary drain
+                else float("nan"))
         if params is None:
             raise ValueError(
                 "no training steps ran (empty dataset or maxIter=0)")
@@ -616,6 +627,10 @@ class LogisticRegression(Estimator, HasLabelCol):
                             watchdog_watch("estimator.step"):
                         params, opt_state, loss = step(params, opt_state,
                                                        xb, yb, wb)
-                        losses.append(float(loss))
-                history.append(float(np.mean(losses)))
+                        # sparkdl-lint H14: device-resident until the
+                        # epoch boundary — a per-step float(loss)
+                        # serializes the async step chain
+                        losses.append(loss)
+                # the epoch's async step chain lands once, here
+                history.append(float(np.mean(jax.device_get(losses))))  # sparkdl-lint: allow[H1] -- epoch-boundary drain
         return params, history

@@ -280,6 +280,7 @@ class ModelFunction:
         single = not isinstance(inputs, dict)
         d = _as_dict(inputs, self.input_names)
         d = {k: jnp.asarray(v) for k, v in d.items()}
+        # sparkdl-lint: allow[H15] -- jnp.asarray is zero-copy when the caller already hands device (or committed host) arrays, so `d` may ALIAS caller-owned buffers; donating would invalidate the caller's arrays on a second use — batch-path donation lives in jitted(donate_inputs=True), opted into by owners of their buffers
         out = self.jitted()(p, d)
         if single and len(out) == 1:
             return next(iter(out.values()))

@@ -13,7 +13,9 @@ witness chain; a mutable-instance-attr capture is caught; an unclosed
 ModelServer is caught while every escape-analysis negative stays
 silent; a swallowing serve handler is caught while the
 counter-recording form is accepted; the real package + tools +
-examples are lint-clean under all thirteen rules (H13 rode in with ISSUE 11's resilience layer).
+examples are lint-clean under all sixteen rules (H13 rode in with
+ISSUE 11's resilience layer; H14-H16 with ISSUE 12's device-dataflow
+layer).
 """
 
 import json
@@ -936,17 +938,18 @@ class TestCacheVersionBump:
 
 
 # ---------------------------------------------------------------------------
-# meta: the thirteen-rule acceptance gate
+# meta: the sixteen-rule acceptance gate
 
 
-class TestMetaThirteenRules:
+class TestMetaSixteenRules:
     def test_all_rules_includes_the_effect_system(self):
-        assert {"H10", "H11", "H12", "H13"} <= set(ALL_RULES)
-        assert len(ALL_RULES) == 13
+        assert {"H10", "H11", "H12", "H13", "H14", "H15",
+                "H16"} <= set(ALL_RULES)
+        assert len(ALL_RULES) == 16
 
-    def test_package_tools_examples_clean_under_thirteen_rules(self):
+    def test_package_tools_examples_clean_under_sixteen_rules(self):
         """THE acceptance gate: zero unsuppressed findings under all
-        thirteen rules across the package + tools/ + examples/."""
+        sixteen rules across the package + tools/ + examples/."""
         targets = [PKG_DIR]
         for extra in ("tools", "examples"):
             d = os.path.join(REPO_ROOT, extra)

@@ -83,6 +83,15 @@
 #      double-answered), /healthz back at 200 after the drill, and
 #      the availability burn rate back under 1.0 once the drill
 #      window rolls off — recovery proved, not asserted
+#  14. throughput-hazard gate (docs/LINT.md): the seeded fixture for
+#      each of H14 (hot-loop `.item()` host sync, witness chain
+#      printed), H15 (undonated jit call with a dead device-array
+#      argument), and H16 (dtype-less float64 promotion into device
+#      arithmetic) must be CAUGHT; the dead-vs-escaping H15 negative
+#      must stay silent; SARIF must list all sixteen rules; and the
+#      analyzer's --json timing block must show the dataflow closure
+#      staying cheap (warm cached run: every file hits, wall time
+#      bounded) so the --changed-only fast loop keeps its point
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -98,7 +107,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/13] native shim build =="
+echo "== [1/14] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -107,13 +116,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/13] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/14] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/13] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/14] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/13] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/14] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -122,7 +131,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/13] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/14] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -202,7 +211,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/13] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/14] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -241,11 +250,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/13] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/14] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/13] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/14] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -340,7 +349,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/13] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/14] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -450,7 +459,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/13] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/14] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -574,11 +583,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/13] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/14] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/13] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/14] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -608,7 +617,7 @@ assert d1["unsuppressed"] == 0, d1["findings"]
 assert d1["suppressed"] > 0, "expected the known suppressed findings"
 assert set(d1["rules"]) >= {"H1", "H2", "H3", "H4", "H5", "H6",
                             "H7", "H8", "H9", "H10", "H11", "H12",
-                            "H13"}, \
+                            "H13", "H14", "H15", "H16"}, \
     d1["rules"]
 for f in d1["findings"]:
     for k in ("rule", "path", "line", "col", "message", "suppressed"):
@@ -643,7 +652,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/13] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/14] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -726,7 +735,8 @@ assert doc["version"] == "2.1.0", doc.get("version")
 assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
 [run] = doc["runs"]
 rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
-assert {"H1", "H10", "H11", "H12"} <= rules, sorted(rules)
+assert {"H1", "H10", "H11", "H12", "H14", "H15", "H16"} <= rules, \
+    sorted(rules)
 for res in run["results"]:
     assert res["ruleId"] in rules
     assert res["message"]["text"]
@@ -740,7 +750,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/13] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/14] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -830,6 +840,133 @@ print(json.dumps({
     "injected": snap["faults.injected"],
     "serve_retries": snap["serve.retries"],
     "availability_burn_after": burn}))
+EOF
+
+echo "== [14/14] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+python - <<'EOF'
+import json
+import os
+import tempfile
+
+from sparkdl_tpu.analysis import analyze_paths, analyze_source
+
+# seeded fixtures: each throughput rule must CATCH its shape
+with tempfile.TemporaryDirectory() as d:
+    def w(name, src):
+        with open(os.path.join(d, name), "w") as f:
+            f.write(src)
+
+    # H14: hot loop (watchdog-marked) doing a per-step .item() sync,
+    # with the sync one resolved call away — the witness chain must
+    # name both functions
+    w("hotsync_mod.py",
+      "import jax.numpy as jnp\n"
+      "from sparkdl_tpu.obs.watchdog import watch as watchdog_watch\n"
+      "def record(loss, out):\n"
+      "    out.append(loss.item())\n"
+      "def drive(step, batches, out):\n"
+      "    for b in batches:\n"
+      "        with watchdog_watch('fixture.step'):\n"
+      "            loss = jnp.asarray(b)\n"
+      "            record(loss, out)\n")
+    # H15: undonated jit call whose device batch is dead after it,
+    # plus the escaping negative (the result-carrying state is read
+    # later, the escaping batch is retained by a list)
+    w("donate_mod.py",
+      "import jax\n"
+      "import jax.numpy as jnp\n"
+      "def loop(step, X, keep):\n"
+      "    jitted = jax.jit(step)\n"
+      "    state = jnp.zeros((4,), jnp.float32)\n"
+      "    for i in range(8):\n"
+      "        xb = jnp.asarray(X[i])\n"
+      "        kept = jnp.asarray(X[i])\n"
+      "        keep.append(kept)\n"
+      "        state = jitted(state, xb, kept)\n"
+      "    return state\n")
+    # H16: dtype-less np.zeros mixed into device arithmetic on a hot
+    # function
+    w("widen_mod.py",
+      "import numpy as np\n"
+      "import jax.numpy as jnp\n"
+      "from sparkdl_tpu.obs.watchdog import watch as watchdog_watch\n"
+      "def ship(chunks):\n"
+      "    for c in chunks:\n"
+      "        with watchdog_watch('fixture.ship'):\n"
+      "            dev = jnp.asarray(c)\n"
+      "            dev = dev + np.zeros(len(c))\n"
+      "    return dev\n")
+    found = analyze_paths([d], cache_path=None)
+    by_rule = {}
+    for f in found:
+        if not f.suppressed:
+            by_rule.setdefault(f.rule, []).append(f)
+    h14 = by_rule.get("H14", [])
+    assert any("`.item()`" in f.message and "record" in f.message
+               and "drive" in f.message for f in h14), \
+        [f.render() for f in h14]
+    h15 = by_rule.get("H15", [])
+    assert any("`xb`" in f.message and "donate_argnums=(1,)"
+               in f.message for f in h15), [f.render() for f in h15]
+    # the escaping twin must stay silent — donation of a retained
+    # buffer would be a correctness bug, not a perf win
+    assert not any("`kept`" in f.message for f in h15), \
+        [f.render() for f in h15]
+    assert not any("`state`" in f.message for f in h15), \
+        [f.render() for f in h15]
+    h16 = by_rule.get("H16", [])
+    assert any("np.zeros" in f.message and "`dev`" in f.message
+               for f in h16), [f.render() for f in h16]
+
+# the sanctioned-drain contract: the same .item() shape inside the
+# allowlisted timed_device_get scope reports SUPPRESSED, not silent
+drain = analyze_source(
+    "import jax.numpy as jnp\n"
+    "from sparkdl_tpu.obs.watchdog import watch as watchdog_watch\n"
+    "def timed_device_get(res):\n"
+    "    with watchdog_watch('drain'):\n"
+    "        v = jnp.asarray(res)\n"
+    "        return v.item()\n",
+    "sparkdl_tpu/obs/trace.py", rules=["H14"])
+assert drain and all(f.suppressed for f in drain), \
+    [f.render() for f in drain]
+print(json.dumps({"throughput_fixtures": "ok",
+                  "h14": len(h14), "h15": len(h15),
+                  "h16": len(h16)}))
+EOF
+# analyzer cost guard: the --json timing block must exist with per-rule
+# stats, and a WARM cached run (step 11 populated the cache) must hit
+# every file — the dataflow facts replay from the cache, nothing
+# re-scans — inside a bounded wall time
+SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+
+env = dict(os.environ)
+r = subprocess.run(
+    [sys.executable, "-m", "sparkdl_tpu.analysis", "--json",
+     "sparkdl_tpu", "tools", "examples"],
+    capture_output=True, text=True, env=env)
+assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                           r.stderr[-2000:])
+d = json.loads(r.stdout)
+t = d["timing"]
+assert "total_s" in t and "per_rule_s" in t, sorted(t)
+for rule in ("H14", "H15", "H16", "H7", "H9", "H10"):
+    assert rule in t["per_rule_s"], (rule, sorted(t["per_rule_s"]))
+assert d["cache"]["misses"] == 0, \
+    ("warm run re-analyzed files", d["cache"])
+# the fast-loop bound: a fully-cached whole-package run (facts replay,
+# program rules only) must stay interactive — generous for CI hosts,
+# tight enough to catch a dataflow closure gone quadratic
+assert t["total_s"] < 60.0, t
+print(json.dumps({"analyzer_cost_gate": "ok",
+                  "warm_total_s": t["total_s"],
+                  "h14_s": t["per_rule_s"]["H14"],
+                  "h15_s": t["per_rule_s"]["H15"],
+                  "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
 echo "== ci.sh: ALL GREEN =="
