@@ -64,6 +64,15 @@ The full result reports:
   (queue/coalesce/staging/device/reassembly) come from the recorded
   timelines. tools/ci.sh gates the schema and the ≥95% attribution
   bar.
+* ``bound`` — the live roofline (sparkdl_tpu/obs/ledger.py,
+  docs/PERFORMANCE.md): one utilization-ledger window over the
+  measured pipeline pass — per-stage utilization fractions
+  (decode/link/compute/serve), the continuous ``bound_by`` verdict
+  with its headroom, the probed/injected ceilings, and the offline
+  ceilings-based twin. ``pipeline_bound_by`` itself is re-derived
+  through the SAME ``ledger.attribute()`` call, so the offline and
+  live verdicts are one code path. tools/ci.sh gates the schema,
+  the [0,1] bounds, and verdict == max-utilization stage.
 * ``autotune`` — the closed-loop infeed autotuner
   (sparkdl_tpu/autotune, docs/PERFORMANCE.md): tuned-vs-fixed
   throughput with the baseline's recorded noise band, decision /
@@ -648,11 +657,23 @@ def main() -> None:
         packedFormat="yuv420")
 
     # the full-pipeline headline: disk → decode → pack(4:2:0) → ship →
-    # device reconstruct+resize+featurize, one stream
+    # device reconstruct+resize+featurize, one stream. The utilization
+    # ledger (obs/ledger.py) windows EXACTLY this pass: ceilings are
+    # injected from the link measurement above (the probe is never
+    # paid twice in one process), the baseline snaps right before the
+    # pass, and one tick after it publishes the live ledger.util.* /
+    # ledger.bound_by gauges the "bound" block and ci.sh gate read.
+    from sparkdl_tpu.obs.ledger import ledger as _ledger
+    led = _ledger()
+    led.ensure_ceilings({"link_h2d_MBps": link["h2d_MBps"],
+                         "link_d2h_MBps": link["d2h_MBps"],
+                         "source": "bench.measure_link"})
+    led.baseline()
     pipeline = measure_pipeline(mf, packed_src, batch_size,
                                 n_images=256 if on_tpu else 24,
                                 packedFormat="yuv420")
     pipeline_ips = pipeline["ips"]
+    ledger_window = led.tick()
 
     fidelity = measure_fidelity(mf, packed_src,
                                 n_images=32 if on_tpu else 8)
@@ -736,13 +757,20 @@ def main() -> None:
     ceiling = link["h2d_MBps"] / image_mb
     ceiling_packed = link["h2d_MBps"] / packed_mb
     ceiling_420 = link["h2d_MBps"] / packed420_mb
-    # which stage's own ceiling binds the measured pipeline: the
-    # smallest of (host decode rate at the pipeline's size+format, link
-    # ceiling for its payload, device compute rate) is the constraint
+    # which stage's own ceiling binds the measured pipeline — derived
+    # FROM the ledger's attribute() (obs/ledger.py), not bench-local
+    # math: utilization per stage = measured pipeline rate over that
+    # stage's own ceiling, verdict = the max-utilization stage (which
+    # is exactly the min-ceiling stage — the offline and live verdicts
+    # are one code path)
+    from sparkdl_tpu.obs.ledger import attribute as ledger_attribute
     stage_ceilings = {"decode": host_decode_ips_420,
                       "link": ceiling_420,
                       "compute": device["ips"]}
-    pipeline_bound_by = min(stage_ceilings, key=stage_ceilings.get)
+    offline_util = {k: (pipeline_ips / v if v else 0.0)
+                    for k, v in stage_ceilings.items()}
+    offline_verdict = ledger_attribute(offline_util)
+    pipeline_bound_by = offline_verdict["bound_by"]
 
     # unified observability (sparkdl_tpu/obs, docs/OBSERVABILITY.md):
     # the registry snapshot always ships; when SPARKDL_TPU_TRACE=1
@@ -779,6 +807,7 @@ def main() -> None:
                                     "/tmp/sparkdl_tpu_trace.json")
         obs_block["trace_events"] = trc.export(trace_path)
         obs_block["trace_export"] = trace_path
+    ledger_status = led.status()
     result = {
         # monotonically bumped whenever a key is REMOVED or retyped
         # (additions are compatible); tools/bench_compare.py gates a
@@ -859,6 +888,28 @@ def main() -> None:
         "pipeline_bound_by": pipeline_bound_by,
         "pipeline_stage_ceilings_ips": {
             k: round(v, 1) for k, v in stage_ceilings.items()},
+        # the live roofline (obs/ledger.py, docs/PERFORMANCE.md): ONE
+        # ledger window over the measured pipeline pass — utilization
+        # fractions, the continuous bound_by verdict (same attribute()
+        # as pipeline_bound_by above), and the ceilings it divided by;
+        # ci.sh gates the schema, the [0,1] bounds, and verdict ==
+        # max-utilization stage against the published ledger.util.*
+        "bound": {
+            **({"bound_by": ledger_window["bound_by"],
+                "headroom_pct": ledger_window["headroom_pct"],
+                "util": ledger_window["util"],
+                "window_s": ledger_window["dt_s"],
+                "link_basis": ledger_window["link_basis"],
+                "ship_MBps": ledger_window["ship_MBps"]}
+               if ledger_window is not None else
+               {"bound_by": None, "headroom_pct": None, "util": None,
+                "window_s": None, "link_basis": None,
+                "ship_MBps": None}),
+            **{k: ledger_status[k] for k in ("windows", "ceilings")},
+            "offline": {"bound_by": pipeline_bound_by,
+                        "util": {k: round(v, 4)
+                                 for k, v in offline_util.items()}},
+        },
         "runner_strategy": runner.strategy,
         # whether the runners' ship path ran under the runtime
         # sanitizer's transfer guard (SPARKDL_TPU_SANITIZE=1 —
@@ -922,6 +973,11 @@ def main() -> None:
         "device_resident_ips": result["device_resident_ips"],
         "link_h2d_MBps": result["link_h2d_MBps"],
         "pipeline_bound_by": result["pipeline_bound_by"],
+        # the LIVE verdict (ledger window over the measured pipeline
+        # pass) with its headroom — the offline ceilings verdict above
+        # stays for round-over-round continuity
+        "bound_by": result["bound"]["bound_by"],
+        "bound_headroom_pct": result["bound"]["headroom_pct"],
         "runner_strategy": result["runner_strategy"],
         "sanitize": result["sanitize"],
         "serve_rows_per_s": result["serve"].get("achieved_rows_per_s"),

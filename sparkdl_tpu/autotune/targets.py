@@ -120,6 +120,11 @@ class RunnerTarget(_TrialMixin):
     #: which the overlap is deepened
     raise_wait_frac = 0.15
 
+    #: how stale a ledger window may be and still count as a prior
+    #: (in multiples of the ledger's own window length) — a verdict
+    #: from minutes ago describes a different pipeline
+    ledger_prior_max_windows = 10.0
+
     def __init__(self, runner, name: Optional[str] = None,
                  max_inflight_cap: int = 32,
                  max_prefetch_depth: int = 8,
@@ -142,6 +147,16 @@ class RunnerTarget(_TrialMixin):
 
     def knobs(self) -> List[Knob]:
         return [self._inflight, self._depth]
+
+    def _ledger_prior(self) -> Optional[str]:
+        """The live roofline's ``bound_by`` verdict as a measured
+        prior (obs/ledger.py — READ-only: this target never ticks or
+        writes the ledger). ``None`` when no fresh window exists, so
+        processes that never ran the ledger tune exactly as before."""
+        from sparkdl_tpu.obs.ledger import ledger
+        led = ledger()
+        return led.last_bound(
+            max_age_s=self.ledger_prior_max_windows * led.window_s)
 
     def _window(self) -> Optional[tuple]:
         """(rows/s, wait_frac, placement degrades) over the window
@@ -190,8 +205,18 @@ class RunnerTarget(_TrialMixin):
             out.append(Proposal(self._depth, self._depth.value - 1,
                                 "placement degrade events in window"))
         if wait_frac >= self.raise_wait_frac:
+            prior = self._ledger_prior()
+            if prior == "decode":
+                # the live roofline says the DECODE lane binds right
+                # now: deepening ship-side overlap cannot relieve an
+                # input-side wall, and the trial would burn a freeze
+                # epoch learning that. The prior is consulted, never
+                # written (obs/ledger.py stays read-only to targets).
+                return out
             reason = (f"transfer_wait is {wait_frac:.0%} of wall; "
                       "deepen overlap")
+            if prior is not None:
+                reason += f" (ledger prior: bound by {prior})"
             if (self.runner.strategy == "prefetch" and degrades == 0
                     and self._depth.usable()
                     and self._depth.value < self._depth.hi):
@@ -208,6 +233,7 @@ class RunnerTarget(_TrialMixin):
         return {"name": self.name, "kind": "runner",
                 "strategy": getattr(self.runner, "strategy", None),
                 "trial_open": self._trial is not None,
+                "ledger_prior": self._ledger_prior(),
                 "knobs": [k.describe() for k in self.knobs()]}
 
 

@@ -29,6 +29,7 @@ import collections
 import logging
 import os
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Iterator, Optional, Sequence, Tuple
 
@@ -190,16 +191,19 @@ class LocalEngine:
         # (obs/trace.py — a no-op when SPARKDL_TPU_TRACE is unset)
         with span(f"stage:{stage.name}", lane="engine",
                   rows=batch.num_rows, kind=stage.kind):
-            if timings is None:
-                return (stage.fn(batch, index) if stage.with_index
-                        else stage.fn(batch))
-            import time
             t0 = time.perf_counter()
             out = (stage.fn(batch, index) if stage.with_index
                    else stage.fn(batch))
-            timings.append((stage.name, time.perf_counter() - t0,
-                            batch.num_rows))
-            return out
+            dt = time.perf_counter() - t0
+        if stage.kind != "device":
+            # the utilization ledger's decode-lane feed (obs/ledger.py):
+            # host-stage busy time — device-stage applies wrap
+            # runner.run, which feeds device.run_seconds itself, so
+            # counting them here would double-attribute the window
+            default_registry().counter("engine.busy_seconds").add(dt)
+        if timings is not None:
+            timings.append((stage.name, dt, batch.num_rows))
+        return out
 
     def _run_once(self, source, plan, index) -> pa.RecordBatch:
         # Buffer stage timings locally and flush only on success, so a
@@ -209,7 +213,11 @@ class LocalEngine:
         # worker-death drill for ROADMAP item 1's multi-host plan)
         maybe_fail("engine.source_load")
         with span("source.load", lane="engine", partition=index):
+            t0 = time.perf_counter()
             batch = source.load()
+            # source reads (decode/IO) are decode-lane busy time too
+            default_registry().counter("engine.busy_seconds").add(
+                time.perf_counter() - t0)
         for stage in plan:
             if stage.kind == "device":
                 with self._device_lock:

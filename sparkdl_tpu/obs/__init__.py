@@ -2,7 +2,13 @@
 Perfetto-exportable timelines, and an operable health surface across
 engine → ship → device.
 
-Eight pieces (docs/OBSERVABILITY.md):
+Nine pieces (docs/OBSERVABILITY.md):
+
+* :mod:`sparkdl_tpu.obs.ledger` — the windowed utilization ledger:
+  per-window rates over the hot paths' feed counters, divided by
+  probed per-host ceilings into ``ledger.util.*`` fractions and ONE
+  continuous ``ledger.bound_by`` roofline verdict (the same
+  ``attribute()`` bench.py's offline ``pipeline_bound_by`` uses);
 
 * :mod:`sparkdl_tpu.obs.trace` — ``span(name, lane=...)`` recording
   into one process-wide bounded ring buffer on a single clock, armed by
@@ -46,6 +52,13 @@ from sparkdl_tpu.obs.export import (
 )
 from sparkdl_tpu.obs.flight import FlightRecorder
 from sparkdl_tpu.obs.flight import recorder as flight_recorder
+from sparkdl_tpu.obs.ledger import (
+    UtilizationLedger,
+    ledger,
+    ledger_poll,
+    probe_ceilings,
+)
+from sparkdl_tpu.obs.ledger import attribute as ledger_attribute
 from sparkdl_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -85,8 +98,13 @@ __all__ = [
     "StallWatchdog",
     "TelemetryServer",
     "Tracer",
+    "UtilizationLedger",
     "default_registry",
     "flight_recorder",
+    "ledger",
+    "ledger_attribute",
+    "ledger_poll",
+    "probe_ceilings",
     "render_prometheus",
     "request_log",
     "slo_tracker",

@@ -64,6 +64,59 @@ def prom_name(name: str) -> str:
     return PROM_PREFIX + _PROM_BAD.sub("_", name)
 
 
+#: ``# HELP`` text by registry-key prefix (longest prefix wins; the
+#: registry's dotted ``<lane>.<what>`` convention makes the lane the
+#: help unit — per-key prose lives in docs/OBSERVABILITY.md's table,
+#: which sparkdl-lint H9 keeps in sync with the code)
+HELP_BY_PREFIX = (
+    ("ledger.util.", "live-roofline utilization fraction for this "
+                     "pipeline lane, per ledger window (obs/ledger.py)"),
+    ("ledger.", "windowed utilization-ledger accounting — the live "
+                "bottleneck verdict and its bookkeeping (obs/ledger.py)"),
+    ("ship.", "host->device ship path: dispatch queue, staging copies, "
+              "transfer waits (runtime/runner.py)"),
+    ("engine.stage.", "per-stage engine counters published from "
+                      "StageMetrics (utils/profiling.py)"),
+    ("engine.", "host execution engine: stage busy time and retries "
+                "(data/engine.py)"),
+    ("device.", "device-side accounting observed from the host "
+                "(runtime/runner.py)"),
+    ("serve.", "online serving front-end: admission, micro-batching, "
+               "latency (sparkdl_tpu/serve)"),
+    ("collective.", "mesh-program collective launch discipline "
+                    "(parallel/mesh.py)"),
+    ("sanitize.", "runtime transfer-guard sanitizer "
+                  "(runtime/sanitize.py)"),
+    ("autotune.", "closed-loop infeed autotuner (sparkdl_tpu/autotune)"),
+    ("watchdog.", "stall watchdog verdicts (obs/watchdog.py)"),
+    ("flight.", "flight-recorder forensics bundles (obs/flight.py)"),
+    ("slo.", "rolling-window SLO burn-rate/budget verdicts "
+             "(obs/slo.py)"),
+    ("obs.", "the observability layer's own accounting "
+             "(sparkdl_tpu/obs)"),
+    ("faults.", "armed fault-injection drill counters "
+                "(resilience/faults.py)"),
+    ("resilience.", "shared retry-policy/budget accounting "
+                    "(resilience/policy.py)"),
+    ("telemetry.", "telemetry-endpoint handler failures "
+                   "(obs/export.py)"),
+)
+
+_HELP_FALLBACK = ("sparkdl_tpu pipeline metric (registry key table: "
+                  "docs/OBSERVABILITY.md)")
+
+
+def prom_help(name: str) -> str:
+    """The ``# HELP`` text for a registry key: longest matching lane
+    prefix, with a generic fallback — every exported sample gets a
+    HELP line (the Prometheus exposition contract ci.sh validates
+    line-by-line), never a bare TYPE."""
+    for prefix, text in HELP_BY_PREFIX:
+        if name.startswith(prefix):
+            return f"{text} [key: {name}]"
+    return f"{_HELP_FALLBACK} [key: {name}]"
+
+
 def _fmt(value: float) -> str:
     # Prometheus floats: repr round-trips, integers stay readable
     f = float(value)
@@ -74,28 +127,30 @@ def _fmt(value: float) -> str:
 
 def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
     """The registry in Prometheus text exposition format (version
-    0.0.4): one ``# TYPE`` line per metric, kinds preserved. This is
-    THE scrape payload — ``tools/ci.sh``'s telemetry gate parses it
-    line-by-line so a rendering regression fails the build, not the
+    0.0.4): one ``# HELP`` + ``# TYPE`` pair per metric, kinds
+    preserved. This is THE scrape payload — ``tools/ci.sh``'s
+    telemetry gate parses it line-by-line (every TYPE must follow its
+    HELP) so a rendering regression fails the build, not the
     operator's dashboard."""
     registry = registry if registry is not None else default_registry()
     lines = []
+
+    def emit(base: str, kind: str, value: float, key: str) -> None:
+        lines.append(f"# HELP {base} {prom_help(key)}")
+        lines.append(f"# TYPE {base} {kind}")
+        lines.append(f"{base} {_fmt(value)}")
+
     for m in registry.metrics():
         base = prom_name(m.name)
         if isinstance(m, Counter):
-            lines.append(f"# TYPE {base} counter")
-            lines.append(f"{base} {_fmt(m.value)}")
+            emit(base, "counter", m.value, m.name)
         elif isinstance(m, Gauge):
-            lines.append(f"# TYPE {base} gauge")
-            lines.append(f"{base} {_fmt(m.value)}")
+            emit(base, "gauge", m.value, m.name)
         elif isinstance(m, Reservoir):
             p50, p99 = m.quantiles((0.5, 0.99))
-            lines.append(f"# TYPE {base}_count counter")
-            lines.append(f"{base}_count {_fmt(m.count)}")
-            lines.append(f"# TYPE {base}_p50 gauge")
-            lines.append(f"{base}_p50 {_fmt(p50)}")
-            lines.append(f"# TYPE {base}_p99 gauge")
-            lines.append(f"{base}_p99 {_fmt(p99)}")
+            emit(f"{base}_count", "counter", m.count, m.name)
+            emit(f"{base}_p50", "gauge", p50, m.name)
+            emit(f"{base}_p99", "gauge", p99, m.name)
     return "\n".join(lines) + "\n"
 
 
@@ -197,6 +252,18 @@ class TelemetryServer:
                     self._registry.counter("telemetry.errors").add()
                     logger.debug("telemetry: slo refresh failed: %s",
                                  e)
+                # the utilization ledger's reader-driven window: a
+                # scrape closes a window when one is due, so
+                # ledger.util.* is fresh without any in-process
+                # arming; degrades like the SLO refresh (a broken
+                # probe must not 500 every other metric)
+                try:
+                    from sparkdl_tpu.obs.ledger import ledger
+                    ledger().tick_due()
+                except Exception as e:
+                    self._registry.counter("telemetry.errors").add()
+                    logger.debug("telemetry: ledger tick failed: %s",
+                                 e)
                 body = render_prometheus(self._registry).encode()
                 self._reply(handler, 200, body,
                             "text/plain; version=0.0.4; charset=utf-8")
@@ -267,6 +334,10 @@ class TelemetryServer:
             # shape as the flight bundle's section, so a curl and a
             # postmortem never disagree
             "resilience": _flight.resilience_state(),
+            # the live roofline: current window, ceilings, and the
+            # bounded history ring (obs/ledger.py) — literally the
+            # same renderer the flight bundle uses
+            "ledger": _flight.ledger_state(),
             "servers": servers,
             "metrics_count": len(self._registry.snapshot()),
         }

@@ -209,6 +209,21 @@ def resilience_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def ledger_state() -> dict:
+    """The utilization ledger's live-roofline state — a fresh window
+    when one is due, the current ceilings, and the bounded history
+    ring (obs/ledger.py) — ONE shape shared by the flight bundle and
+    ``/statusz`` so a curl and a postmortem never disagree; degrades
+    like every probe."""
+    try:
+        from sparkdl_tpu.obs.ledger import ledger
+        led = ledger()
+        led.tick_due()
+        return {**led.status(), "history": led.history()}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -321,6 +336,7 @@ class FlightRecorder:
             "spans_dropped": trc.dropped,
             "serve": _serve_status(),
             "autotune": _autotune_state(),
+            "ledger": ledger_state(),
             "slo": _slo_state(),
             "requests": _request_state(),
             "resilience": resilience_state(),

@@ -23,6 +23,14 @@ specimen's breakdown across the named phases (queue vs coalesce-wait
 vs staging vs device vs reassembly) — where the TAIL spends its time,
 which a lane-busy summary cannot say.
 
+``report --bound <trace.json>`` renders the trace against the SAME
+roofline lanes the live ledger publishes (obs/ledger.py): per-lane
+busy fractions of the trace wall (decode = engine-lane spans, link =
+the ``device_get``/``device_put`` wire edges, compute = ship-lane
+``dispatch``, serve = the ``coalesce`` windows) fed through the same
+``ledger.attribute()`` call, so the offline trace verdict and the
+live ``ledger.bound_by`` gauge are one code path.
+
 Forward-compat contract (both modes): event TYPES are data too — flow
 events (``ph`` s/t/f, how split requests link), counter events, and
 ``ph`` values this report has never heard of must all be skipped, not
@@ -137,6 +145,81 @@ def summarize(events: Sequence[dict]) -> str:
                          "of wall)")
     else:
         lines.append("  (none recorded)")
+    return "\n".join(lines)
+
+
+#: trace-span → roofline-lane mapping for ``--bound``: the offline
+#: twin of the ledger's feed counters (caveat carried in the output:
+#: on async backends ship-lane ``dispatch`` times the ENQUEUE, so the
+#: compute fraction is a lower bound there)
+BOUND_LANES = {
+    "decode": "engine-lane spans (decode / stage execution)",
+    "link": "device_get/device_put spans (the wire, host-observable)",
+    "compute": "ship-lane dispatch spans (enqueue on async backends)",
+    "serve": "serve-lane coalesce windows (fill wait)",
+}
+
+
+def bound_summary(events: Sequence[dict]) -> Optional[dict]:
+    """Per-roofline-lane busy fractions of the trace wall plus the
+    ledger's own ``attribute()`` verdict. Returns ``None`` for a trace
+    with no spans. Forward-compat: unknown lanes/names simply don't
+    land in any roofline lane."""
+    from sparkdl_tpu.obs.ledger import attribute
+
+    lane_of_pid = {e["pid"]: e.get("args", {}).get("name", "?")
+                   for e in events
+                   if e.get("ph") == "M"
+                   and e.get("name") == "process_name"
+                   and "pid" in e}
+    spans = [e for e in events
+             if e.get("ph") == "X" and "ts" in e and "pid" in e]
+    if not spans:
+        return None
+    t0 = min(e["ts"] for e in spans)
+    t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+    wall_us = max(t1 - t0, 1e-9)
+
+    def stage_of(e: dict) -> Optional[str]:
+        lane = lane_of_pid.get(e["pid"]) or e.get("cat", "?")
+        name = e.get("name", "?")
+        if lane == "engine":
+            return "decode"
+        if name == "device_get" or name == "device_put":
+            return "link"
+        if lane == "ship" and name == "dispatch":
+            return "compute"
+        if lane == "serve" and name == "coalesce":
+            return "serve"
+        return None
+
+    intervals: Dict[str, List[Tuple[float, float]]] = {}
+    for e in spans:
+        stage = stage_of(e)
+        if stage is not None:
+            intervals.setdefault(stage, []).append(
+                (e["ts"], e["ts"] + e.get("dur", 0.0)))
+    util = {stage: min(1.0, _merged_length(
+                intervals.get(stage, [])) / wall_us)
+            for stage in BOUND_LANES}
+    verdict = attribute(util)
+    return {"wall_ms": round(wall_us / 1e3, 3), "spans": len(spans),
+            **verdict}
+
+
+def summarize_bound(events: Sequence[dict]) -> str:
+    """The ``--bound`` text section (unit-testable without the CLI)."""
+    b = bound_summary(events)
+    if b is None:
+        return ("(no spans in trace — arm SPARKDL_TPU_TRACE and run "
+                "traffic to record a roofline-readable timeline)")
+    lines = [f"live roofline, offline (busy fraction of "
+             f"{b['wall_ms']:.3f} ms wall over {b['spans']} spans)"]
+    for stage, what in BOUND_LANES.items():
+        frac = b["util"].get(stage, 0.0)
+        lines.append(f"  {stage.ljust(8)} {100.0 * frac:5.1f}%  ({what})")
+    lines.append(f"bound by: {b['bound_by']} "
+                 f"(headroom {b['headroom_pct']:.1f}%)")
     return "\n".join(lines)
 
 
@@ -261,9 +344,12 @@ def main(argv: Sequence[str]) -> int:
     tails = "--tails" in args
     if tails:
         args.remove("--tails")
+    bound = "--bound" in args
+    if bound:
+        args.remove("--bound")
     if len(args) != 2 or args[0] != "report":
         print("usage: python -m sparkdl_tpu.obs report [--tails] "
-              "<trace.json>")
+              "[--bound] <trace.json>")
         return 2
     try:
         events = load_events(args[1])
@@ -275,4 +361,7 @@ def main(argv: Sequence[str]) -> int:
         print()
         print("request tails (per-request phase attribution)")
         print(summarize_tails(events))
+    if bound:
+        print()
+        print(summarize_bound(events))
     return 0

@@ -42,6 +42,7 @@ from sparkdl_tpu.runtime.runner import (
     dispatch_chunks,
     empty_jax_outputs,
     iter_padded_chunks,
+    record_run_feeds,
     warmup_runner,
 )
 from sparkdl_tpu.runtime.sanitize import ship_guard
@@ -214,12 +215,17 @@ class ShardedBatchRunner:
             # drain half of the phase accounting — one pair of clock
             # reads shared with transfer_wait_seconds
             phases.drain_s += sink.transfer_wait
-        self.metrics.add(n, batches, time.perf_counter() - t0,
+        elapsed = time.perf_counter() - t0
+        self.metrics.add(n, batches, elapsed,
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=sink.transfer_wait)
+        record_run_feeds(self.model_fn, inputs, elapsed,
+                         sink.transfer_wait)
         # autotune apply point (runtime/runner.py precedent): knobs
         # move between runs only; disarmed this is one armed-check
         from sparkdl_tpu.autotune.core import poll as autotune_poll
         autotune_poll()
+        from sparkdl_tpu.obs.ledger import ledger_poll
+        ledger_poll()
         return sink.result()

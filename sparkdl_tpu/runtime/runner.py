@@ -94,6 +94,7 @@ import numpy as np
 from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import default_registry, span, timed_device_get
+from sparkdl_tpu.obs.ledger import ledger_poll
 from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.resilience.faults import maybe_fail
@@ -651,6 +652,26 @@ def start_device_prefetch(chunk: Dict[str, np.ndarray], sharding=None
         return None
 
 
+def record_run_feeds(model_fn: ModelFunction,
+                     inputs: Dict[str, np.ndarray],
+                     elapsed_s: float, wait_s: float) -> None:
+    """Feed the utilization ledger's compute/link lanes
+    (obs/ledger.py) from one completed ``run()``: dispatch+drain wall
+    as device-run busy time, the drain waits as link-wait time, and —
+    device backends only (host models ship nothing) — the input bytes
+    handed to device dispatch. Monotonic counters, shared by
+    BatchRunner and ShardedBatchRunner so both runners' traffic lands
+    in the same roofline."""
+    reg = default_registry()
+    reg.counter("device.run_seconds").add(elapsed_s)
+    reg.counter("ship.transfer_wait_seconds_total").add(wait_s)
+    if model_fn.backend != "host":
+        # getattr: array-likes without nbytes (exotic duck-typed
+        # inputs) ship unknown bytes — an under-count, never a crash
+        reg.counter("ship.bytes_shipped").add(
+            sum(int(getattr(v, "nbytes", 0)) for v in inputs.values()))
+
+
 @dataclass
 class RunnerMetrics:
     """Throughput + host-copy counters (SURVEY §5: the reference had
@@ -822,15 +843,17 @@ class BatchRunner:
         else:
             out, wait = self._run_device(inputs, n, counters,
                                          batch_size, phases)
-        self.metrics.add(n, -(-n // batch_size),
-                         time.perf_counter() - t0,
+        elapsed = time.perf_counter() - t0
+        self.metrics.add(n, -(-n // batch_size), elapsed,
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=wait)
+        record_run_feeds(self.model_fn, inputs, elapsed, wait)
         # the autotune controller's apply point: knobs only ever move
         # BETWEEN runs, on the thread that just finished one (a single
         # armed-check when the controller is disarmed)
         autotune_poll()
+        ledger_poll()
         return out
 
     # -- host path ----------------------------------------------------------

@@ -58,6 +58,7 @@ import numpy as np
 from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.obs import default_registry, span
 from sparkdl_tpu.obs import flight
+from sparkdl_tpu.obs.ledger import ledger_poll
 from sparkdl_tpu.obs.request_log import request_log
 from sparkdl_tpu.obs.slo import slo_tracker
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
@@ -385,6 +386,11 @@ class ModelSession:
             batch = self._queue.collect(self.chunk, self.max_wait_s)
             if batch is None:
                 return          # closed and drained
+            # the utilization ledger's serve-lane feed (obs/ledger.py):
+            # the coalesce window's wait — latency deliberately traded
+            # for batch fill, clocked by collect() from first pop
+            reg.counter("serve.coalesce_wait_seconds").add(
+                batch.waited_s)
             with watchdog_watch(wd_source):
                 for req in batch.expired:
                     # failed BEFORE dispatch: no device time for the
@@ -434,8 +440,10 @@ class ModelSession:
             # autotune apply point, OUTSIDE the watchdog activity
             # window: a controller step must never eat this source's
             # heartbeat budget (disarmed: one armed-check — the
-            # shared-no-op regime)
+            # shared-no-op regime); the ledger poll rides the same
+            # cadence under the same contract
             autotune_poll()
+            ledger_poll()
 
     def _record_outcome(self, req: Request, status: str) -> None:
         """Close out a failed/expired/abandoned request's timeline

@@ -183,4 +183,14 @@ def throughput_report(stage_metrics: Optional[StageMetrics] = None,
             f"{int(snap.get('ship.bytes_copied', 0))} B copied, "
             f"{snap.get('ship.transfer_wait_seconds', 0.0):.3f}s "
             "transfer wait)")
+    if parts:
+        # the bottleneck verdict, from THE one attribution code path
+        # (obs/ledger.py — the same ledger.attribute() bench.py and
+        # the live ledger.bound_by gauge use): the last closed window
+        # when the ledger ran, else cumulative process totals
+        from sparkdl_tpu.obs.ledger import ledger
+        v = ledger().current_verdict()
+        parts.append(f"bound by: {v['bound_by']} "
+                     f"(headroom {v['headroom_pct']:.0f}%, "
+                     f"{v['basis']})")
     return "\n".join(parts) if parts else "(no metrics)"

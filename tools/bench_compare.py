@@ -48,9 +48,11 @@ from typing import List, Optional
 #: breakdown (and null p50/p99) on which requests the serve pass
 #: actually recorded, the slo block's objectives on the env's
 #: objective config, and the resilience block's per-site counts /
-#: circuit state on whether the round armed a fault drill
+#: circuit state on whether the round armed a fault drill, and the
+#: bound block's window/ceilings on what the ledger measured and
+#: which probe produced the ceilings that round
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
-                "autotune", "tails", "slo", "resilience"}
+                "autotune", "tails", "slo", "resilience", "bound"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

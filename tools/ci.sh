@@ -83,6 +83,15 @@
 #      double-answered), /healthz back at 200 after the drill, and
 #      the availability burn rate back under 1.0 once the drill
 #      window rolls off — recovery proved, not asserted
+#  15. live-roofline ledger gate (docs/PERFORMANCE.md "Reading the
+#      live roofline"): the armed tiny bench's "bound" block must be
+#      computed by obs/ledger.py (fractions in [0,1], verdict = the
+#      max-utilization stage, fractions equal to the published
+#      ledger.util.* gauges, pipeline_bound_by = the same attribute()
+#      over the offline ceilings); live traffic must surface
+#      sparkdl_ledger_util_* (with # HELP) on /metricsz, the ledger
+#      section with its history ring on /statusz AND in a flight
+#      bundle; and `report --bound` must read the armed bench trace
 #  14. throughput-hazard gate (docs/LINT.md): the seeded fixture for
 #      each of H14 (hot-loop `.item()` host sync, witness chain
 #      printed), H15 (undonated jit call with a dead device-array
@@ -107,7 +116,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/14] native shim build =="
+echo "== [1/15] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -116,13 +125,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/14] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/15] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/14] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/15] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/14] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/15] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -131,7 +140,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/14] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/15] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -169,7 +178,7 @@ required = [
     "host_fed_ceiling_ips_packed420",
     "host_decode_ips", "host_decode_ips_packed",
     "host_decode_ips_packed420",
-    "pipeline_bound_by", "pipeline_stage_ceilings_ips",
+    "pipeline_bound_by", "pipeline_stage_ceilings_ips", "bound",
     "host_copy", "fidelity", "runner_strategy", "sanitize", "serve",
     "autotune", "tails",
 ]
@@ -211,7 +220,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/14] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/15] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -250,11 +259,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/14] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/15] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/14] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/15] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -349,7 +358,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/14] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/15] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -459,7 +468,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/14] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/15] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -550,22 +559,37 @@ while not wd.healthy():
 code, body = get("/healthz")
 assert code == 200, (code, body)
 
-# /metricsz must parse as Prometheus text exposition format
+# /metricsz must parse as Prometheus text exposition format — and
+# every exported sample must carry BOTH its # HELP and # TYPE line
+# (render_prometheus emits the pair; a renderer regression that drops
+# either fails here, line-by-line)
 code, body = get("/metricsz")
 assert code == 200, (code, body)
 sample = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
     r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|nan|inf)$")
 n = 0
+help_names, type_names, sample_names = set(), set(), set()
 for line in body.strip().splitlines():
     if not line:
         continue
     if line.startswith("#"):
-        assert re.match(r"^# (TYPE|HELP) ", line), repr(line)
+        m = re.match(r"^# (TYPE|HELP) ([a-zA-Z_:][a-zA-Z0-9_:]*) .+$",
+                     line)
+        assert m, repr(line)
+        (help_names if m.group(1) == "HELP" else type_names).add(
+            m.group(2))
         continue
     assert sample.match(line), f"bad Prometheus line: {line!r}"
+    sample_names.add(line.split("{")[0].split(" ")[0])
     n += 1
 assert n > 0, "empty /metricsz"
+assert sample_names <= type_names, \
+    f"samples missing # TYPE: {sorted(sample_names - type_names)[:8]}"
+assert type_names == help_names, \
+    (f"HELP/TYPE mismatch: TYPE-only "
+     f"{sorted(type_names - help_names)[:8]}, HELP-only "
+     f"{sorted(help_names - type_names)[:8]}")
 assert "sparkdl_watchdog_stalls" in body, body[:400]
 assert "sparkdl_flight_dumps" in body, body[:400]
 
@@ -583,11 +607,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/14] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/15] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/14] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/15] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -652,7 +676,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/14] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/15] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -750,7 +774,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/14] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/15] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -842,7 +866,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/14] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/15] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -968,5 +992,125 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h15_s": t["per_rule_s"]["H15"],
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
+
+echo "== [15/15] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+# (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
+# verdict is computed by obs/ledger.py — fractions in [0,1], verdict
+# equal to the max-utilization stage, and the SAME fractions on the
+# published ledger.util.* gauges in the obs registry snapshot
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_bench_obs.json") as f:
+    d = json.load(f)
+b = d["bound"]
+for k in ("bound_by", "headroom_pct", "util", "window_s",
+          "link_basis", "ship_MBps", "windows", "ceilings", "offline"):
+    assert k in b, f"bound block: missing {k!r}: {sorted(b)}"
+util = b["util"]
+assert isinstance(util, dict) and set(util) == \
+    {"decode", "link", "compute", "serve"}, util
+for k, v in util.items():
+    assert 0.0 <= v <= 1.0, (k, v)
+# the verdict IS the max-utilization stage (the attribute() contract;
+# ties break alphabetically-first, same as the library)
+best = sorted(util.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+if best[1] > 0.0:
+    assert b["bound_by"] == best[0], (b["bound_by"], util)
+else:
+    assert b["bound_by"] == "idle", (b["bound_by"], util)
+assert 0.0 <= b["headroom_pct"] <= 100.0, b["headroom_pct"]
+assert b["windows"] >= 1, b["windows"]
+# the published gauges carry the same fractions (one code path, no
+# bench-local twin)
+reg = d["obs"]["registry"]
+for k, v in util.items():
+    key = f"ledger.util.{k}"
+    assert key in reg, f"{key} missing from the obs registry snapshot"
+    # the block rounds to 4 decimals; the gauge is full precision
+    assert abs(reg[key] - v) < 5e-5, (key, reg[key], v)
+assert "ledger.bound_by" in reg and "ledger.headroom_pct" in reg, \
+    sorted(k for k in reg if k.startswith("ledger"))
+# the offline ceilings verdict is the SAME attribute() output bench
+# headlines as pipeline_bound_by
+assert d["pipeline_bound_by"] == b["offline"]["bound_by"], \
+    (d["pipeline_bound_by"], b["offline"])
+# the headline line carries the live verdict too (driver contract)
+with open("/tmp/sparkdl_bench_obs_stdout.txt") as f:
+    head = json.loads(f.read().strip().splitlines()[-1])
+assert "bound_by" in head, sorted(head)
+print(json.dumps({"bound_gate": "ok", "bound_by": b["bound_by"],
+                  "headroom_pct": b["headroom_pct"], "util": util}))
+EOF
+# (b) live scrape + flight bundle: traffic -> a ledger window ->
+# /metricsz carries sparkdl_ledger_util_* (with HELP), /statusz and a
+# flight dump both carry the ledger section with its history ring.
+# The probe file points at a throwaway: this step INJECTS fabricated
+# ceilings, which must never land in the host's shared probe cache
+# where a later real process would read them as measured bandwidth.
+SPARKDL_TPU_FLIGHT_DIR=/tmp \
+  SPARKDL_TPU_LEDGER_PROBE_FILE=/tmp/sparkdl_ci_ledger_probe.json \
+  python - <<'EOF'
+import json
+import re
+import urllib.request
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import flight, start_telemetry
+from sparkdl_tpu.obs.ledger import ledger
+from sparkdl_tpu.runtime.runner import BatchRunner
+
+led = ledger()
+led.ensure_ceilings({"link_h2d_MBps": 100.0, "link_d2h_MBps": 100.0,
+                     "source": "ci-step-15"})
+led.baseline()
+mf = ModelFunction.fromSingle(lambda x: x * 2.0, None, input_shape=(4,))
+runner = BatchRunner(mf, batch_size=8)
+runner.run({"input": np.ones((32, 4), np.float32)})
+w = led.tick()
+assert w is not None and w["util"]["compute"] > 0.0, w
+
+tel = start_telemetry()
+with urllib.request.urlopen(tel.url("/metricsz"), timeout=5) as r:
+    body = r.read().decode()
+for stage in ("decode", "link", "compute", "serve"):
+    assert re.search(rf"^sparkdl_ledger_util_{stage} ", body, re.M), \
+        f"sparkdl_ledger_util_{stage} missing from /metricsz"
+    assert re.search(rf"^# HELP sparkdl_ledger_util_{stage} ", body,
+                     re.M), f"HELP missing for ledger.util.{stage}"
+assert re.search(r"^sparkdl_ledger_bound_by ", body, re.M), body[:400]
+
+with urllib.request.urlopen(tel.url("/statusz"), timeout=5) as r:
+    st = json.load(r)
+assert "ledger" in st, sorted(st)
+for k in ("window_s", "windows", "history_len", "evicted", "ceilings",
+          "last", "history"):
+    assert k in st["ledger"], f"/statusz ledger missing {k!r}"
+assert st["ledger"]["windows"] >= 1, st["ledger"]
+assert isinstance(st["ledger"]["history"], list) \
+    and st["ledger"]["history"], "empty ledger history on /statusz"
+
+path = flight.recorder().dump(reason="ci ledger gate")
+with open(path) as f:
+    bundle = json.load(f)
+assert "ledger" in bundle, sorted(bundle)
+assert isinstance(bundle["ledger"].get("history"), list) \
+    and bundle["ledger"]["history"], bundle["ledger"]
+assert bundle["ledger"]["history"][-1]["bound_by"] in (
+    "decode", "link", "compute", "serve", "idle"), bundle["ledger"]
+tel.close()
+print(json.dumps({"ledger_scrape_gate": "ok",
+                  "bound_by": w["bound_by"],
+                  "windows": st["ledger"]["windows"],
+                  "bundle": path}))
+EOF
+# (c) the offline CLI reads the step-7 armed trace against the same
+# roofline lanes and prints the same-code-path verdict
+python -m sparkdl_tpu.obs report --bound \
+  /tmp/sparkdl_obs_bench_trace.json | tee /tmp/sparkdl_bound_report.txt
+grep -q "live roofline" /tmp/sparkdl_bound_report.txt
+grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
 echo "== ci.sh: ALL GREEN =="
