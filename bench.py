@@ -73,6 +73,15 @@ The full result reports:
   through the SAME ``ledger.attribute()`` call, so the offline and
   live verdicts are one code path. tools/ci.sh gates the schema,
   the [0,1] bounds, and verdict == max-utilization stage.
+* ``compile`` — compile forensics (docs/OBSERVABILITY.md,
+  obs/compile_log.py): the run's jit compiles per function with wall
+  time, cost/memory analysis, retrace attribution (a diff naming the
+  argument that moved), and the steady-state zero-retrace verdict
+  (``unexpected_retraces`` — the warmed serve pass must report 0);
+  ``device_gflops_ceiling`` is the model-calibrated compute roofline
+  the ledger's ``compute_basis`` divides by. tools/ci.sh gates the
+  schema, the clean-pass zero, and an injected off-ladder shape
+  showing the attributed retrace.
 * ``autotune`` — the closed-loop infeed autotuner
   (sparkdl_tpu/autotune, docs/PERFORMANCE.md): tuned-vs-fixed
   throughput with the baseline's recorded noise band, decision /
@@ -524,6 +533,13 @@ def main() -> None:
     # retention before any section that can stall, not at reporting time
     from sparkdl_tpu.obs import flight as obs_flight
     obs_flight.autoarm()
+    # compile forensics are part of the bench contract (the "compile"
+    # block + the ledger's model-specific compute ceiling both read
+    # it) — armed for the whole run, before the first model builds.
+    # The AOT cost-analysis pass this enables rides the persistent XLA
+    # compilation cache configured below, so big programs compile once.
+    from sparkdl_tpu.obs.compile_log import compile_log
+    compile_log().arm()
     tpu_down = False
     if not _probe_accelerator():
         import jax
@@ -665,8 +681,20 @@ def main() -> None:
     # ledger.bound_by gauges the "bound" block and ci.sh gate read.
     from sparkdl_tpu.obs.ledger import ledger as _ledger
     led = _ledger()
+    # the model-calibrated compute ceiling (docs/OBSERVABILITY.md):
+    # device-resident images/s × the compiled program's cost_analysis
+    # FLOPs/image (compile log) = the device's demonstrated FLOP rate
+    # ON THIS PROGRAM — the compute lane's roofline denominator, with
+    # compute_basis naming it in the ledger verdict. Degrades to None
+    # (busy-time attribution) on backends whose cost_analysis returns
+    # nothing.
+    model_flops = getattr(mf.jitted(), "last_flops", None)
+    device_gflops = (
+        round(device["ips"] * (model_flops / batch_size) / 1e9, 3)
+        if model_flops else None)
     led.ensure_ceilings({"link_h2d_MBps": link["h2d_MBps"],
                          "link_d2h_MBps": link["d2h_MBps"],
+                         "device_gflops": device_gflops,
                          "source": "bench.measure_link"})
     led.baseline()
     pipeline = measure_pipeline(mf, packed_src, batch_size,
@@ -877,6 +905,15 @@ def main() -> None:
         "tails": tails,
         "autotune": autotune,
         "resilience": resilience_block,
+        # compile forensics (docs/OBSERVABILITY.md, obs/compile_log.py):
+        # per-function compile counts + wall time, retrace attribution,
+        # and the zero-retrace verdict over the whole run — literally
+        # the same renderer /statusz and the flight bundle use. A
+        # warmed serve pass must show unexpected_retraces == 0 (ci.sh
+        # gates it, plus an injected off-ladder shape showing > 0 with
+        # the diff naming the argument).
+        "compile": obs_flight.compile_state(),
+        "device_gflops_ceiling": device_gflops,
         "infeed_race": infeed_race,
         **({"tpu_fallback": ("tunneled TPU backend did not initialize; "
                              "CPU numbers are compute-bound on this "
@@ -900,11 +937,12 @@ def main() -> None:
                 "util": ledger_window["util"],
                 "window_s": ledger_window["dt_s"],
                 "link_basis": ledger_window["link_basis"],
+                "compute_basis": ledger_window["compute_basis"],
                 "ship_MBps": ledger_window["ship_MBps"]}
                if ledger_window is not None else
                {"bound_by": None, "headroom_pct": None, "util": None,
                 "window_s": None, "link_basis": None,
-                "ship_MBps": None}),
+                "compute_basis": None, "ship_MBps": None}),
             **{k: ledger_status[k] for k in ("windows", "ceilings")},
             "offline": {"bound_by": pipeline_bound_by,
                         "util": {k: round(v, 4)
@@ -984,6 +1022,11 @@ def main() -> None:
         "serve_p99_ms": result["serve"].get("p99_latency_ms"),
         "tails_p99_ms": result["tails"].get("p99_ms"),
         "autotune_converged": result["autotune"].get("converged"),
+        # compile forensics: total compiles observed + the zero-
+        # retrace verdict (docs/OBSERVABILITY.md)
+        "compiles": result["compile"].get("events"),
+        "unexpected_retraces": result["compile"].get(
+            "unexpected_retraces"),
         **({"tpu_fallback": True} if tpu_down else {}),
         "result_path": result_path,
         "note": "headline only; the full result (all keys, "

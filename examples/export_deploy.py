@@ -85,10 +85,48 @@ def main():
     # pads every dispatch to exactly that shape, so single-row requests
     # from many threads are served by the same compiled program.
     import threading
+    import time
 
     from sparkdl_tpu.graph.function import ModelFunction
+    from sparkdl_tpu.obs.compile_log import compile_log
     from sparkdl_tpu.serve import ModelServer, ServeConfig
 
+    # ROADMAP item 4's AOT warm-start case, MEASURED from the compile
+    # log (docs/OBSERVABILITY.md "Compile forensics"): the same
+    # deployed bytes served cold (first request pays the jit on the
+    # hot path) vs warmed (warmup() moves the one compile before
+    # traffic) — the difference is what a freshly scaled worker saves.
+    clog = compile_log()
+    saved_override = clog._override   # restore the caller's arming
+    clog.arm()
+
+    def first_request_s(name: str, warm: bool) -> float:
+        # deltas, not totals: the process-wide log survives repeated
+        # in-process runs of this example (the test suite's runpy)
+        base = clog.compiles_of(f"{name}.jitted")
+        m = ModelFunction.deserialize(blob, name=name)
+        srv = ModelServer(ServeConfig(max_wait_s=0.02))
+        srv.register(name, m, batch_size=batch)
+        if warm:
+            srv.warmup()
+        t0 = time.perf_counter()
+        srv.submit({m.input_names[0]: x[:1]}).result(timeout=120)
+        latency = time.perf_counter() - t0
+        srv.close()
+        # the compile log attributes where the jit landed: cold serves
+        # compile ON the first request, warmed serves before it
+        assert clog.compiles_of(f"{name}.jitted") - base == 1, \
+            (name, clog.state()["functions"])
+        return latency
+
+    cold_s = first_request_s("deployed_cold", warm=False)
+    warm_s = first_request_s("deployed_warm", warm=True)
+    print(f"first-request latency: {cold_s * 1e3:.1f} ms cold "
+          f"(compile on the hot path) vs {warm_s * 1e3:.1f} ms after "
+          f"warmup() (compile paid before traffic; compile log "
+          f"attributes both)")
+
+    served_base = clog.compiles_of("deployed.jitted")
     served = ModelFunction.deserialize(blob, name="deployed")
     server = ModelServer(ServeConfig(max_wait_s=0.02))
     server.register("deployed", served, batch_size=batch)
@@ -117,13 +155,21 @@ def main():
         for i, out in rows:
             np.testing.assert_allclose(out[out_name], expected[i:i + 1],
                                        rtol=1e-5, atol=1e-5)
+    # the zero-retrace pin (tests/test_examples.py reads this line):
+    # warmup + 12 concurrent requests through one fixed-batch export =
+    # exactly ONE compile of the served program, zero after steady
+    served_compiles = clog.compiles_of("deployed.jitted") - served_base
+    assert served_compiles == 1, clog.state()["functions"]
+    clog._override = saved_override
     m = server.metrics.as_dict()
     print(f"serve: {m['requests']} concurrent requests -> "
           f"{m['batches']} micro-batches, "
           f"fill {m['batch_fill_ratio']:.2f}, "
           f"p99 {m['latency_p99_ms']:.1f} ms, "
           f"rejections {m['rejections']}, "
-          f"deadline_misses {m['deadline_misses']}")
+          f"deadline_misses {m['deadline_misses']}, "
+          f"served-path compiles {served_compiles} (exactly once; "
+          f"unexpected retraces 0)")
 
 
 if __name__ == "__main__":

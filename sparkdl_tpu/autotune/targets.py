@@ -407,6 +407,13 @@ class RechunkTarget(_TrialMixin):
                      for k, (shape, dtype) in sig.items()}
             fn(params, zeros)
         self.warmed = True
+        # every rung is compiled — mark the model's programs STEADY in
+        # the compile log (obs/compile_log.py): the one-of-K-prewarmed
+        # guarantee becomes a runtime invariant, and any OFF-ladder
+        # shape from here on counts compile.unexpected_retraces with a
+        # diff naming the argument that moved
+        from sparkdl_tpu.obs.compile_log import compile_log
+        compile_log().mark_model_steady(mf, reason="prewarm")
         logger.info("autotune: %s pre-warmed %d ladder rungs %s",
                     self.name, len(self.ladder), self.ladder)
         return len(self.ladder)

@@ -507,9 +507,19 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         single-device path; callers that place arrays themselves
         (multi-host streaming) derive their shardings from THIS mesh so
         the jit's in_shardings and the placed arrays can never diverge.
+
+        Both branches route through the process-wide compile log
+        (obs/compile_log.py): a training loop that starts retracing
+        per step (a shape leak in the batch feed) is attributed at
+        runtime with a diff naming the argument, instead of
+        presenting as an unexplained slowdown.
         """
         import jax
 
+        from sparkdl_tpu.obs.compile_log import compile_log
+
+        step_args = ("trainable", "non_trainable", "opt_state",
+                     "xb", "yb")
         if self.getOrDefault("useMesh") and len(jax.devices()) > 1:
             from sparkdl_tpu.parallel.mesh import (
                 DATA_AXIS, data_sharding, make_mesh, replicated)
@@ -521,8 +531,19 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                              in_shardings=(rep, rep, rep, dat, dat),
                              out_shardings=(rep, rep, rep, rep),
                              donate_argnums=(3, 4))
+            jitted = compile_log().instrument(
+                jitted, name=f"{type(self).__name__}.train_step",
+                kind="sharded_jit",
+                config={"donate_argnums": (3, 4),
+                        "mesh": tuple(mesh.shape.items())},
+                arg_names=step_args)
             return jitted, batch_size, mesh
-        return jax.jit(step, donate_argnums=(3, 4)), batch_size, None
+        jitted = jax.jit(step, donate_argnums=(3, 4))
+        jitted = compile_log().instrument(
+            jitted, name=f"{type(self).__name__}.train_step",
+            kind="jit", config={"donate_argnums": (3, 4)},
+            arg_names=step_args)
+        return jitted, batch_size, None
 
     @staticmethod
     def _prepare_targets(y: np.ndarray, loss, n_out: int) -> np.ndarray:

@@ -28,11 +28,14 @@ libtensorflow); see ``graph/ingest.py`` for the boundary.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from sparkdl_tpu.obs.compile_log import compile_log
 
 # name -> (per-row shape tuple, dtype)
 Signature = Dict[str, Tuple[Tuple[int, ...], Any]]
@@ -218,7 +221,28 @@ class ModelFunction:
             self._params_cache = {
                 k: v for k, v in self._params_cache.items()
                 if v[0] is self.params}
-            entry = (self.params, put(self.params))
+            # a cache miss is a weight transfer the compile forensics
+            # want on the books (obs/compile_log.py): each placement
+            # holds param-sized HBM for the ModelFunction's lifetime,
+            # and a steady process re-placing weights is the same
+            # class of hot-path surprise as a retrace
+            log = compile_log()
+            if log.armed:
+                t0 = time.perf_counter()
+                placed = put(self.params)
+                wall = time.perf_counter() - t0
+                leaves = jax.tree_util.tree_leaves(self.params)
+                log.record_transfer(
+                    name=f"{self.name}.device_params", kind="device_put",
+                    wall_s=wall,
+                    detail={"placement": (key if isinstance(key, str)
+                                          else key[0]),
+                            "leaves": len(leaves),
+                            "bytes": sum(int(getattr(v, "nbytes", 0))
+                                         for v in leaves)})
+                entry = (self.params, placed)
+            else:
+                entry = (self.params, put(self.params))
             self._params_cache[key] = entry
         return entry[1]
 
@@ -254,10 +278,20 @@ class ModelFunction:
             from sparkdl_tpu.parallel.mesh import data_sharding, replicated
             rep = replicated(mesh)
             dat = data_sharding(mesh)
-            self._jit_cache[key] = jax.jit(
+            fn = jax.jit(
                 self.apply_fn,
                 in_shardings=(rep, {k: dat for k in self.input_names}),
                 out_shardings=dat)
+            # route compiles through the process-wide CompileLog
+            # (obs/compile_log.py): retrace attribution + cost/memory
+            # accounting; one armed-check + passthrough disarmed
+            self._jit_cache[key] = compile_log().instrument(
+                fn, name=f"{self.name}.sharded_jitted",
+                kind="sharded_jit",
+                config={"mesh": tuple(mesh.shape.items()),
+                        "in_shardings": "replicated+data",
+                        "out_shardings": "data"},
+                arg_names=("params", "inputs"))
         return self._jit_cache[key]
 
     def jitted(self, donate_inputs: bool = False) -> Callable:
@@ -266,9 +300,16 @@ class ModelFunction:
             raise ValueError(f"cannot jit backend '{self.backend}'")
         key = ("jit", donate_inputs)
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(
+            fn = jax.jit(
                 self.apply_fn,
                 donate_argnums=(1,) if donate_inputs else ())
+            # route compiles through the process-wide CompileLog
+            # (obs/compile_log.py) — the serve layer's zero-retrace
+            # guarantee is enforced against exactly this wrapper
+            self._jit_cache[key] = compile_log().instrument(
+                fn, name=f"{self.name}.jitted", kind="jit",
+                config={"donate_inputs": donate_inputs},
+                arg_names=("params", "inputs"))
         return self._jit_cache[key]
 
     def __call__(self, inputs, params: Any = "__own__"):
@@ -321,6 +362,7 @@ class ModelFunction:
         The result is jittable and composable (it re-traces through the
         exported computation)."""
         from jax import export as jax_export
+        t0 = time.perf_counter()
         try:
             exported = jax_export.deserialize(blob)
         except Exception as e:
@@ -332,6 +374,17 @@ class ModelFunction:
                 "bytes; produce one with ModelFunction.export / "
                 f"ModelIngest.fromExport): {type(e).__name__}: "
                 f"{str(e)[:120]}") from e
+        # a StableHLO load is a compile-adjacent event the forensics
+        # want on the books (obs/compile_log.py): deserialization wall
+        # time + blob size, keyed by the deployed name — an AOT
+        # warm-start story is judged by where these land relative to
+        # the first request
+        log = compile_log()
+        if log.armed:
+            log.record_transfer(
+                name=f"{name}.deserialize", kind="deserialize",
+                wall_s=time.perf_counter() - t0,
+                detail={"bytes": len(blob)})
         in_tree = exported.in_tree
         # input signature from the exported avals: one dict arg
         avals = exported.in_avals

@@ -2,7 +2,18 @@
 Perfetto-exportable timelines, and an operable health surface across
 engine → ship → device.
 
-Nine pieces (docs/OBSERVABILITY.md):
+Ten pieces (docs/OBSERVABILITY.md):
+
+* :mod:`sparkdl_tpu.obs.compile_log` — compile forensics: every
+  package jit compile routes through ONE CompileLog (callable name,
+  abstract arg signature, wall time, ``cost_analysis``/
+  ``memory_analysis`` FLOPs+bytes), recompiles of known functions
+  carry a signature diff naming the offending argument, and
+  ``warmup``/``prewarm`` mark programs *steady* — after which any
+  compile counts ``compile.unexpected_retraces`` and fires a flight
+  dump (the runtime-enforced zero-retrace guarantee); per-device
+  ``memory_stats()`` publishes as periodic ``hbm.*`` gauges with
+  high-watermark tracking;
 
 * :mod:`sparkdl_tpu.obs.ledger` — the windowed utilization ledger:
   per-window rates over the hot paths' feed counters, divided by
@@ -45,6 +56,11 @@ the telemetry endpoint work on any machine); :func:`timed_device_get`
 and the flight recorder's platform probes import it lazily.
 """
 
+from sparkdl_tpu.obs.compile_log import (
+    CompileLog,
+    compile_log,
+    publish_hbm,
+)
 from sparkdl_tpu.obs.export import (
     TelemetryServer,
     render_prometheus,
@@ -84,6 +100,7 @@ from sparkdl_tpu.obs.watchdog import StallWatchdog
 from sparkdl_tpu.obs.watchdog import watchdog as stall_watchdog
 
 __all__ = [
+    "CompileLog",
     "Counter",
     "FlightRecorder",
     "Gauge",
@@ -99,12 +116,14 @@ __all__ = [
     "TelemetryServer",
     "Tracer",
     "UtilizationLedger",
+    "compile_log",
     "default_registry",
     "flight_recorder",
     "ledger",
     "ledger_attribute",
     "ledger_poll",
     "probe_ceilings",
+    "publish_hbm",
     "render_prometheus",
     "request_log",
     "slo_tracker",

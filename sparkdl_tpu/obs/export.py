@@ -92,6 +92,11 @@ HELP_BY_PREFIX = (
     ("flight.", "flight-recorder forensics bundles (obs/flight.py)"),
     ("slo.", "rolling-window SLO burn-rate/budget verdicts "
              "(obs/slo.py)"),
+    ("compile.", "compile forensics: jit compiles, retrace "
+                 "attribution, the steady-state zero-retrace "
+                 "guarantee (obs/compile_log.py)"),
+    ("hbm.", "per-device memory_stats() HBM accounting with "
+             "high-watermark tracking (obs/compile_log.py)"),
     ("obs.", "the observability layer's own accounting "
              "(sparkdl_tpu/obs)"),
     ("faults.", "armed fault-injection drill counters "
@@ -264,16 +269,40 @@ class TelemetryServer:
                     self._registry.counter("telemetry.errors").add()
                     logger.debug("telemetry: ledger tick failed: %s",
                                  e)
+                # HBM accounting at scrape time: a scrape is exactly
+                # the reader that should pay for gauge freshness (the
+                # SLO-refresh precedent); degrades internally
+                try:
+                    from sparkdl_tpu.obs.compile_log import publish_hbm
+                    publish_hbm(self._registry)
+                except Exception as e:
+                    self._registry.counter("telemetry.errors").add()
+                    logger.debug("telemetry: hbm refresh failed: %s",
+                                 e)
                 body = render_prometheus(self._registry).encode()
                 self._reply(handler, 200, body,
                             "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
                 verdict = self._watchdog.verdict()
                 code = 200 if verdict["healthy"] else 503
+                # the compile-forensics detail (obs/compile_log.py):
+                # unexpected retraces are a perf-guarantee violation,
+                # not a liveness failure — the status code stays the
+                # watchdog's; the detail flips so a probe (and ci.sh's
+                # gate) sees the warm-start contract break
+                try:
+                    from sparkdl_tpu.obs.compile_log import compile_log
+                    retraces = compile_log().unexpected_retraces
+                except Exception:
+                    retraces = None
                 body = json.dumps({
                     "status": "ok" if code == 200 else "stalled",
                     "stalled_sources": verdict["stalled_sources"],
                     "watchdog_armed": verdict["armed"],
+                    "unexpected_retraces": retraces,
+                    "compile_steady": (retraces == 0
+                                       if retraces is not None
+                                       else None),
                 }).encode()
                 self._reply(handler, code, body, "application/json")
             elif path == "/statusz":
@@ -338,6 +367,12 @@ class TelemetryServer:
             # bounded history ring (obs/ledger.py) — literally the
             # same renderer the flight bundle uses
             "ledger": _flight.ledger_state(),
+            # compile forensics (obs/compile_log.py): per-function
+            # compile counts, retrace attribution, the steady-state
+            # zero-retrace verdict — same shape as the flight
+            # bundle's section ("diagnosing a compile storm",
+            # docs/SERVING.md)
+            "compile": _flight.compile_state(),
             "servers": servers,
             "metrics_count": len(self._registry.snapshot()),
         }

@@ -224,6 +224,27 @@ def ledger_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def compile_state() -> dict:
+    """The compile log's forensics — per-function compile counts,
+    retrace/unexpected verdicts, the last event (obs/compile_log.py)
+    — ONE shape shared by the flight bundle, ``/statusz``, and
+    bench's ``compile`` block; degrades like every probe. Recent
+    events ride along (bounded: last 16) so a retrace-triggered dump
+    carries the diff that caused it."""
+    try:
+        from sparkdl_tpu.obs.compile_log import compile_log
+        log = compile_log()
+        recent = [{
+            "name": e.name, "kind": e.kind,
+            "wall_s": round(e.wall_s, 4), "retrace": e.retrace,
+            "unexpected": e.unexpected, "diff": e.diff,
+            "flops": (e.cost or {}).get("flops"),
+        } for e in log.events()[-16:]]
+        return {**log.state(), "recent": recent}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -319,6 +340,15 @@ class FlightRecorder:
         probe did."""
         trc = tracer()
         events = trc.trace_events()
+        # refresh the hbm.* gauges so the registry snapshot below
+        # carries the current high-watermarked HBM accounting, not a
+        # stale window's (obs/compile_log.py; degrades internally)
+        try:
+            from sparkdl_tpu.obs.compile_log import publish_hbm
+            publish_hbm()
+        except Exception as e:
+            default_registry().counter("flight.degrade_events").add()
+            logger.debug("flight: hbm refresh failed (%s)", e)
         return {
             "schema": BUNDLE_SCHEMA,
             "reason": reason,
@@ -336,6 +366,7 @@ class FlightRecorder:
             "spans_dropped": trc.dropped,
             "serve": _serve_status(),
             "autotune": _autotune_state(),
+            "compile": compile_state(),
             "ledger": ledger_state(),
             "slo": _slo_state(),
             "requests": _request_state(),

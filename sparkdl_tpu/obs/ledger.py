@@ -101,6 +101,12 @@ FEEDS = {
 }
 LINK_WAIT_FEED = "ship.transfer_wait_seconds_total"
 LINK_BYTES_FEED = "ship.bytes_shipped"
+#: executed-FLOPs feed (runtime/runner.py record_run_feeds, populated
+#: when the compile log recorded the program's cost_analysis) — lifts
+#: the compute lane from a generic busy fraction to a model-specific
+#: roofline when a device_gflops ceiling exists (compute_basis names
+#: which)
+COMPUTE_FLOPS_FEED = "device.flops_total"
 
 #: default window length (seconds) when SPARKDL_TPU_LEDGER_WINDOW_S
 #: is unset — long enough to smooth per-batch jitter, short enough
@@ -396,6 +402,7 @@ class UtilizationLedger:
                 for stage, key in FEEDS.items()}
         vals["link_wait"] = reg.counter(LINK_WAIT_FEED).value
         vals["link_bytes"] = reg.counter(LINK_BYTES_FEED).value
+        vals["compute_flops"] = reg.counter(COMPUTE_FLOPS_FEED).value
         return vals
 
     def baseline(self, now: Optional[float] = None) -> None:
@@ -435,10 +442,11 @@ class UtilizationLedger:
                 return None
             last = self._last
             self._last_t, self._last = now, cur
-        deltas = {k: cur[k] - last[k] for k in cur}
+        deltas = {k: cur.get(k, 0.0) - last.get(k, 0.0) for k in cur}
         resets = sum(1 for v in deltas.values() if v < 0)
         deltas = {k: max(0.0, v) for k, v in deltas.items()}
-        util, link_basis = self._utils(deltas, dt, ceilings)
+        util, link_basis, compute_basis = self._utils(deltas, dt,
+                                                      ceilings)
         verdict = attribute(util)
         window = {
             "t_s": round(now - self._epoch, 3),
@@ -447,6 +455,7 @@ class UtilizationLedger:
             "bound_by": verdict["bound_by"],
             "headroom_pct": verdict["headroom_pct"],
             "link_basis": link_basis,
+            "compute_basis": compute_basis,
             "ship_MBps": round(deltas["link_bytes"] / dt / _MB, 3),
             "counter_resets": resets,
         }
@@ -469,15 +478,32 @@ class UtilizationLedger:
             # the bounded ring evicts its oldest window — counted,
             # never silent (the tracer drop-note discipline)
             reg.counter("ledger.windows_evicted").add()
+        # HBM accounting rides the window cadence: per-device
+        # memory_stats() → hbm.* gauges with high-watermark tracking
+        # (obs/compile_log.py; degrades internally — CPU devices
+        # report nothing and hbm.devices_reporting says so)
+        try:
+            from sparkdl_tpu.obs.compile_log import publish_hbm
+            publish_hbm(reg)
+        except Exception as e:
+            reg.counter("ledger.config_errors").add()
+            logger.debug("ledger: hbm publish failed (%s)", e)
         return window
 
     @staticmethod
     def _utils(deltas: Dict[str, float], dt: float,
                ceilings: Dict[str, Any]) -> tuple:
-        """(utilization fractions, link basis) for one window. Time
-        lanes are busy fractions of the window wall; the link lane is
-        shipped bytes/s over the probed bandwidth, degrading to the
-        transfer-wait fraction when no probe is available."""
+        """(utilization fractions, link basis, compute basis) for one
+        window. Time lanes are busy fractions of the window wall; the
+        link lane is shipped bytes/s over the probed bandwidth,
+        degrading to the transfer-wait fraction when no probe is
+        available; the compute lane is executed FLOPs/s over the
+        model-calibrated device ceiling (``device_gflops`` in the
+        ceilings — bench injects it from its device-resident pass ×
+        the compile log's cost_analysis) when BOTH the ceiling and the
+        flops feed exist, degrading to the dispatch+drain busy
+        fraction (``compute_basis`` names which — the ``link_basis``
+        mirror)."""
         clamp = lambda v: min(1.0, max(0.0, v))  # noqa: E731
         util = {stage: clamp(deltas[stage] / dt) for stage in FEEDS}
         bw = ceilings.get("link_h2d_MBps") if ceilings else None
@@ -488,7 +514,15 @@ class UtilizationLedger:
         else:
             util["link"] = clamp(deltas["link_wait"] / dt)
             basis = "transfer-wait"
-        return util, basis
+        gflops = ceilings.get("device_gflops") if ceilings else None
+        flops = deltas.get("compute_flops", 0.0)
+        if isinstance(gflops, (int, float)) and gflops > 0 and flops > 0:
+            util["compute"] = clamp(
+                (flops / dt) / (gflops * 1e9))
+            compute_basis = "flops/model-ceiling"
+        else:
+            compute_basis = "busy-time"
+        return util, basis, compute_basis
 
     def tick_due(self, now: Optional[float] = None
                  ) -> Optional[Dict[str, Any]]:
@@ -546,7 +580,7 @@ class UtilizationLedger:
         dt = max(now - self._epoch, 1e-9)
         totals = self._read_feeds()
         ceilings = self._ceilings or {}
-        util, _basis = self._utils(totals, dt, ceilings)
+        util, _basis, _cbasis = self._utils(totals, dt, ceilings)
         v = attribute(util)
         v["basis"] = "cumulative"
         return v

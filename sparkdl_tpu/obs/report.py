@@ -339,6 +339,101 @@ def summarize_tails(events: Sequence[dict]) -> str:
     return "\n".join(lines)
 
 
+def compile_summary(events: Sequence[dict]) -> Optional[dict]:
+    """Compile forensics from the ``compile``-lane spans the compile
+    log records (obs/compile_log.py — one span per ACTUAL compile,
+    args carrying ``fn``/``kind``/``retrace``/``unexpected``/``diff``/
+    ``flops``). Returns ``None`` for a trace with no compile spans
+    (disarmed compile log, or pre-compile-log trace — forward AND
+    backward compatible). The dict: compile count, total/max wall ms,
+    retrace and unexpected-retrace counts, a per-function breakdown,
+    and the retrace diffs — what "diagnosing a compile storm"
+    (docs/SERVING.md) reads first."""
+    spans = [e for e in events
+             if e.get("ph") == "X" and e.get("name") == "compile"
+             and isinstance(e.get("args"), dict)]
+    if not spans:
+        return None
+    by_fn: Dict[str, Dict[str, float]] = {}
+    retraces = []
+    for e in spans:
+        a = e["args"]
+        fn = str(a.get("fn", "?"))
+        dur = float(e.get("dur", 0.0))
+        entry = by_fn.setdefault(fn, {
+            "compiles": 0, "total_ms": 0.0, "max_ms": 0.0,
+            "retraces": 0, "unexpected": 0})
+        entry["compiles"] += 1
+        entry["total_ms"] += dur / 1e3
+        entry["max_ms"] = max(entry["max_ms"], dur / 1e3)
+        if a.get("retrace"):
+            entry["retraces"] += 1
+        if a.get("unexpected"):
+            entry["unexpected"] += 1
+        # attribution rows cover BOTH verdicts: an unexpected compile
+        # with no prior signature (steady program, log armed
+        # mid-incident — retrace=False by the diff's absence) is
+        # still the violation this report exists to surface
+        if a.get("retrace") or a.get("unexpected"):
+            retraces.append({"fn": fn, "ms": round(dur / 1e3, 3),
+                             "unexpected": bool(a.get("unexpected")),
+                             "diff": a.get("diff") or None})
+    for entry in by_fn.values():
+        entry["total_ms"] = round(entry["total_ms"], 3)
+        entry["max_ms"] = round(entry["max_ms"], 3)
+    return {
+        "compiles": len(spans),
+        "total_ms": round(sum(float(e.get("dur", 0.0))
+                              for e in spans) / 1e3, 3),
+        "retraces": sum(1 for s in spans
+                        if s["args"].get("retrace")),
+        "unexpected_retraces": sum(1 for s in spans
+                                   if s["args"].get("unexpected")),
+        "by_fn": by_fn,
+        "retrace_events": retraces[-8:],
+    }
+
+
+def summarize_compile(events: Sequence[dict]) -> str:
+    """The ``--compile`` text section (unit-testable without the
+    CLI)."""
+    c = compile_summary(events)
+    if c is None:
+        return ("(no compile spans in trace — arm SPARKDL_TPU_TRACE "
+                "and SPARKDL_TPU_COMPILE_LOG=1 (or "
+                "compile_log().arm()) and run traffic to record "
+                "compile forensics)")
+    lines = [
+        f"compiles: {c['compiles']}   "
+        f"wall {c['total_ms']:.3f} ms total (first-call: "
+        "trace+compile+first execution)   "
+        f"retraces {c['retraces']} "
+        f"({c['unexpected_retraces']} UNEXPECTED — compiles on a "
+        "steady hot path)",
+        "",
+        "per function (compiles, total_ms, max_ms, retraces, "
+        "unexpected)",
+    ]
+    for fn in sorted(c["by_fn"],
+                     key=lambda k: -c["by_fn"][k]["total_ms"]):
+        e = c["by_fn"][fn]
+        lines.append(
+            f"  {fn}: {e['compiles']} compiles, "
+            f"{e['total_ms']:.3f} ms total, {e['max_ms']:.3f} ms max"
+            + (f", {e['retraces']} retraces"
+               if e["retraces"] else "")
+            + (f" ({e['unexpected']} unexpected)"
+               if e["unexpected"] else ""))
+    if c["retrace_events"]:
+        lines += ["", "retrace attribution (most recent; the "
+                      "argument that moved)"]
+        for r in c["retrace_events"]:
+            tag = "UNEXPECTED " if r["unexpected"] else ""
+            lines.append(f"  {tag}{r['fn']} ({r['ms']:.3f} ms): "
+                         f"{r['diff'] or '(no diff recorded)'}")
+    return "\n".join(lines)
+
+
 def main(argv: Sequence[str]) -> int:
     args = list(argv)
     tails = "--tails" in args
@@ -347,9 +442,12 @@ def main(argv: Sequence[str]) -> int:
     bound = "--bound" in args
     if bound:
         args.remove("--bound")
+    compile_ = "--compile" in args
+    if compile_:
+        args.remove("--compile")
     if len(args) != 2 or args[0] != "report":
         print("usage: python -m sparkdl_tpu.obs report [--tails] "
-              "[--bound] <trace.json>")
+              "[--bound] [--compile] <trace.json>")
         return 2
     try:
         events = load_events(args[1])
@@ -364,4 +462,8 @@ def main(argv: Sequence[str]) -> int:
     if bound:
         print()
         print(summarize_bound(events))
+    if compile_:
+        print()
+        print("compile forensics (retrace attribution)")
+        print(summarize_compile(events))
     return 0

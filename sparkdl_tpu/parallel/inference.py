@@ -220,8 +220,12 @@ class ShardedBatchRunner:
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=sink.transfer_wait)
+        from sparkdl_tpu.obs.compile_log import compile_log
         record_run_feeds(self.model_fn, inputs, elapsed,
-                         sink.transfer_wait)
+                         sink.transfer_wait, batches=batches,
+                         flops_per_batch=(
+                             getattr(fn, "last_flops", None)
+                             if compile_log().armed else None))
         # autotune apply point (runtime/runner.py precedent): knobs
         # move between runs only; disarmed this is one armed-check
         from sparkdl_tpu.autotune.core import poll as autotune_poll

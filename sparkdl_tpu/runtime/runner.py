@@ -94,6 +94,7 @@ import numpy as np
 from sparkdl_tpu.autotune.core import poll as autotune_poll
 from sparkdl_tpu.graph.function import ModelFunction
 from sparkdl_tpu.obs import default_registry, span, timed_device_get
+from sparkdl_tpu.obs.compile_log import compile_log
 from sparkdl_tpu.obs.ledger import ledger_poll
 from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
@@ -654,17 +655,26 @@ def start_device_prefetch(chunk: Dict[str, np.ndarray], sharding=None
 
 def record_run_feeds(model_fn: ModelFunction,
                      inputs: Dict[str, np.ndarray],
-                     elapsed_s: float, wait_s: float) -> None:
+                     elapsed_s: float, wait_s: float,
+                     batches: int = 0,
+                     flops_per_batch: Optional[float] = None) -> None:
     """Feed the utilization ledger's compute/link lanes
     (obs/ledger.py) from one completed ``run()``: dispatch+drain wall
     as device-run busy time, the drain waits as link-wait time, and —
     device backends only (host models ship nothing) — the input bytes
-    handed to device dispatch. Monotonic counters, shared by
-    BatchRunner and ShardedBatchRunner so both runners' traffic lands
-    in the same roofline."""
+    handed to device dispatch. When the compile log recorded the
+    program's ``cost_analysis()`` FLOPs (obs/compile_log.py), the
+    executed FLOPs also accumulate — the ledger's compute lane then
+    divides by a model-specific ceiling instead of a generic busy
+    fraction (``compute_basis`` names which). Monotonic counters,
+    shared by BatchRunner and ShardedBatchRunner so both runners'
+    traffic lands in the same roofline."""
     reg = default_registry()
     reg.counter("device.run_seconds").add(elapsed_s)
     reg.counter("ship.transfer_wait_seconds_total").add(wait_s)
+    if flops_per_batch and batches:
+        reg.counter("device.flops_total").add(
+            float(flops_per_batch) * batches)
     if model_fn.backend != "host":
         # getattr: array-likes without nbytes (exotic duck-typed
         # inputs) ship unknown bytes — an under-count, never a crash
@@ -838,17 +848,28 @@ class BatchRunner:
         # read below must see the same value or a mid-run shrink would
         # cut chunks on a stale stride and skip rows
         batch_size = self.batch_size
+        flops = None
         if self.model_fn.backend == "host":
             out, wait = self._run_host(inputs, n, batch_size)
         else:
             out, wait = self._run_device(inputs, n, counters,
                                          batch_size, phases)
+            # the compiled program's FLOPs, when the compile log
+            # recorded them (obs/compile_log.py) — the ledger's
+            # model-specific compute feed. Armed-gated: a disarmed
+            # run's dispatches refresh nothing, so a stale number
+            # from an earlier armed phase must not be credited
+            if compile_log().armed:
+                flops = getattr(self.model_fn.jitted(), "last_flops",
+                                None)
+        batches = -(-n // batch_size)
         elapsed = time.perf_counter() - t0
-        self.metrics.add(n, -(-n // batch_size), elapsed,
+        self.metrics.add(n, batches, elapsed,
                          bytes_staged=counters.bytes_staged,
                          bytes_copied=counters.bytes_copied,
                          transfer_wait_seconds=wait)
-        record_run_feeds(self.model_fn, inputs, elapsed, wait)
+        record_run_feeds(self.model_fn, inputs, elapsed, wait,
+                         batches=batches, flops_per_batch=flops)
         # the autotune controller's apply point: knobs only ever move
         # BETWEEN runs, on the thread that just finished one (a single
         # armed-check when the controller is disarmed)
@@ -960,7 +981,14 @@ def warmup_runner(runner) -> bool:
     padded to ``preferred_chunk``), so one zeros run covers it. Returns
     False without running for host backends (no jit to warm) and for
     signatures with unknown (None) dims, where no concrete warmup batch
-    exists."""
+    exists.
+
+    A successful warmup marks the model's compiled programs STEADY in
+    the process-wide compile log (obs/compile_log.py): from here on
+    any real compile through them counts
+    ``compile.unexpected_retraces`` — the no-first-request-pays-compile
+    guarantee enforced at runtime, not just pinned by trace-count
+    tests."""
     model_fn = runner.model_fn
     if model_fn.backend != "jax":
         return False
@@ -974,4 +1002,6 @@ def warmup_runner(runner) -> bool:
     zeros = {k: np.zeros((n,) + tuple(shape), dtype)
              for k, (shape, dtype) in sig.items()}
     runner.run(zeros)
+    from sparkdl_tpu.obs.compile_log import compile_log
+    compile_log().mark_model_steady(model_fn, reason="warmup_runner")
     return True

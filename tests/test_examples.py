@@ -33,12 +33,23 @@ def test_export_deploy_example_serves_online(capsys):
     bytes behind a ModelServer under concurrent clients — the printed
     serve counters prove the requests really went through the
     micro-batcher (full batches, nothing rejected) rather than a
-    per-request fallback path."""
+    per-request fallback path. The compile log (obs/compile_log.py)
+    additionally pins the warm-start contract: the served program
+    compiles EXACTLY once (during warmup, never on a request), and
+    the example measures first-request latency with vs without
+    warmup() — ROADMAP item 4's AOT warm-start case, as a number."""
     runpy.run_path("examples/export_deploy.py", run_name="__main__")
     out = capsys.readouterr().out
     assert "serve: 12 concurrent requests" in out, out
     assert "micro-batches" in out and "fill" in out, out
     assert "rejections 0" in out, out
+    # the zero-retrace pin: exactly one compile for the served path
+    # (the example asserts the compile-log counts internally; this
+    # pins the printed contract line)
+    assert "served-path compiles 1 (exactly once" in out, out
+    assert "first-request latency:" in out, out
+    assert "cold (compile on the hot path)" in out, out
+    assert "after warmup()" in out, out
 
 
 def test_migration_guide_api_claims():

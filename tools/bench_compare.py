@@ -51,8 +51,11 @@ from typing import List, Optional
 #: circuit state on whether the round armed a fault drill, and the
 #: bound block's window/ceilings on what the ledger measured and
 #: which probe produced the ceilings that round
+#: ... and the compile block's per-function table on which programs
+#: the round actually compiled (obs/compile_log.py)
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
-                "autotune", "tails", "slo", "resilience", "bound"}
+                "autotune", "tails", "slo", "resilience", "bound",
+                "compile"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

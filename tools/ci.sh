@@ -92,6 +92,17 @@
 #      sparkdl_ledger_util_* (with # HELP) on /metricsz, the ledger
 #      section with its history ring on /statusz AND in a flight
 #      bundle; and `report --bound` must read the armed bench trace
+#  16. compile-forensics gate (docs/OBSERVABILITY.md "Compile
+#      forensics", docs/SERVING.md "diagnosing a compile storm"): the
+#      bench smoke's "compile" block must schema-check (armed, ≥1
+#      event, per-function table) with ZERO unexpected retraces on
+#      the clean warmed pass and compute_basis in the ledger verdict;
+#      a warmed serve soak followed by an injected off-ladder shape
+#      must show compile.unexpected_retraces > 0 with the retrace
+#      diff NAMING the changed argument, a flight dump carrying the
+#      attribution, and the /healthz detail flipped — while the soak
+#      before the injection stays at zero; and `report --compile`
+#      must read the drill's exported trace
 #  14. throughput-hazard gate (docs/LINT.md): the seeded fixture for
 #      each of H14 (hot-loop `.item()` host sync, witness chain
 #      printed), H15 (undonated jit call with a dead device-array
@@ -116,7 +127,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/15] native shim build =="
+echo "== [1/16] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -125,13 +136,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/15] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/16] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/15] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/16] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/15] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/16] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -140,7 +151,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/15] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/16] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -220,7 +231,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/15] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/16] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -259,11 +270,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/15] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/16] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/15] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/16] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -358,7 +369,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/15] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/16] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -468,7 +479,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/15] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/16] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -607,11 +618,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/15] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/16] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/15] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/16] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -676,7 +687,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/15] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/16] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -774,7 +785,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/15] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/16] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -866,7 +877,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/15] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/16] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -993,7 +1004,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/15] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/16] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1112,5 +1123,141 @@ python -m sparkdl_tpu.obs report --bound \
   /tmp/sparkdl_obs_bench_trace.json | tee /tmp/sparkdl_bound_report.txt
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
+
+echo "== [16/16] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+# (a) the bench smoke's "compile" block (step 4's result file): the
+# compile log was armed for the whole run, saw every jit compile, and
+# the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
+# verdict carries compute_basis (the model-specific compute ceiling's
+# link_basis mirror) and the headline carries the verdict
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_bench_smoke.json") as f:
+    d = json.load(f)
+c = d["compile"]
+for k in ("armed", "events", "retained", "dropped", "retraces",
+          "unexpected_retraces", "steady_models", "functions",
+          "wall_seconds_total", "last_event"):
+    assert k in c, f"compile block missing {k!r}: {sorted(c)}"
+assert c["armed"] is True, c
+assert c["events"] >= 1, c
+assert c["unexpected_retraces"] == 0, \
+    f"clean warmed bench pass recorded unexpected retraces: {c}"
+assert isinstance(c["functions"], dict) and c["functions"], c
+for name, e in c["functions"].items():
+    for k in ("kind", "compiles", "retraces", "unexpected", "wall_s",
+              "steady"):
+        assert k in e, (name, e)
+# the serve pass warmed its model — at least one steady program
+assert c["steady_models"], c
+assert any(e["steady"] for e in c["functions"].values()), \
+    c["functions"]
+assert "compute_basis" in d["bound"], sorted(d["bound"])
+assert "device_gflops_ceiling" in d, sorted(d)
+with open("/tmp/sparkdl_bench_smoke_stdout.txt") as f:
+    head = json.loads(f.read().strip().splitlines()[-1])
+assert head.get("compiles", 0) >= 1, head
+assert head.get("unexpected_retraces") == 0, head
+print(json.dumps({"compile_block_gate": "ok",
+                  "compiles": c["events"],
+                  "wall_s": c["wall_seconds_total"],
+                  "compute_basis": d["bound"]["compute_basis"]}))
+EOF
+# (b) the enforcement drill: a warmed serve soak must stay at ZERO
+# unexpected retraces; an injected off-ladder shape must then show
+# compile.unexpected_retraces > 0 with the diff naming the changed
+# argument, a flight dump carrying the attribution, and the /healthz
+# detail flipped — and the drill's armed trace feeds the CLI smoke
+SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
+import json
+import re
+import urllib.request
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry, flight, start_telemetry
+from sparkdl_tpu.obs.compile_log import compile_log
+from sparkdl_tpu.obs.trace import tracer
+from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+clog = compile_log()
+clog.arm()
+tracer().arm()
+flight.recorder().arm()
+reg = default_registry()
+
+mf = ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                              input_shape=(4,), name="ci_drill")
+server = ModelServer(ServeConfig(max_wait_s=0.01))
+session = server.register("drill", mf, batch_size=8)
+warmed = server.warmup()
+assert warmed == {"drill": True}, warmed
+base = reg.counter("compile.unexpected_retraces").value
+
+# the steady-state soak: warmed-shape traffic compiles NOTHING
+x = np.ones((4, 4), np.float32)
+for _ in range(8):
+    server.submit({"input": x}).result(timeout=60)
+assert reg.counter("compile.unexpected_retraces").value == base, \
+    "clean warmed soak must report zero unexpected retraces"
+
+# the injection: the runner's device batch moved off the warmed shape
+dumps_before = flight.recorder().dumps
+session.runner.batch_size = 6
+server.submit({"input": np.ones((8, 4), np.float32)}
+              ).result(timeout=60)
+server.close()
+assert reg.counter("compile.unexpected_retraces").value > base, \
+    "injected off-ladder shape did not count an unexpected retrace"
+ev = [e for e in clog.events() if e.unexpected][-1]
+assert ev.diff and "inputs.input" in ev.diff, ev.diff
+assert "float32[8,4]" in ev.diff and "float32[6,4]" in ev.diff, \
+    ev.diff
+
+# the flight dump fired with the attribution aboard
+assert flight.recorder().dumps == dumps_before + 1, \
+    (flight.recorder().dumps, dumps_before)
+with open(flight.recorder().last_dump_path) as f:
+    bundle = json.load(f)
+assert "unexpected retrace" in bundle["reason"], bundle["reason"]
+assert bundle["compile"]["unexpected_retraces"] >= 1
+assert any(r.get("unexpected") and r.get("diff")
+           for r in bundle["compile"]["recent"]), bundle["compile"]
+
+# /healthz detail flips (status stays the watchdog's), /statusz and
+# /metricsz carry the compile + hbm surfaces
+tel = start_telemetry()
+with urllib.request.urlopen(tel.url("/healthz"), timeout=5) as r:
+    hz = json.load(r)
+assert hz["unexpected_retraces"] >= 1, hz
+assert hz["compile_steady"] is False, hz
+with urllib.request.urlopen(tel.url("/statusz"), timeout=5) as r:
+    st = json.load(r)
+assert st["compile"]["unexpected_retraces"] >= 1, st["compile"]
+assert "ci_drill.jitted" in st["compile"]["functions"], \
+    sorted(st["compile"]["functions"])
+with urllib.request.urlopen(tel.url("/metricsz"), timeout=5) as r:
+    body = r.read().decode()
+assert re.search(r"^sparkdl_compile_unexpected_retraces ", body,
+                 re.M), body[:400]
+assert re.search(r"^# HELP sparkdl_compile_unexpected_retraces ",
+                 body, re.M)
+assert re.search(r"^sparkdl_hbm_devices_reporting ", body, re.M), \
+    "hbm accounting missing from /metricsz"
+tel.close()
+
+tracer().export("/tmp/sparkdl_ci_compile_trace.json")
+print(json.dumps({"retrace_drill": "ok", "diff": ev.diff[:120],
+                  "bundle": flight.recorder().last_dump_path}))
+EOF
+# (c) the offline CLI reads the drill's trace: compile counts per
+# function + the retrace diffs, the UNEXPECTED one flagged
+python -m sparkdl_tpu.obs report --compile \
+  /tmp/sparkdl_ci_compile_trace.json | tee /tmp/sparkdl_compile_report.txt
+grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
+grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
+grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
 echo "== ci.sh: ALL GREEN =="
