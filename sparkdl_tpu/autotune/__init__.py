@@ -16,7 +16,11 @@ runtime through attachable targets
   (``ModelSession.max_wait_s``): shrunk when batch fill saturates,
   grown when fill is poor and p99 headroom exists;
 * ``RechunkTarget`` — the device batch / engine re-chunk hint, moved
-  only along a pre-warmed shape ladder (zero cold retraces).
+  only along a pre-warmed shape ladder (zero cold retraces);
+* ``PipelineTarget`` — the parallel host pipeline's worker count and
+  read-ahead window (``data/pipeline.py``): deepened (trial-gated)
+  while the live roofline says the decode lane binds, shed on memory
+  pressure.
 
 Armed by ``SPARKDL_TPU_AUTOTUNE=1`` or ``controller().arm()``;
 disarmed, the hot-path :func:`poll` hook is a single armed-check (the
@@ -35,6 +39,7 @@ from sparkdl_tpu.autotune.core import (
     poll,
 )
 from sparkdl_tpu.autotune.targets import (
+    PipelineTarget,
     RechunkTarget,
     RunnerTarget,
     ServeTarget,
@@ -43,6 +48,7 @@ from sparkdl_tpu.autotune.targets import (
 __all__ = [
     "AutotuneController",
     "Knob",
+    "PipelineTarget",
     "Proposal",
     "RechunkTarget",
     "RunnerTarget",

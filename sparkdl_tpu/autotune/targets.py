@@ -51,8 +51,23 @@ class _TrialMixin:
     min_gain = 0.02
     #: controller steps a knob rests after a reverted trial
     freeze_steps = 64
+    #: how stale a ledger window may be and still count as a prior
+    #: (in multiples of the ledger's own window length) — a verdict
+    #: from minutes ago describes a different pipeline
+    ledger_prior_max_windows = 10.0
 
     _trial: Optional[tuple] = None
+
+    def _ledger_prior(self) -> Optional[str]:
+        """The live roofline's ``bound_by`` verdict as a measured
+        prior (obs/ledger.py — READ-only: targets never tick or write
+        the ledger). ``None`` when no fresh window exists, so
+        processes that never ran the ledger tune exactly as
+        before."""
+        from sparkdl_tpu.obs.ledger import ledger
+        led = ledger()
+        return led.last_bound(
+            max_age_s=self.ledger_prior_max_windows * led.window_s)
 
     def _start_trial(self, knob: Knob, proposed, tput: float,
                      reason: str, out: List[Proposal]) -> None:
@@ -120,11 +135,6 @@ class RunnerTarget(_TrialMixin):
     #: which the overlap is deepened
     raise_wait_frac = 0.15
 
-    #: how stale a ledger window may be and still count as a prior
-    #: (in multiples of the ledger's own window length) — a verdict
-    #: from minutes ago describes a different pipeline
-    ledger_prior_max_windows = 10.0
-
     def __init__(self, runner, name: Optional[str] = None,
                  max_inflight_cap: int = 32,
                  max_prefetch_depth: int = 8,
@@ -147,16 +157,6 @@ class RunnerTarget(_TrialMixin):
 
     def knobs(self) -> List[Knob]:
         return [self._inflight, self._depth]
-
-    def _ledger_prior(self) -> Optional[str]:
-        """The live roofline's ``bound_by`` verdict as a measured
-        prior (obs/ledger.py — READ-only: this target never ticks or
-        writes the ledger). ``None`` when no fresh window exists, so
-        processes that never ran the ledger tune exactly as before."""
-        from sparkdl_tpu.obs.ledger import ledger
-        led = ledger()
-        return led.last_bound(
-            max_age_s=self.ledger_prior_max_windows * led.window_s)
 
     def _window(self) -> Optional[tuple]:
         """(rows/s, wait_frac, placement degrades) over the window
@@ -232,6 +232,122 @@ class RunnerTarget(_TrialMixin):
     def describe(self) -> dict:
         return {"name": self.name, "kind": "runner",
                 "strategy": getattr(self.runner, "strategy", None),
+                "trial_open": self._trial is not None,
+                "ledger_prior": self._ledger_prior(),
+                "knobs": [k.describe() for k in self.knobs()]}
+
+
+class PipelineTarget(_TrialMixin):
+    """Tunes a :class:`~sparkdl_tpu.data.engine.LocalEngine`'s
+    parallel host pipeline (``data/pipeline.py``):
+    ``pipeline_workers`` (the decode worker pool) and
+    ``pipeline_read_ahead`` (the ordered re-merge's look-ahead
+    window).
+
+    Deepening is **trial-gated and prior-vetoed in the raising
+    direction**: the pool only helps while the DECODE lane binds, so a
+    worker (then read-ahead) step up is proposed only when the live
+    roofline's latest window says ``bound_by == "decode"``
+    (obs/ledger.py — read-only, the RunnerTarget precedent) and is
+    kept only if the next window's merged rows per pooled-stream-active
+    second pays ``min_gain``;
+    otherwise it reverts and the knob freezes for the epoch. With no
+    fresh ledger window there is no evidence a deeper pool can pay —
+    the target proposes nothing rather than exploring blind (workers
+    are processes; idle ones are not free the way idle queue slots
+    are).
+
+    Shedding is signal-shaped: a ``memory_pressure`` hook (the
+    RunnerTarget shape — e.g. a host-RSS check) reclaims read-ahead
+    first (each look-ahead slot parks one decoded fragment), then
+    workers. Knob writes are single int attribute stores the engine
+    re-reads at its next ``execute()``/submission wave — shape-safe,
+    lock-free, watchdog-safe (the repo-wide apply discipline); worker
+    count 1 means serial (the pool disengages entirely)."""
+
+    def __init__(self, engine, name: Optional[str] = None,
+                 max_workers: Optional[int] = None,
+                 max_read_ahead: int = 16,
+                 memory_pressure=None):
+        import os
+        self.engine = engine
+        self.name = name or f"pipeline{next(_SEQ)}"
+        self.memory_pressure = memory_pressure
+        cap = int(max_workers if max_workers is not None
+                  else max(2, os.cpu_count() or 2))
+        self._workers = Knob(
+            "pipeline_workers",
+            get=lambda: int(engine.pipeline_workers),
+            set=lambda v: setattr(engine, "pipeline_workers", int(v)),
+            lo=1, hi=cap)
+        self._read_ahead = Knob(
+            "pipeline_read_ahead",
+            get=lambda: int(engine.pipeline_read_ahead),
+            set=lambda v: setattr(engine, "pipeline_read_ahead",
+                                  int(v)),
+            lo=1, hi=int(max_read_ahead))
+        self._prev: Optional[tuple] = None
+
+    def knobs(self) -> List[Knob]:
+        return [self._workers, self._read_ahead]
+
+    def _window(self) -> Optional[float]:
+        """Merged rows per pooled-stream-ACTIVE second over the window
+        since the last call — ``pipeline.rows`` over
+        ``pipeline.stream_seconds``, both fed by the ordered re-merge
+        (the RunnerTarget active-seconds precedent: wall-clock idle
+        between executes must not deflate a trial's evaluation and
+        spuriously revert-freeze a good step). None when no pooled
+        stream finished in the window."""
+        reg = default_registry()
+        rows = reg.counter("pipeline.rows").value
+        active = reg.counter("pipeline.stream_seconds").value
+        prev, self._prev = self._prev, (rows, active)
+        if prev is None:
+            return None
+        drows = rows - prev[0]
+        dsec = active - prev[1]
+        if drows <= 0 or dsec <= 0:
+            return None
+        return drows / dsec
+
+    def propose(self, warming: bool) -> List[Proposal]:
+        tput = self._window()
+        out: List[Proposal] = []
+        if tput is None or warming:
+            return out
+        if self._eval_trial(tput, out):
+            return out
+        if self.memory_pressure is not None and self.memory_pressure():
+            # reclaim look-ahead fragments first, then whole workers
+            if self._read_ahead.value > self._read_ahead.lo:
+                out.append(Proposal(self._read_ahead,
+                                    self._read_ahead.value - 1,
+                                    "memory pressure"))
+            elif self._workers.value > self._workers.lo:
+                out.append(Proposal(self._workers,
+                                    self._workers.value - 1,
+                                    "memory pressure"))
+            return out
+        if self._ledger_prior() != "decode":
+            # the decode lane is not the wall right now: a deeper host
+            # pool cannot move the pipeline, and the trial would burn
+            # a freeze epoch learning that
+            return out
+        reason = "ledger prior: decode lane binds; deepen host pipeline"
+        if self._workers.usable() \
+                and self._workers.value < self._workers.hi:
+            self._start_trial(self._workers, self._workers.value + 1,
+                              tput, reason, out)
+        elif self._read_ahead.usable() \
+                and self._read_ahead.value < self._read_ahead.hi:
+            self._start_trial(self._read_ahead,
+                              self._read_ahead.value + 1, tput,
+                              reason + " (read-ahead)", out)
+        return out
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": "pipeline",
                 "trial_open": self._trial is not None,
                 "ledger_prior": self._ledger_prior(),
                 "knobs": [k.describe() for k in self.knobs()]}

@@ -18,6 +18,17 @@ padding it. TensorFrames never had this problem (its blocks were
 whatever size the partition was); static-shape XLA makes batch
 alignment the engine's job rather than the user's.
 
+With ``pipeline_workers >= 2`` (ctor arg or
+``SPARKDL_TPU_PIPELINE_WORKERS``; typos degrade to serial) the host
+prefix instead runs on the parallel host pipeline
+(``data/pipeline.py``): a process pool (thread fallback where the plan
+is not pickle-safe) executes source load + decode per partition, hands
+fragments back through shared-memory Arrow buffers, and an ordered
+bounded re-merge feeds the same consumer-thread re-chunk/ship path —
+decode then OVERLAPS ship/dispatch instead of serializing with it
+(ROADMAP item 3, the tf.data shape; docs/PERFORMANCE.md "Parallel
+host pipeline").
+
 A Spark/mapInArrow binding can replace this class behind the same
 ``execute(sources, plan)`` contract when pyspark is available (there,
 one partition per task — the hint is advisory; see spark_binding).
@@ -122,8 +133,31 @@ class LocalEngine:
                  max_inflight: Optional[int] = None,
                  max_retries: int = 2,
                  stage_metrics=None,
-                 retryable_exceptions: Optional[Tuple[type, ...]] = None):
+                 retryable_exceptions: Optional[Tuple[type, ...]] = None,
+                 pipeline_workers: Optional[int] = None,
+                 pipeline_read_ahead: Optional[int] = None,
+                 pipeline_mode: Optional[str] = None):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
+        # the parallel host pipeline (data/pipeline.py): >= 2 resolved
+        # workers select the pooled streaming mode per execute() —
+        # source load + the host-stage prefix run on N pool workers
+        # with an ordered bounded re-merge, so decode overlaps
+        # ship/dispatch instead of serializing with it. 0/1 (and env
+        # typos) = the serial path below, unchanged. Both knobs are
+        # plain int attributes re-read at each execute()/wave — the
+        # autotune controller's PipelineTarget moves them live
+        # (single attribute stores, the repo-wide apply discipline).
+        from sparkdl_tpu.data.pipeline import (
+            resolve_mode,
+            resolve_read_ahead,
+            resolve_workers,
+        )
+        self.pipeline_workers = resolve_workers(pipeline_workers)
+        self.pipeline_read_ahead = resolve_read_ahead(
+            pipeline_read_ahead, self.pipeline_workers)
+        self.pipeline_mode = resolve_mode(pipeline_mode)
+        self._pipeline = None           # lazily-built HostPipeline
+        self._pipeline_lock = threading.Lock()
         # Enough in-flight partitions to keep workers busy while the
         # consumer drains in order. A falsy sentinel (0/None) is NOT an
         # explicit window: treating 0 as explicit would disable the
@@ -174,6 +208,12 @@ class LocalEngine:
         state = self.__dict__.copy()
         del state["_pool"]
         del state["_device_lock"]
+        # the host-pipeline pool follows the same H3 contract: pools
+        # and their lock drop on the wire; the pipeline_workers /
+        # read_ahead / mode CONFIG travels, so a shipped engine
+        # rebuilds an equivalent pool on first pooled execute
+        del state["_pipeline"]
+        del state["_pipeline_lock"]
         return state
 
     def __setstate__(self, state):
@@ -182,6 +222,8 @@ class LocalEngine:
             max_workers=self.num_workers,
             thread_name_prefix="sparkdl-tpu-host")
         self._device_lock = threading.Lock()
+        self._pipeline = None
+        self._pipeline_lock = threading.Lock()
 
     def _run_stage(self, stage, batch, index, timings) -> pa.RecordBatch:
         # fault-injection site (resilience/faults.py; disarmed: one
@@ -278,6 +320,15 @@ class LocalEngine:
         if not sources:
             return iter(())
         plan = list(plan)
+        if int(self.pipeline_workers or 0) >= 2:
+            # the parallel host pipeline (data/pipeline.py): the
+            # source-load + host-stage prefix runs on N pool workers
+            # with an ordered bounded re-merge; returns None when the
+            # pool degrades to serial (1-core auto mode, config typo)
+            # and the unchanged path below takes over
+            pipelined = self._execute_pipelined(sources, plan)
+            if pipelined is not None:
+                return pipelined
         split = next((i for i, st in enumerate(plan)
                       if self._rechunkable(st)), None)
         if split is None:
@@ -312,6 +363,47 @@ class LocalEngine:
                 # host stages downstream of the device stage keep pool
                 # parallelism (ordered futures) so device dispatch never
                 # waits on host post-processing
+                stream = self._stream_pooled(stream, stage)
+        return (b for _, b in stream)
+
+    def _host_pipeline(self):
+        from sparkdl_tpu.data.pipeline import HostPipeline
+        with self._pipeline_lock:
+            if self._pipeline is None:
+                self._pipeline = HostPipeline(mode=self.pipeline_mode)
+            return self._pipeline
+
+    def _execute_pipelined(self, sources: Sequence, plan: Sequence
+                           ) -> Optional[Iterator[pa.RecordBatch]]:
+        """The pooled streaming mode (data/pipeline.py): the plan's
+        host prefix — everything before the FIRST device stage — runs
+        per-partition on the worker pool; the ordered fragment stream
+        then flows through the same consumer-thread stage machinery as
+        the serial path (re-chunkable device stages get hint-aligned
+        blocks, downstream host stages keep thread-pool parallelism).
+        Returns None when the pool resolves to serial — the caller
+        falls through to the unchanged single-stream path."""
+        from sparkdl_tpu.data import pipeline as host_pipeline
+        workers = host_pipeline.effective_workers(
+            int(self.pipeline_workers), self.pipeline_mode)
+        if workers < 2:
+            return None
+        dsplit = next((i for i, st in enumerate(plan)
+                       if st.kind == "device"), len(plan))
+        stream = self._host_pipeline().stream(
+            sources, plan[:dsplit], self, workers)
+        hints = [int(st.batch_hint) for st in plan[dsplit:]
+                 if self._rechunkable(st)]
+        for stage in plan[dsplit:]:
+            if self._rechunkable(stage):
+                # no adaptive inflight widening here: the pipeline's
+                # read_ahead knob IS the pooled look-ahead window (an
+                # autotuner knob, not a heuristic)
+                stream = self._stream_rechunk(stream, stage,
+                                              max_hint=max(hints))
+            elif stage.kind == "device":
+                stream = self._stream_plain(stream, stage)
+            else:
                 stream = self._stream_pooled(stream, stage)
         return (b for _, b in stream)
 
@@ -550,6 +642,10 @@ class LocalEngine:
 
     def shutdown(self):
         self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._pipeline_lock:
+            pipeline, self._pipeline = self._pipeline, None
+        if pipeline is not None:
+            pipeline.shutdown()
 
 
 _default: Optional[LocalEngine] = None

@@ -79,6 +79,9 @@ HELP_BY_PREFIX = (
                       "StageMetrics (utils/profiling.py)"),
     ("engine.", "host execution engine: stage busy time and retries "
                 "(data/engine.py)"),
+    ("pipeline.", "parallel host pipeline: pooled decode workers, "
+                  "ordered re-merge, shared-memory hand-off "
+                  "(data/pipeline.py)"),
     ("device.", "device-side accounting observed from the host "
                 "(runtime/runner.py)"),
     ("serve.", "online serving front-end: admission, micro-batching, "
@@ -367,6 +370,11 @@ class TelemetryServer:
             # bounded history ring (obs/ledger.py) — literally the
             # same renderer the flight bundle uses
             "ledger": _flight.ledger_state(),
+            # the parallel host pipeline's live worker/read-ahead/mode
+            # picture (data/pipeline.py) — same shape as the flight
+            # bundle's section, so a curl and a postmortem never
+            # disagree
+            "pipeline": _flight.pipeline_state(),
             # compile forensics (obs/compile_log.py): per-function
             # compile counts, retrace attribution, the steady-state
             # zero-retrace verdict — same shape as the flight

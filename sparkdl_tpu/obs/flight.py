@@ -245,6 +245,19 @@ def compile_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def pipeline_state() -> dict:
+    """The parallel host pipeline's live state — resolved
+    mode/workers/read-ahead plus the ``pipeline.*`` counters
+    (data/pipeline.py) — ONE shape shared by the flight bundle,
+    ``/statusz``, and bench's ``pipeline_overlap`` block; degrades
+    like every probe."""
+    try:
+        from sparkdl_tpu.data.pipeline import state
+        return state()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -368,6 +381,7 @@ class FlightRecorder:
             "autotune": _autotune_state(),
             "compile": compile_state(),
             "ledger": ledger_state(),
+            "pipeline": pipeline_state(),
             "slo": _slo_state(),
             "requests": _request_state(),
             "resilience": resilience_state(),

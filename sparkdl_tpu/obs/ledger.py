@@ -408,9 +408,16 @@ class UtilizationLedger:
     def baseline(self, now: Optional[float] = None) -> None:
         """Reset the window baseline to the current feed totals —
         bench.py calls this right before its measured pass so the
-        first tick covers exactly that pass."""
+        first tick covers exactly that pass. Also drains the host
+        pipeline's pooled-worker window peak (data/pipeline.py): a
+        pooled experiment that finished BEFORE this baseline must not
+        leak its worker count into the next window's decode ceiling
+        (a serial decode-saturated pass divided by stale workers
+        under-reads, and the decode-bound prior never fires)."""
         now = time.perf_counter() if now is None else now
         cur = self._read_feeds()
+        from sparkdl_tpu.data.pipeline import consume_workers_peak
+        consume_workers_peak()
         with self._lock:
             self._last_t, self._last = now, cur
 
@@ -445,8 +452,21 @@ class UtilizationLedger:
         deltas = {k: cur.get(k, 0.0) - last.get(k, 0.0) for k in cur}
         resets = sum(1 for v in deltas.values() if v < 0)
         deltas = {k: max(0.0, v) for k, v in deltas.items()}
-        util, link_basis, compute_basis = self._utils(deltas, dt,
-                                                      ceilings)
+        # the decode lane's pooled-worker ceiling (data/pipeline.py):
+        # with N host-pipeline workers live, the lane can earn N busy
+        # seconds per wall second (0/1 = serial, the busy-fraction
+        # ceiling unchanged). The WINDOW PEAK — max(live gauge, max
+        # since the previous tick) — not an instantaneous read: a
+        # pooled stream that ended mid-window already banked its N
+        # busy-seconds, and dividing them by a serial ceiling would
+        # fabricate a saturated decode verdict right as PipelineTarget
+        # reads it as the deepen-workers prior.
+        from sparkdl_tpu.data.pipeline import consume_workers_peak
+        decode_workers = max(
+            default_registry().gauge("pipeline.workers").value,
+            consume_workers_peak())
+        util, link_basis, compute_basis, decode_basis = self._utils(
+            deltas, dt, ceilings, decode_workers)
         verdict = attribute(util)
         window = {
             "t_s": round(now - self._epoch, 3),
@@ -456,6 +476,8 @@ class UtilizationLedger:
             "headroom_pct": verdict["headroom_pct"],
             "link_basis": link_basis,
             "compute_basis": compute_basis,
+            "decode_basis": decode_basis,
+            "decode_workers": max(1, int(decode_workers or 0)),
             "ship_MBps": round(deltas["link_bytes"] / dt / _MB, 3),
             "counter_resets": resets,
         }
@@ -492,20 +514,34 @@ class UtilizationLedger:
 
     @staticmethod
     def _utils(deltas: Dict[str, float], dt: float,
-               ceilings: Dict[str, Any]) -> tuple:
-        """(utilization fractions, link basis, compute basis) for one
-        window. Time lanes are busy fractions of the window wall; the
-        link lane is shipped bytes/s over the probed bandwidth,
-        degrading to the transfer-wait fraction when no probe is
-        available; the compute lane is executed FLOPs/s over the
-        model-calibrated device ceiling (``device_gflops`` in the
+               ceilings: Dict[str, Any],
+               decode_workers: float = 0.0) -> tuple:
+        """(utilization fractions, link basis, compute basis, decode
+        basis) for one window. Time lanes are busy fractions of the
+        window wall; the link lane is shipped bytes/s over the probed
+        bandwidth, degrading to the transfer-wait fraction when no
+        probe is available; the compute lane is executed FLOPs/s over
+        the model-calibrated device ceiling (``device_gflops`` in the
         ceilings — bench injects it from its device-resident pass ×
         the compile log's cost_analysis) when BOTH the ceiling and the
         flops feed exist, degrading to the dispatch+drain busy
         fraction (``compute_basis`` names which — the ``link_basis``
-        mirror)."""
+        mirror). The DECODE lane has the same two-tier shape
+        (``decode_basis``): with N host-pipeline workers live at any
+        point in the window (the window peak of the
+        ``pipeline.workers`` gauge, data/pipeline.py) the ceiling is
+        N busy-seconds per wall second — N workers each fully busy IS
+        the lane's roofline — degrading to the plain busy fraction
+        when the pipeline runs serial."""
         clamp = lambda v: min(1.0, max(0.0, v))  # noqa: E731
         util = {stage: clamp(deltas[stage] / dt) for stage in FEEDS}
+        workers = max(1.0, float(decode_workers or 0.0))
+        if workers > 1.0:
+            util["decode"] = clamp(
+                deltas["decode"] / (dt * workers))
+            decode_basis = "busy/pooled-workers"
+        else:
+            decode_basis = "busy-time"
         bw = ceilings.get("link_h2d_MBps") if ceilings else None
         if isinstance(bw, (int, float)) and bw > 0:
             util["link"] = clamp(
@@ -522,7 +558,7 @@ class UtilizationLedger:
             compute_basis = "flops/model-ceiling"
         else:
             compute_basis = "busy-time"
-        return util, basis, compute_basis
+        return util, basis, compute_basis, decode_basis
 
     def tick_due(self, now: Optional[float] = None
                  ) -> Optional[Dict[str, Any]]:
@@ -580,7 +616,12 @@ class UtilizationLedger:
         dt = max(now - self._epoch, 1e-9)
         totals = self._read_feeds()
         ceilings = self._ceilings or {}
-        util, _basis, _cbasis = self._utils(totals, dt, ceilings)
+        # cumulative totals include any pooled busy-seconds this
+        # process ever banked — divide the decode lane by the
+        # process-lifetime worker high-water, not the serial ceiling
+        from sparkdl_tpu.data.pipeline import alltime_workers_peak
+        util, _basis, _cbasis, _dbasis = self._utils(
+            totals, dt, ceilings, alltime_workers_peak())
         v = attribute(util)
         v["basis"] = "cumulative"
         return v

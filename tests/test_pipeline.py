@@ -1,0 +1,780 @@
+"""Parallel host pipeline (data/pipeline.py): ordered re-merge under
+adversarial scheduling, the shared-memory hand-off, H3 pickle
+discipline, serial degrades, the PipelineTarget autotune knobs, and
+the ledger's per-worker decode basis.
+
+The ISSUE-15 pins: workers completing out of order, a mid-stream
+``LiveBatchHint`` shrink/regrow while fragments are in flight, a
+worker raising (typed error surfaces once, remaining rows drain, the
+engine quiesces), and exact row-identity/order assertions in each
+case.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data import DataFrame, LocalEngine
+from sparkdl_tpu.data import pipeline as host_pipeline
+from sparkdl_tpu.data.frame import LiveBatchHint, Source
+from sparkdl_tpu.obs import default_registry
+
+
+def _ids_df(ids, parts, engine):
+    return DataFrame(
+        DataFrame.from_table(pa.table({"id": ids}), parts)._sources,
+        engine=engine)
+
+
+def _collect_ids(table):
+    return table.column("id").to_numpy(zero_copy_only=False)
+
+
+@pytest.fixture
+def thread_engine():
+    eng = LocalEngine(pipeline_workers=3, pipeline_mode="thread")
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture
+def process_engine():
+    # fork context: pytest's __main__ survives spawn too, but fork is
+    # the cheap deterministic choice for the suite (workers stay off
+    # jax by design — module docstring)
+    os.environ["SPARKDL_TPU_PIPELINE_MPCTX"] = "fork"
+    eng = LocalEngine(pipeline_workers=2, pipeline_mode="process")
+    yield eng
+    eng.shutdown()
+    os.environ.pop("SPARKDL_TPU_PIPELINE_MPCTX", None)
+
+
+# ---------------------------------------------------------------------------
+# config resolution + degrades
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_env_typo_degrades_to_serial(self, monkeypatch):
+        monkeypatch.setenv(host_pipeline.ENV_WORKERS, "banana")
+        before = default_registry().counter(
+            "pipeline.config_errors").value
+        assert host_pipeline.resolve_workers(None) == 0
+        assert default_registry().counter(
+            "pipeline.config_errors").value == before + 1
+
+    def test_env_selects_pooled_mode(self, monkeypatch):
+        monkeypatch.setenv(host_pipeline.ENV_WORKERS, "4")
+        eng = LocalEngine()
+        assert eng.pipeline_workers == 4
+        assert eng.pipeline_read_ahead == 8  # 2x workers default
+        eng.shutdown()
+
+    def test_read_ahead_typo_degrades(self, monkeypatch):
+        monkeypatch.setenv(host_pipeline.ENV_READ_AHEAD, "-3")
+        assert host_pipeline.resolve_read_ahead(None, 2) == 4
+
+    def test_one_core_auto_degrades_serial(self, monkeypatch):
+        monkeypatch.setattr(os, "cpu_count", lambda: 1)
+        before = default_registry().counter(
+            "pipeline.degrade_events").value
+        assert host_pipeline.effective_workers(4, "auto") == 0
+        assert default_registry().counter(
+            "pipeline.degrade_events").value == before + 1
+        # explicit modes trust the caller (CI correctness drills)
+        assert host_pipeline.effective_workers(4, "thread") == 4
+        assert host_pipeline.effective_workers(4, "process") == 4
+
+    def test_under_two_workers_is_serial(self):
+        assert host_pipeline.effective_workers(0, "thread") == 0
+        assert host_pipeline.effective_workers(1, "process") == 0
+
+    def test_serial_engine_never_builds_a_pool(self):
+        eng = LocalEngine(pipeline_workers=0)
+        ids = np.arange(20)
+        out = _ids_df(ids, 4, eng).map_batches(lambda b: b).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert eng._pipeline is None
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ordered re-merge under adversarial scheduling
+# ---------------------------------------------------------------------------
+
+class TestOrderedRemerge:
+    def test_out_of_order_completion_stays_ordered(self, thread_engine):
+        """Later partitions finish FIRST (sleeps shrink with index);
+        the reorder buffer must still yield strict partition order
+        with exact row identity."""
+        ids = np.arange(90)
+
+        def slow(batch, idx):
+            time.sleep(0.03 * (8 - idx) / 8)
+            return batch
+
+        out = _ids_df(ids, 9, thread_engine).map_batches(
+            slow, with_index=True, name="slow").collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+
+    def test_process_mode_roundtrip_exact(self, process_engine):
+        ids = np.arange(64)
+        out = _ids_df(ids, 5, process_engine).map_batches(
+            lambda b: b, name="ident").collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+
+    def test_process_mode_shm_handoff_exercised(self, process_engine):
+        """Forcing the shared-memory threshold to 0 routes every
+        fragment through a segment; identity stays exact and the
+        hand-off counters move."""
+        process_engine._host_pipeline().shm_min_bytes = 0
+        reg = default_registry()
+        segs0 = reg.counter("pipeline.shm_segments").value
+        bytes0 = reg.counter("pipeline.handoff_bytes").value
+        ids = np.arange(48)
+        out = _ids_df(ids, 4, process_engine).map_batches(
+            lambda b: b).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert reg.counter("pipeline.shm_segments").value == segs0 + 4
+        assert reg.counter("pipeline.handoff_bytes").value > bytes0
+
+    def test_small_fragments_ride_the_pipe(self, process_engine):
+        process_engine._host_pipeline().shm_min_bytes = 1 << 30
+        reg = default_registry()
+        segs0 = reg.counter("pipeline.shm_segments").value
+        ids = np.arange(30)
+        out = _ids_df(ids, 3, process_engine).map_batches(
+            lambda b: b).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert reg.counter("pipeline.shm_segments").value == segs0
+
+    def test_with_index_sees_logical_identity(self, thread_engine):
+        """Reordered partitions keep their logical index through the
+        pooled path (the with_index determinism contract)."""
+        seen = {}
+
+        def record(batch, idx):
+            seen[idx] = batch.num_rows
+            return batch
+
+        ids = np.arange(40)
+        df = _ids_df(ids, 4, thread_engine) \
+            .with_partition_order([3, 1, 2, 0]) \
+            .map_batches(record, with_index=True)
+        out = df.collect()
+        assert sorted(seen) == [0, 1, 2, 3]
+        expect = np.concatenate([ids[30:], ids[10:20], ids[20:30],
+                                 ids[:10]])
+        np.testing.assert_array_equal(_collect_ids(out), expect)
+
+    def test_empty_partitions_keep_schema(self, thread_engine):
+        df = DataFrame.from_table(pa.table({"id": np.arange(3)}), 1)
+        empty = DataFrame(
+            [Source(lambda: pa.RecordBatch.from_pylist(
+                [], schema=pa.schema([("id", pa.int64())])), 0)]
+            + df._sources, engine=thread_engine)
+        out = empty.map_batches(lambda b: b).collect()
+        assert out.num_rows == 3
+
+    def test_device_stage_rechunk_through_pooled_prefix(
+            self, thread_engine):
+        """A batch-hinted device stage downstream of the pooled prefix
+        still gets hint-aligned blocks spanning partitions, outputs
+        re-sliced row-exact."""
+        blocks = []
+
+        def dev(batch):
+            blocks.append(batch.num_rows)
+            return batch
+
+        ids = np.arange(50)
+        out = _ids_df(ids, 7, thread_engine).map_batches(
+            dev, kind="device", name="dev", batch_hint=16).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert all(n == 16 for n in blocks[:-1]), blocks
+        assert sum(blocks) == 50
+
+
+class _Chunky:
+    """Duck-typed LiveBatchHint runner stub (the test_autotune
+    idiom)."""
+
+    def __init__(self, n):
+        self.batch_size = n
+
+    @property
+    def preferred_chunk(self):
+        return self.batch_size
+
+
+class TestMidStreamHintThroughPool:
+    def test_hint_shrink_regrow_with_fragments_in_flight(
+            self, thread_engine):
+        """The ISSUE pin: a LiveBatchHint shrink then regrow while
+        pooled fragments are still in flight keeps row identity and
+        order exact, and the cut follows the moved hint."""
+        chunky = _Chunky(8)
+        seen = []
+
+        def dev(batch):
+            seen.append(batch.num_rows)
+            if len(seen) == 1:
+                chunky.batch_size = 4       # shrink mid-stream
+            elif len(seen) == 3:
+                chunky.batch_size = 12      # regrow mid-stream
+            return batch
+
+        def slow(batch, idx):
+            # out-of-order completion underneath the hint changes
+            time.sleep(0.02 * ((idx + 3) % 6) / 6)
+            return batch
+
+        ids = np.arange(64)
+        out = _ids_df(ids, 8, thread_engine) \
+            .map_batches(slow, with_index=True, name="slow") \
+            .map_batches(dev, kind="device", name="dev",
+                         batch_hint=LiveBatchHint(chunky)).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert seen[0] == 8, seen
+        assert any(n == 4 for n in seen[1:]), seen
+        assert sum(seen) == 64
+
+    def test_hint_change_process_mode(self, process_engine):
+        chunky = _Chunky(8)
+        seen = []
+
+        def dev(batch):
+            seen.append(batch.num_rows)
+            if len(seen) == 1:
+                chunky.batch_size = 4
+            return batch
+
+        ids = np.arange(40)
+        out = _ids_df(ids, 5, process_engine).map_batches(
+            dev, kind="device", name="dev",
+            batch_hint=LiveBatchHint(chunky)).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert sum(seen) == 40
+
+
+# ---------------------------------------------------------------------------
+# failure semantics
+# ---------------------------------------------------------------------------
+
+class TestWorkerFailure:
+    def test_typed_error_surfaces_once_thread(self, thread_engine):
+        calls = []
+
+        def boom(batch, idx):
+            calls.append(idx)
+            if idx == 2:
+                raise KeyError("bad column xyz")
+            return batch
+
+        df = _ids_df(np.arange(40), 4, thread_engine).map_batches(
+            boom, with_index=True)
+        with pytest.raises(KeyError, match="bad column xyz"):
+            df.collect()
+
+    def test_typed_error_survives_the_process_wire(self, process_engine):
+        def boom(batch):
+            raise ValueError("decode exploded on purpose")
+
+        df = _ids_df(np.arange(20), 4, process_engine).map_batches(boom)
+        with pytest.raises(ValueError, match="decode exploded"):
+            df.collect()
+
+    def test_engine_quiesces_and_stays_usable_after_error(
+            self, process_engine):
+        def boom(batch):
+            raise ValueError("boom")
+
+        ids = np.arange(30)
+        with pytest.raises(ValueError):
+            _ids_df(ids, 3, process_engine).map_batches(boom).collect()
+        out = _ids_df(ids, 3, process_engine).map_batches(
+            lambda b: b).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+
+    def test_effectful_plan_drains_stragglers(self, thread_engine):
+        """The quiesce discipline: an EFFECTFUL plan's in-flight
+        siblings complete before control returns after an error — a
+        straggler must not produce side effects after the caller's
+        cleanup ran."""
+        done = []
+        started = threading.Semaphore(0)
+        release = threading.Event()
+
+        def effectful(batch, idx):
+            if idx == 0:
+                # raise only once BOTH siblings are genuinely running:
+                # a merely-queued future would be cancelled (itself a
+                # fine quiesce outcome — no effect at all) and the
+                # drain-wait path under test would never exercise
+                started.acquire(timeout=5.0)
+                started.acquire(timeout=5.0)
+                raise ValueError("primary failure")
+            started.release()
+            release.wait(5.0)
+            done.append(idx)
+            return batch
+
+        df = _ids_df(np.arange(30), 3, thread_engine).map_batches(
+            effectful, with_index=True, effectful=True)
+
+        t = threading.Thread(
+            target=lambda: pytest.raises(ValueError, df.collect))
+        t.start()
+        time.sleep(0.1)
+        release.set()
+        t.join(10.0)
+        assert not t.is_alive()
+        # every in-flight sibling drained (read_ahead covered both)
+        assert sorted(done) == [1, 2]
+
+    def test_handoff_error_is_typed_transient(self):
+        """A vanished shm segment must actually reach the parent-side
+        retry: the class docstring promises transient classification,
+        so the type has to carry it (resilience/errors.py)."""
+        from sparkdl_tpu.resilience.errors import (
+            TransientError,
+            is_transient,
+        )
+        assert issubclass(host_pipeline.PipelineHandoffError,
+                          TransientError)
+        assert is_transient(host_pipeline.PipelineHandoffError("gone"))
+
+    def test_transient_worker_failure_retries_parent_side(
+            self, process_engine, tmp_path):
+        """A transient error in a pooled worker re-runs through the
+        engine's shared RetryPolicy (parent-side re-submit) and the
+        partition completes."""
+        from sparkdl_tpu.resilience.errors import TransientError
+
+        marker = tmp_path / "fail_once"
+
+        def flaky(batch, idx):
+            # cross-process once-latch: the file system is the only
+            # state the worker processes share
+            if idx == 1 and not marker.exists():
+                marker.write_text("failed")
+                raise TransientError("transient decode hiccup")
+            return batch
+
+        retries0 = default_registry().counter("engine.retries").value
+        ids = np.arange(30)
+        out = _ids_df(ids, 3, process_engine).map_batches(
+            flaky, with_index=True).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert default_registry().counter(
+            "engine.retries").value > retries0
+
+
+# ---------------------------------------------------------------------------
+# watchdog + obs
+# ---------------------------------------------------------------------------
+
+class TestWatchdogAndObs:
+    def test_stalled_worker_fires_named_stall_and_recovers(
+            self, thread_engine):
+        from sparkdl_tpu.obs.watchdog import watchdog
+
+        wd = watchdog()
+        wd.arm(threshold_s=0.15)
+        reg = default_registry()
+        stalls0 = reg.counter("watchdog.stalls").value
+        try:
+            def wedge(batch, idx):
+                if idx == 1:
+                    time.sleep(0.6)     # > threshold: a stalled worker
+                return batch
+
+            stalled_names = []
+
+            def sample():
+                deadline = time.perf_counter() + 5.0
+                while time.perf_counter() < deadline:
+                    v = wd.verdict()
+                    if v["stalled_sources"]:
+                        stalled_names.extend(v["stalled_sources"])
+                        return
+                    time.sleep(0.02)
+
+            sampler = threading.Thread(target=sample)
+            sampler.start()
+            ids = np.arange(30)
+            out = _ids_df(ids, 3, thread_engine).map_batches(
+                wedge, with_index=True).collect()
+            sampler.join(6.0)
+            np.testing.assert_array_equal(_collect_ids(out), ids)
+            assert reg.counter("watchdog.stalls").value > stalls0
+            # the stall names EXACTLY the wedged partition: queued
+            # siblings are unwatched until they run, finished ones
+            # unwatch at completion — neither can mis-fire
+            assert set(stalled_names) == {"pipeline.decode:1"}, \
+                stalled_names
+            # completion recovers: nothing left active or stalled
+            assert wd.healthy()
+        finally:
+            wd.disarm()
+            wd.arm_from_env()
+
+    def test_pipeline_gauges_and_spans(self, thread_engine):
+        from sparkdl_tpu.obs import tracer
+
+        trc = tracer()
+        trc.arm()
+        try:
+            reg = default_registry()
+            tasks0 = reg.counter("pipeline.tasks").value
+            rows0 = reg.counter("pipeline.rows").value
+            ids = np.arange(40)
+            out = _ids_df(ids, 4, thread_engine).map_batches(
+                lambda b: b).collect()
+            assert out.num_rows == 40
+            assert reg.counter("pipeline.tasks").value == tasks0 + 4
+            assert reg.counter("pipeline.rows").value == rows0 + 40
+            assert reg.gauge("pipeline.inflight_peak").value >= 1
+            # the merged fragments land on the engine lane
+            frags = [s for s in trc.spans()
+                     if s.name == "pipeline.fragment"]
+            assert len(frags) >= 4
+            assert all(s.lane == "engine" for s in frags)
+        finally:
+            trc.disarm()
+            trc.arm_from_env()
+
+    def test_workers_gauge_live_during_stream_and_zero_after(
+            self, thread_engine):
+        reg = default_registry()
+        seen = []
+
+        def probe(batch):
+            seen.append(reg.gauge("pipeline.workers").value)
+            return batch
+
+        _ids_df(np.arange(20), 2, thread_engine).map_batches(
+            probe).collect()
+        assert seen and all(v == 3 for v in seen), seen
+        assert reg.gauge("pipeline.workers").value == 0
+
+    def test_state_rides_statusz_shape(self, thread_engine):
+        _ids_df(np.arange(10), 2, thread_engine).map_batches(
+            lambda b: b).collect()
+        st = host_pipeline.state()
+        for k in ("mode", "workers", "read_ahead", "streams_active",
+                  "counters"):
+            assert k in st, sorted(st)
+        assert st["mode"] == "thread"
+        assert st["workers"] == 3
+        from sparkdl_tpu.obs import flight
+        assert flight.pipeline_state()["mode"] == "thread"
+
+
+# ---------------------------------------------------------------------------
+# H3 pickle discipline
+# ---------------------------------------------------------------------------
+
+class TestPickleDiscipline:
+    def test_engine_cloudpickle_roundtrip_drops_pools(
+            self, process_engine):
+        import cloudpickle
+
+        # warm the pool so there is live state to drop
+        _ids_df(np.arange(20), 2, process_engine).map_batches(
+            lambda b: b).collect()
+        assert process_engine._pipeline is not None
+        clone = cloudpickle.loads(cloudpickle.dumps(process_engine))
+        # config travels ...
+        assert clone.pipeline_workers == 2
+        assert clone.pipeline_read_ahead == \
+            process_engine.pipeline_read_ahead
+        assert clone.pipeline_mode == "process"
+        # ... pools and locks do not (fresh on arrival)
+        assert clone._pipeline is None
+        ids = np.arange(20)
+        out = _ids_df(ids, 2, clone).map_batches(lambda b: b).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        clone.shutdown()
+
+    def test_host_pipeline_pickle_drops_pools(self, thread_engine):
+        import cloudpickle
+
+        _ids_df(np.arange(10), 2, thread_engine).map_batches(
+            lambda b: b).collect()
+        hp = thread_engine._host_pipeline()
+        clone = cloudpickle.loads(cloudpickle.dumps(hp))
+        assert clone.mode == "thread"
+        assert clone._thread_handle is None
+        assert clone._proc_handle is None
+
+    def test_unpicklable_plan_falls_back_to_threads(self):
+        """The H3 fallback: a plan that cannot survive the wire (a
+        closure over a lock) runs on the THREAD pool — counted, not
+        silent, and still ordered-exact."""
+        eng = LocalEngine(pipeline_workers=2, pipeline_mode="process")
+        lock = threading.Lock()
+
+        def locked(batch):
+            with lock:
+                return batch
+
+        reg = default_registry()
+        fb0 = reg.counter("pipeline.fallbacks").value
+        ids = np.arange(30)
+        out = _ids_df(ids, 3, eng).map_batches(locked).collect()
+        np.testing.assert_array_equal(_collect_ids(out), ids)
+        assert reg.counter("pipeline.fallbacks").value == fb0 + 1
+        assert host_pipeline.state()["mode"] == "thread"
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the ledger's per-worker decode basis
+# ---------------------------------------------------------------------------
+
+class TestLedgerDecodeBasis:
+    def test_pooled_workers_raise_the_decode_ceiling(self):
+        from sparkdl_tpu.obs.ledger import UtilizationLedger
+
+        led = UtilizationLedger(window_s=0.01, probe_file="/dev/null")
+        reg = default_registry()
+        reg.gauge("pipeline.workers").set(4)
+        try:
+            led.baseline(now=100.0)
+            # 2 busy-seconds in a 1-second window: serial basis would
+            # clamp to 1.0; the 4-worker ceiling reads 0.5
+            reg.counter("engine.busy_seconds").add(2.0)
+            w = led.tick(now=101.0)
+            assert w is not None
+            assert w["decode_basis"] == "busy/pooled-workers"
+            assert w["decode_workers"] == 4
+            assert abs(w["util"]["decode"] - 0.5) < 1e-6
+        finally:
+            reg.gauge("pipeline.workers").set(0)
+
+    def test_stream_ending_mid_window_keeps_its_pooled_basis(self):
+        """A pooled stream that finished before the tick already
+        banked its N busy-seconds: the window divides by the WINDOW
+        PEAK of the worker gauge, not the instantaneous (now 0) read —
+        otherwise the window fabricates a saturated serial decode
+        verdict right as PipelineTarget reads it as the deepen
+        prior."""
+        from sparkdl_tpu.obs.ledger import UtilizationLedger
+
+        led = UtilizationLedger(window_s=0.01, probe_file="/dev/null")
+        reg = default_registry()
+        host_pipeline.consume_workers_peak()   # drain prior history
+        led.baseline(now=300.0)
+        sid = host_pipeline._enter_stream(4)
+        reg.counter("engine.busy_seconds").add(2.0)
+        host_pipeline._exit_stream(sid)        # gauge back to 0
+        assert reg.gauge("pipeline.workers").value == 0
+        w = led.tick(now=301.0)
+        assert w is not None
+        assert w["decode_basis"] == "busy/pooled-workers"
+        assert w["decode_workers"] == 4
+        assert abs(w["util"]["decode"] - 0.5) < 1e-6
+
+    def test_baseline_drains_stale_worker_history(self):
+        """A pooled experiment that finished BEFORE baseline() must
+        not leak its worker count into the next window: a serial
+        decode-saturated pass divided by stale workers would
+        under-read and hide the decode-bound prior."""
+        from sparkdl_tpu.obs.ledger import UtilizationLedger
+
+        led = UtilizationLedger(window_s=0.01, probe_file="/dev/null")
+        reg = default_registry()
+        sid = host_pipeline._enter_stream(4)
+        host_pipeline._exit_stream(sid)        # history pre-baseline
+        led.baseline(now=400.0)
+        reg.counter("engine.busy_seconds").add(0.9)
+        w = led.tick(now=401.0)
+        assert w is not None
+        assert w["decode_basis"] == "busy-time"
+        assert w["decode_workers"] == 1
+        assert w["util"]["decode"] >= 0.85
+
+    def test_serial_keeps_busy_time_basis(self):
+        from sparkdl_tpu.obs.ledger import UtilizationLedger
+
+        led = UtilizationLedger(window_s=0.01, probe_file="/dev/null")
+        reg = default_registry()
+        reg.gauge("pipeline.workers").set(0)
+        led.baseline(now=200.0)
+        reg.counter("engine.busy_seconds").add(0.5)
+        w = led.tick(now=201.0)
+        assert w is not None
+        assert w["decode_basis"] == "busy-time"
+        assert w["decode_workers"] == 1
+        assert w["util"]["decode"] >= 0.45
+
+
+# ---------------------------------------------------------------------------
+# the PipelineTarget autotune knobs
+# ---------------------------------------------------------------------------
+
+class TestPipelineTarget:
+    def _target(self, engine, **kw):
+        from sparkdl_tpu.autotune import PipelineTarget
+        return PipelineTarget(engine, **kw)
+
+    def _feed(self, rows=100):
+        default_registry().counter("pipeline.rows").add(rows)
+        default_registry().counter("pipeline.stream_seconds").add(1.0)
+
+    def test_knobs_move_engine_attributes(self):
+        eng = LocalEngine(pipeline_workers=2)
+        t = self._target(eng, max_workers=8)
+        workers, read_ahead = t.knobs()
+        workers.set(4)
+        read_ahead.set(6)
+        assert eng.pipeline_workers == 4
+        assert eng.pipeline_read_ahead == 6
+        eng.shutdown()
+
+    def test_deepens_only_on_decode_prior(self, monkeypatch):
+        eng = LocalEngine(pipeline_workers=2)
+        t = self._target(eng, max_workers=8)
+        monkeypatch.setattr(t, "_ledger_prior", lambda: "link")
+        self._feed()
+        assert t.propose(False) == []       # first window = baseline
+        self._feed()
+        assert t.propose(False) == []       # link-bound: vetoed
+        monkeypatch.setattr(t, "_ledger_prior", lambda: "decode")
+        self._feed()
+        props = t.propose(False)
+        assert len(props) == 1
+        assert props[0].knob.name == "pipeline_workers"
+        assert props[0].value == 3
+        eng.shutdown()
+
+    def test_trial_reverts_when_gain_does_not_pay(self, monkeypatch):
+        eng = LocalEngine(pipeline_workers=2)
+        t = self._target(eng, max_workers=8)
+        monkeypatch.setattr(t, "_ledger_prior", lambda: "decode")
+        self._feed(1000)
+        t.propose(False)
+        self._feed(1000)
+        [p] = t.propose(False)
+        p.knob.set(p.value)                 # the controller's apply
+        # the next window does NOT pay min_gain -> revert + freeze
+        self._feed(1000)
+        out = t.propose(False)
+        assert any(pr.force and pr.value == 2 for pr in out), \
+            [(pr.knob.name, pr.value, pr.force) for pr in out]
+        assert t._workers.frozen_for > 0
+        eng.shutdown()
+
+    def test_memory_pressure_sheds_read_ahead_then_workers(self):
+        eng = LocalEngine(pipeline_workers=3, pipeline_read_ahead=4)
+        t = self._target(eng, memory_pressure=lambda: True)
+        self._feed()
+        t.propose(False)
+        self._feed()
+        [p] = t.propose(False)
+        assert p.knob.name == "pipeline_read_ahead"
+        assert p.value == 3
+        eng.pipeline_read_ahead = 1
+        self._feed()
+        [p] = t.propose(False)
+        assert p.knob.name == "pipeline_workers"
+        assert p.value == 2
+        eng.shutdown()
+
+    def test_controller_convergence_zero_oscillations(self, monkeypatch):
+        """The CI convergence shape: an armed controller driving the
+        target over steady traffic settles without a single refused
+        direction flip."""
+        from sparkdl_tpu.autotune.core import AutotuneController
+
+        eng = LocalEngine(pipeline_workers=2)
+        ctl = AutotuneController(interval_s=0.0)
+        ctl.arm(interval_s=0.0)
+        t = self._target(eng, max_workers=4)
+        monkeypatch.setattr(t, "_ledger_prior", lambda: "decode")
+        ctl.attach(t)
+        for _ in range(12):
+            self._feed(500)
+            ctl.step()
+        assert ctl.oscillations == 0
+        assert 1 <= eng.pipeline_workers <= 4
+        assert t._workers.lo <= t._workers.value <= t._workers.hi
+        ctl.reset()
+        eng.shutdown()
+
+    def test_describe_shape(self):
+        eng = LocalEngine(pipeline_workers=2)
+        d = self._target(eng).describe()
+        assert d["kind"] == "pipeline"
+        assert {k["name"] for k in d["knobs"]} == \
+            {"pipeline_workers", "pipeline_read_ahead"}
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# early-stop hygiene
+# ---------------------------------------------------------------------------
+
+class TestLiveResize:
+    def test_resize_mid_stream_keeps_the_old_generation_alive(self):
+        """The autotuner moving ``pipeline_workers`` while a stream is
+        mid-flight must not cancel that stream's queued tasks: the
+        stream pinned its _PoolHandle generation; the resized pool is
+        a NEW generation and the old one only shuts down when its last
+        holder releases it."""
+        eng = LocalEngine(pipeline_workers=2, pipeline_mode="thread",
+                          pipeline_read_ahead=2)
+        try:
+            ids = np.arange(60)
+            it = _ids_df(ids, 6, eng).map_batches(
+                lambda b: b, name="slowish").stream()
+            first = next(it)          # stream A mid-flight, tasks queued
+            hp = eng._host_pipeline()
+            gen_a = hp._thread_handle
+            assert gen_a is not None and gen_a.refs >= 1
+
+            # the knob moves; stream B runs to completion on the NEW
+            # generation while A is still open
+            eng.pipeline_workers = 3
+            out_b = _ids_df(np.arange(30), 3, eng).map_batches(
+                lambda b: b).collect()
+            assert out_b.num_rows == 30
+            assert hp._thread_handle is not gen_a
+            assert gen_a.retired and gen_a.refs >= 1
+
+            # stream A drains its remaining rows intact — nothing was
+            # cancelled out from under it
+            got = [first] + list(it)
+            merged = np.concatenate(
+                [_collect_ids(b) for b in got])
+            np.testing.assert_array_equal(merged, ids)
+            # A's release shut the retired generation down
+            assert gen_a.refs == 0
+        finally:
+            eng.shutdown()
+
+
+class TestEarlyStop:
+    def test_take_abandons_stream_without_leaking_segments(
+            self, process_engine):
+        """take(1) on a pooled frame abandons in-flight fragments;
+        completed-but-unconsumed shared-memory segments must be
+        released (pipeline.fragments_discarded counts them)."""
+        process_engine._host_pipeline().shm_min_bytes = 0
+        ids = np.arange(80)
+        rows = _ids_df(ids, 8, process_engine).map_batches(
+            lambda b: b).take(1)
+        assert rows[0]["id"] == 0
+        # the stream generator closed; give abandoned futures a beat
+        deadline = time.perf_counter() + 5.0
+        reg = default_registry()
+        while time.perf_counter() < deadline:
+            if reg.gauge("pipeline.inflight").value == 0:
+                break
+            time.sleep(0.02)
+        assert reg.gauge("pipeline.inflight").value == 0
+        assert default_registry().gauge("pipeline.workers").value == 0

@@ -52,10 +52,12 @@ from typing import List, Optional
 #: bound block's window/ceilings on what the ledger measured and
 #: which probe produced the ceilings that round
 #: ... and the compile block's per-function table on which programs
-#: the round actually compiled (obs/compile_log.py)
+#: the round actually compiled (obs/compile_log.py), and the
+#: pipeline_overlap block's mode/worker shape on the measuring host's
+#: cores and start-method support (data/pipeline.py)
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
                 "autotune", "tails", "slo", "resilience", "bound",
-                "compile"}
+                "compile", "pipeline_overlap"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

@@ -29,7 +29,11 @@ strategies and ``prefetch_depth`` for prefetch — and emits per-config
 rows/s: the measured priors behind the autotune controller's bounds
 (sparkdl_tpu/autotune, docs/PERFORMANCE.md) on whatever host runs it.
 ``--model/--batch/--rows`` size the sweep (TestNet makes it cheap on
-CPU).
+CPU). ``--sweep --workers 0,2,4`` adds the parallel host pipeline's
+axis (data/pipeline.py): the fused decode→pack pipeline measured
+through a pooled ``LocalEngine`` at each worker count (0 = serial) —
+the measured priors behind ``PipelineTarget``'s worker/read-ahead
+bounds on this host.
 
 Prints one JSON object; run on the real chip (no JAX_PLATFORMS
 override) or CPU. Results feed BatchRunner's strategy choice,
@@ -203,6 +207,55 @@ def _sweep(model: str, batch: int, rows: int,
     return grid
 
 
+def _workers_sweep(counts, n_images: int = 48,
+                   size=(64, 64)) -> list:
+    """The parallel host pipeline's worker axis: a fused
+    decode→resize→pack pipeline (synthesized textured JPEGs, the bench
+    corpus shape) collected through a pooled LocalEngine at each
+    worker count — per-config rows/s, best of 2 passes (pass 1 warms
+    the page cache / builds the shim). 0 = the serial engine; counts
+    above the host's cores still measure (the pool degrades are the
+    point of measuring)."""
+    import shutil
+    import tempfile
+
+    from sparkdl_tpu.data import pipeline as host_pipeline
+    from sparkdl_tpu.data.engine import LocalEngine
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.utils.synth import write_textured_jpegs
+
+    d = tempfile.mkdtemp(prefix="sparkdl_workers_sweep_")
+    grid = []
+    try:
+        write_textured_jpegs(d, n_images)
+        for w in counts:
+            engine = LocalEngine(pipeline_workers=w)
+            try:
+                best = 0.0
+                for _ in range(2):
+                    df = imageIO.readImagesPacked(
+                        d, size, numPartitions=8, engine=engine)
+                    t0 = time.perf_counter()
+                    n = df.collect().num_rows
+                    best = max(best,
+                               n / (time.perf_counter() - t0))
+                effective = host_pipeline.effective_workers(
+                    int(w), engine.pipeline_mode, record=False)
+                grid.append({
+                    "workers": int(w),
+                    "effective_workers": effective,
+                    "read_ahead": int(engine.pipeline_read_ahead),
+                    "mode": (host_pipeline.state().get("mode")
+                             or "serial") if effective >= 2
+                            else "serial",
+                    "rows_per_s": round(best, 1)})
+            finally:
+                engine.shutdown()
+        return grid
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> None:
     import argparse
 
@@ -227,6 +280,12 @@ def main() -> None:
     parser.add_argument("--rows", type=int, default=None,
                         help="rows per timed pass for --sweep "
                              "(default: 4x batch)")
+    parser.add_argument("--workers", default=None,
+                        help="comma-separated parallel-host-pipeline "
+                             "worker counts to sweep with --sweep "
+                             "(0 = serial; e.g. 0,2,4) — the measured "
+                             "priors behind the PipelineTarget knob "
+                             "bounds (docs/PERFORMANCE.md)")
     args = parser.parse_args()
 
     platform = jax.devices()[0].platform
@@ -234,9 +293,14 @@ def main() -> None:
     if args.sweep:
         batch = args.batch or (256 if on_tpu else 8)
         rows = args.rows or batch * 4
-        print(json.dumps({"platform": platform, "model": args.model,
-                          "batch": batch, "rows": rows,
-                          "sweep": _sweep(args.model, batch, rows)}))
+        report = {"platform": platform, "model": args.model,
+                  "batch": batch, "rows": rows,
+                  "sweep": _sweep(args.model, batch, rows)}
+        if args.workers is not None:
+            counts = [int(tok) for tok in args.workers.split(",")
+                      if tok.strip() != ""]
+            report["workers_sweep"] = _workers_sweep(counts)
+        print(json.dumps(report))
         return
     batch = args.batch or (256 if on_tpu else 8)
     rows = args.rows or batch * (4 if on_tpu else 2)
