@@ -594,6 +594,85 @@ def measure_autotune(mf, batch_size: int, n_rows: int) -> dict:
     }
 
 
+def measure_ship_ring(mf, batch_size: int, n_rows: int) -> dict:
+    """The device-resident infeed ring's acceptance shape
+    (docs/PERFORMANCE.md "Infeed ring & transfer interleave"): a
+    repeated-corpus steady pass through a ringed prefetch runner vs
+    the same runner with no ring, same model, same rows. The ring is
+    sized to hold the whole corpus (depth = corpus chunks, floored at
+    2) — the shape serving steady traffic actually sees, and the one
+    the zero-re-ship guarantee is defined over. tools/ci.sh gates:
+    ``steady_bytes_reshipped == 0``, ``steady_bytes_shipped == 0``
+    (every steady byte served from resident HBM),
+    ``unexpected_retraces == 0`` (the donated program compiled at
+    warmup, never at a steady request), and ring_ips against the
+    no-ring baseline inside the same noise discipline as
+    measure_autotune."""
+    from sparkdl_tpu.obs import default_registry
+    from sparkdl_tpu.runtime.runner import BatchRunner, warmup_runner
+
+    in_name = mf.input_names[0]
+    shape, dtype = mf.input_signature[in_name]
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 255, (n_rows,) + tuple(shape)).astype(dtype)
+    full = {in_name: x}
+    corpus_chunks = -(-n_rows // batch_size)
+    depth = max(2, corpus_chunks)
+    reg = default_registry()
+
+    def passes(runner, n):
+        rates = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            runner.run(full)
+            rates.append(n_rows / (time.perf_counter() - t0))
+        return rates
+
+    baseline = BatchRunner(mf, batch_size=batch_size,
+                           strategy="prefetch")
+    warmup_runner(baseline)
+    base_rates = passes(baseline, 3)
+    baseline_ips = float(max(base_rates))
+    noise_band = (max(base_rates) - min(base_rates)) / max(base_rates)
+    # the no-ring pass re-ships the whole corpus every time — the
+    # per-pass link traffic the ring's steady pass is gated to kill
+    s0 = reg.counter("ship.bytes_shipped").value
+    baseline.run(full)
+    baseline_bytes = reg.counter("ship.bytes_shipped").value - s0
+
+    ringed = BatchRunner(mf, batch_size=batch_size,
+                         strategy="prefetch", infeed_ring=depth)
+    warmup_runner(ringed)
+    ringed.run(full)                         # fill pass (ships once)
+    retr0 = reg.counter("compile.unexpected_retraces").value
+    h0 = reg.counter("ship.ring_hits").value
+    r0 = reg.counter("ship.bytes_reshipped").value
+    s0 = reg.counter("ship.bytes_shipped").value
+    res0 = reg.counter("ship.bytes_resident").value
+    ring_rates = passes(ringed, 3)
+    return {
+        "batch": int(batch_size),
+        "rows": int(n_rows),
+        "ring_depth": int(ringed.infeed_ring),
+        "corpus_chunks": int(corpus_chunks),
+        "baseline_ips": round(baseline_ips, 1),
+        "ring_ips": round(float(max(ring_rates)), 1),
+        "noise_band_pct": round(noise_band * 100.0, 1),
+        "baseline_bytes_per_pass": int(baseline_bytes),
+        "steady_bytes_shipped": int(
+            reg.counter("ship.bytes_shipped").value - s0),
+        "steady_bytes_reshipped": int(
+            reg.counter("ship.bytes_reshipped").value - r0),
+        "steady_ring_hits": int(
+            reg.counter("ship.ring_hits").value - h0),
+        "steady_bytes_resident": int(
+            reg.counter("ship.bytes_resident").value - res0),
+        "unexpected_retraces": int(
+            reg.counter("compile.unexpected_retraces").value - retr0),
+        "ring_state": ringed.ring_state(),
+    }
+
+
 _bench_done = None  # set by main(); threading.Event
 
 
@@ -839,6 +918,11 @@ def main() -> None:
     # outside the recorded noise band — tools/ci.sh gates it
     autotune = measure_autotune(mf, batch_size, n_rows=n_rows)
 
+    # the device-resident infeed ring (runtime/runner.py InfeedRing):
+    # a repeated-corpus steady pass must ship ZERO bytes (all content
+    # hits), re-ship zero, and retrace zero — tools/ci.sh gates it
+    ship_ring = measure_ship_ring(mf, batch_size, n_rows=n_rows)
+
     # Race the two fused-resize implementations device-resident
     # (VERDICT r4 #7, the transfer-strategy precedent: measured, not
     # asserted): the XLA einsum chain is the library default
@@ -1021,6 +1105,10 @@ def main() -> None:
         "serve": serve,
         "tails": tails,
         "autotune": autotune,
+        # the device-resident infeed ring's steady-pass verdict
+        # (runtime/runner.py InfeedRing; ci.sh step [18/18] gates
+        # zero re-ship / zero steady link bytes / zero retraces)
+        "ship_ring": ship_ring,
         "resilience": resilience_block,
         # compile forensics (docs/OBSERVABILITY.md, obs/compile_log.py):
         # per-function compile counts + wall time, retrace attribution,

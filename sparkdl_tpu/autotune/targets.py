@@ -129,7 +129,18 @@ class RunnerTarget(_TrialMixin):
     hook (for TPU hosts that can read ``memory_stats``) is the
     legitimate reason to reclaim depth AND queue slots; depth that is
     merely unused is left alone — idle slots cost nothing on a
-    healthy backend."""
+    healthy backend.
+
+    Link-prior path (trial-gated, prior-vetoed): runners that expose
+    the device-resident infeed ring (``infeed_ring`` /
+    ``transfer_interleave``, runtime/runner.py) get two more knobs,
+    deepened ONLY while the live roofline's latest window says
+    ``bound_by == "link"`` (the PipelineTarget read-only-prior
+    precedent) — ring slots hold HBM and interleave threads hold host
+    cores, so growing either without evidence the link binds would
+    spend real resources learning nothing. With no fresh ledger window
+    neither knob moves. Runners without the attributes (or with the
+    ring disabled) tune exactly as before."""
 
     #: fraction of window wall time blocked in device_get drains above
     #: which the overlap is deepened
@@ -138,6 +149,8 @@ class RunnerTarget(_TrialMixin):
     def __init__(self, runner, name: Optional[str] = None,
                  max_inflight_cap: int = 32,
                  max_prefetch_depth: int = 8,
+                 max_infeed_ring: int = 8,
+                 max_interleave: int = 8,
                  memory_pressure=None):
         self.runner = runner
         self.name = name or f"runner{next(_SEQ)}"
@@ -152,11 +165,34 @@ class RunnerTarget(_TrialMixin):
             get=lambda: runner.prefetch_depth,
             set=lambda v: setattr(runner, "prefetch_depth", int(v)),
             lo=1, hi=int(max_prefetch_depth))
+        # ring/interleave knobs only for runners that grew them
+        # (hasattr, not isinstance: stub runners in tests and older
+        # pickles simply tune without them)
+        self._ring: Optional[Knob] = None
+        if hasattr(runner, "infeed_ring"):
+            self._ring = Knob(
+                "infeed_ring",
+                get=lambda: int(runner.infeed_ring),
+                set=lambda v: setattr(runner, "infeed_ring", int(v)),
+                lo=0, hi=int(max_infeed_ring))
+        self._interleave: Optional[Knob] = None
+        if hasattr(runner, "transfer_interleave"):
+            self._interleave = Knob(
+                "transfer_interleave",
+                get=lambda: int(runner.transfer_interleave),
+                set=lambda v: setattr(
+                    runner, "transfer_interleave", int(v)),
+                lo=0, hi=int(max_interleave))
         self._prev: Optional[tuple] = None
         self._prev_degrades: Optional[float] = None
 
     def knobs(self) -> List[Knob]:
-        return [self._inflight, self._depth]
+        ks = [self._inflight, self._depth]
+        if self._ring is not None:
+            ks.append(self._ring)
+        if self._interleave is not None:
+            ks.append(self._interleave)
+        return ks
 
     def _window(self) -> Optional[tuple]:
         """(rows/s, wait_frac, placement degrades) over the window
@@ -227,6 +263,29 @@ class RunnerTarget(_TrialMixin):
                 self._start_trial(self._inflight,
                                   self._inflight.value + 1, tput,
                                   reason, out)
+        if out:
+            return out          # one move per window
+        # link-prior path: grow the infeed ring (then the interleave
+        # width) ONLY while the live roofline says the link binds —
+        # see class docstring. 0→2 jumps the K≥2 floor in one step
+        # (depth 1 is not a ring); past it, single validated steps.
+        if (self._ring is None and self._interleave is None):
+            return out
+        prior = self._ledger_prior()
+        if prior != "link":
+            return out
+        reason = "ledger prior: bound by link; keep bytes resident"
+        if (self._ring is not None and self._ring.usable()
+                and self._ring.value < self._ring.hi):
+            nxt = 2 if self._ring.value < 2 else self._ring.value + 1
+            self._start_trial(self._ring, nxt, tput, reason, out)
+        elif (self._interleave is not None
+                and self._interleave.usable()
+                and self._interleave.value < self._interleave.hi):
+            cur = self._interleave.value
+            nxt = 2 if cur < 2 else cur + 1
+            self._start_trial(self._interleave, nxt, tput,
+                              reason + "; widen transfer streams", out)
         return out
 
     def describe(self) -> dict:

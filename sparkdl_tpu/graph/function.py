@@ -305,9 +305,14 @@ class ModelFunction:
                 donate_argnums=(1,) if donate_inputs else ())
             # route compiles through the process-wide CompileLog
             # (obs/compile_log.py) — the serve layer's zero-retrace
-            # guarantee is enforced against exactly this wrapper
+            # guarantee is enforced against exactly this wrapper. The
+            # donated variant is a DISTINCT program with its own
+            # signature history: sharing the undonated name would make
+            # its first (legitimate) compile read as a phantom retrace.
+            log_name = (f"{self.name}.jitted[donated]"
+                        if donate_inputs else f"{self.name}.jitted")
             self._jit_cache[key] = compile_log().instrument(
-                fn, name=f"{self.name}.jitted", kind="jit",
+                fn, name=log_name, kind="jit",
                 config={"donate_inputs": donate_inputs},
                 arg_names=("params", "inputs"))
         return self._jit_cache[key]

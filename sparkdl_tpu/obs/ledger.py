@@ -100,6 +100,11 @@ FEEDS = {
     "serve": "serve.coalesce_wait_seconds",
 }
 LINK_WAIT_FEED = "ship.transfer_wait_seconds_total"
+#: NET link traffic: runs with the device-resident infeed ring engaged
+#: feed only the bytes that actually crossed the link this run
+#: (record_run_feeds(shipped_bytes=...) — ring hits re-use resident
+#: HBM slabs and are counted in ship.bytes_resident instead), so
+#: ledger.util.link reflects the wire, not the input size
 LINK_BYTES_FEED = "ship.bytes_shipped"
 #: executed-FLOPs feed (runtime/runner.py record_run_feeds, populated
 #: when the compile log recorded the program's cost_analysis) — lifts

@@ -33,8 +33,10 @@ from sparkdl_tpu.parallel.mesh import (
 from sparkdl_tpu.runtime.runner import (
     ChunkPhases,
     CopyCounters,
+    InfeedRing,
     PadStaging,
     RunnerMetrics,
+    ShipStats,
     SlabSink,
     check_against_signature,
     check_row_counts,
@@ -64,7 +66,9 @@ class ShardedBatchRunner:
                  metrics: Optional[RunnerMetrics] = None,
                  strategy: Optional[str] = None,
                  max_inflight: Optional[int] = None,
-                 prefetch_depth: Optional[int] = None):
+                 prefetch_depth: Optional[int] = None,
+                 infeed_ring: Optional[int] = None,
+                 transfer_interleave: Optional[int] = None):
         if model_fn.backend != "jax":
             raise ValueError(
                 f"sharded execution requires a jax backend, got "
@@ -82,8 +86,10 @@ class ShardedBatchRunner:
         # (runner.py module docstring): host_async on tunneled devices,
         # bounded async dispatch on direct-attached ones
         from sparkdl_tpu.runtime.runner import (
+            resolve_infeed_ring,
             resolve_prefetch_depth,
             resolve_strategy,
+            resolve_transfer_interleave,
         )
         self.strategy, self.max_inflight = resolve_strategy(
             strategy, max_inflight)
@@ -91,11 +97,25 @@ class ShardedBatchRunner:
         # (runtime/runner.py) — prefetched chunks land with the data
         # sharding, so depth costs global-batch-sized HBM per slot
         self.prefetch_depth = resolve_prefetch_depth(prefetch_depth)
+        # device-resident infeed ring over the PLACED sharded slabs —
+        # each retained slot already lives split across the data axis,
+        # so one logical ring IS the per-device ring set; stream-through
+        # chunks dispatch undonated (sharded_jitted declares no
+        # donate_argnums — sharded donation is a future rung)
+        self.infeed_ring = resolve_infeed_ring(infeed_ring)
+        # per-device transfer interleave width for sharded placements
+        # (runtime/runner.py::interleaved_device_put)
+        self.transfer_interleave = resolve_transfer_interleave(
+            transfer_interleave)
         self._global_batch = batch_size * self.mesh.shape[DATA_AXIS]
         # persistent pad staging (BatchRunner's checkout discipline):
         # concurrent run() calls fall back to a throwaway stager
         self._staging = PadStaging()
         self._staging_lock = threading.Lock()
+        # persistent ring + try-lock (BatchRunner discipline: a
+        # contended run() bypasses the ring rather than racing)
+        self._ring: Optional[InfeedRing] = None
+        self._ring_lock = threading.Lock()
 
     # Locks, warm staging buffers, and the mesh's device handles are
     # process-local; a runner captured in a stage closure ships to
@@ -113,6 +133,8 @@ class ShardedBatchRunner:
         state = dict(self.__dict__)
         state.pop("_staging", None)
         state.pop("_staging_lock", None)
+        state.pop("_ring", None)
+        state.pop("_ring_lock", None)
         state.pop("mesh", None)
         state.pop("_global_batch", None)
         state["_mesh_model_axis"] = self.mesh.shape[MODEL_AXIS]
@@ -126,6 +148,33 @@ class ShardedBatchRunner:
         self._global_batch = self.batch_size * self.mesh.shape[DATA_AXIS]
         self._staging = PadStaging()
         self._staging_lock = threading.Lock()
+        self._ring = None
+        self._ring_lock = threading.Lock()
+
+    def _checkout_ring(self):
+        """(ring, locked, stats) — BatchRunner's checkout discipline
+        minus the donated program (sharded stream-through dispatches
+        undonated; see ``__init__``)."""
+        depth = int(self.infeed_ring)
+        if depth < 2:
+            return None, False, None
+        if not self._ring_lock.acquire(blocking=False):
+            return None, False, None
+        if self._ring is None:
+            self._ring = InfeedRing(depth)
+        else:
+            self._ring.resize(depth)
+        from sparkdl_tpu.obs import default_registry
+        reg = default_registry()
+        reg.gauge("ship.ring_depth").set(depth)
+        reg.gauge("ship.interleave_width").set(
+            int(self.transfer_interleave))
+        return self._ring, True, ShipStats()
+
+    def ring_state(self) -> Optional[dict]:
+        """Live infeed-ring telemetry (None when no ring engaged)."""
+        ring = self._ring
+        return ring.state() if ring is not None else None
 
     @property
     def preferred_chunk(self) -> int:
@@ -172,7 +221,8 @@ class ShardedBatchRunner:
         place = None
         dat = None
         place_required = jax.process_count() > 1
-        if place_required or self.strategy == "prefetch":
+        if (place_required or self.strategy == "prefetch"
+                or self.transfer_interleave >= 2):
             from sparkdl_tpu.parallel.mesh import data_sharding
             dat = data_sharding(self.mesh)
         if place_required:
@@ -184,6 +234,7 @@ class ShardedBatchRunner:
         counters = CopyCounters()
         staging, locked = checkout_staging(self._staging,
                                            self._staging_lock)
+        ring, ring_locked, stats = self._checkout_ring()
         try:
             chunks = iter_padded_chunks(inputs, n, self._global_batch,
                                         staging, counters)
@@ -207,10 +258,14 @@ class ShardedBatchRunner:
                 batches = dispatch_chunks(
                     fn, params, chunks, self.strategy,
                     self.max_inflight, sink, place=place, sharding=dat,
-                    prefetch_depth=self.prefetch_depth, phases=phases)
+                    prefetch_depth=self.prefetch_depth, phases=phases,
+                    ring=ring, donate_fn=None,
+                    interleave=self.transfer_interleave, stats=stats)
         finally:
             if locked:
                 self._staging_lock.release()
+            if ring_locked:
+                self._ring_lock.release()
         if phases is not None:
             # drain half of the phase accounting — one pair of clock
             # reads shared with transfer_wait_seconds
@@ -225,7 +280,9 @@ class ShardedBatchRunner:
                          sink.transfer_wait, batches=batches,
                          flops_per_batch=(
                              getattr(fn, "last_flops", None)
-                             if compile_log().armed else None))
+                             if compile_log().armed else None),
+                         shipped_bytes=(stats.shipped_bytes
+                                        if stats is not None else None))
         # autotune apply point (runtime/runner.py precedent): knobs
         # move between runs only; disarmed this is one armed-check
         from sparkdl_tpu.autotune.core import poll as autotune_poll

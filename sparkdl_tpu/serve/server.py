@@ -701,12 +701,17 @@ class ModelServer:
                  batch_size: int = 64, mesh=None,
                  strategy: Optional[str] = None,
                  max_inflight: Optional[int] = None,
-                 prefetch_depth: Optional[int] = None) -> ModelSession:
+                 prefetch_depth: Optional[int] = None,
+                 infeed_ring: Optional[int] = None,
+                 transfer_interleave: Optional[int] = None
+                 ) -> ModelSession:
         """Register a model under ``name``: either a ``ModelFunction``
         (a ``BatchRunner`` is built; pass ``mesh`` for a data-parallel
         ``ShardedBatchRunner`` — ``batch_size`` is then PER-CHIP) or a
-        prebuilt runner. Returns the session (for per-model warmup /
-        introspection)."""
+        prebuilt runner. ``infeed_ring``/``transfer_interleave`` pass
+        through to the runner (runtime/runner.py: device-resident
+        infeed ring + per-device transfer streams). Returns the
+        session (for per-model warmup / introspection)."""
         if (model_fn is None) == (runner is None):
             raise ValueError(
                 "register() takes exactly one of model_fn= or runner=")
@@ -715,12 +720,16 @@ class ModelServer:
                 runner = ShardedBatchRunner(
                     model_fn, mesh=mesh, batch_size=batch_size,
                     strategy=strategy, max_inflight=max_inflight,
-                    prefetch_depth=prefetch_depth)
+                    prefetch_depth=prefetch_depth,
+                    infeed_ring=infeed_ring,
+                    transfer_interleave=transfer_interleave)
             else:
                 runner = BatchRunner(
                     model_fn, batch_size=batch_size, strategy=strategy,
                     max_inflight=max_inflight,
-                    prefetch_depth=prefetch_depth)
+                    prefetch_depth=prefetch_depth,
+                    infeed_ring=infeed_ring,
+                    transfer_interleave=transfer_interleave)
         elif mesh is not None:
             raise ValueError(
                 "pass mesh= with model_fn=, not with a prebuilt "
@@ -827,6 +836,15 @@ class ModelServer:
                             s.runner, "prefetch_depth", None),
                         "batch_size": getattr(s.runner, "batch_size",
                                               None),
+                        "infeed_ring": getattr(
+                            s.runner, "infeed_ring", None),
+                        "transfer_interleave": getattr(
+                            s.runner, "transfer_interleave", None),
+                        # live slot occupancy/hit telemetry (None
+                        # until a ringed run engages it)
+                        "ring": (s.runner.ring_state()
+                                 if hasattr(s.runner, "ring_state")
+                                 else None),
                     },
                 } for name, s in sessions.items()},
             "metrics": self.metrics.as_dict(),

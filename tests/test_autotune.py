@@ -85,7 +85,7 @@ class TestDepthNPrefetch:
         up — the bounded-queue semantics the tentpole names."""
         events = []
 
-        def fake_place(chunk, sharding=None):
+        def fake_place(chunk, sharding=None, interleave=0):
             events.append(("place", chunk["i"]))
             return chunk
 

@@ -1,0 +1,578 @@
+"""Infeed-ring tests (L2: device-resident slabs, donation safety,
+per-device transfer interleave, warmup/autotune integration).
+
+Pins the PR-16 contracts: content hits dispatch resident slabs and
+ship zero bytes; a donated slot can never be re-read
+(use-after-donate raises); every degrade — bad env knob, donation
+no-op backend, unservable interleave — is counted and warned, never
+silent; warmup warms every ring slot at exactly two traced programs;
+and the RunnerTarget grows the ring only behind a link-bound ledger
+prior."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+
+import sparkdl_tpu.runtime.runner as rmod
+from sparkdl_tpu.autotune.targets import RunnerTarget
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs.registry import default_registry
+from sparkdl_tpu.runtime.runner import (
+    BatchRunner,
+    InfeedRing,
+    RunnerMetrics,
+    dispatch_donated,
+    interleaved_device_put,
+    resolve_infeed_ring,
+    resolve_transfer_interleave,
+    warmup_runner,
+)
+
+LOGGER = "sparkdl_tpu.runtime.runner"
+
+
+def _double_fn():
+    return ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                                    input_shape=(3,))
+
+
+def _c(name: str) -> float:
+    return default_registry().counter(name).value
+
+
+def _chunk(seed: float, rows: int = 4):
+    return {"x": np.full((rows, 3), seed, np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# InfeedRing unit: fingerprint, hit/admit/donate policy, LRU history
+
+
+class TestInfeedRingUnit:
+    def test_depth_floor_raises(self):
+        for bad in (0, 1, -2):
+            with pytest.raises(ValueError, match="depth"):
+                InfeedRing(bad)
+        with pytest.raises(ValueError, match="depth"):
+            InfeedRing(4).resize(1)
+
+    def test_fingerprint_is_content_addressed(self):
+        ring = InfeedRing(2)
+        a = {"x": np.arange(12, dtype=np.float32).reshape(4, 3)}
+        same = {"x": np.array(a["x"])}           # copy, same content
+        assert ring.fingerprint(a) == ring.fingerprint(same)
+        # name, dtype, shape, and bytes each break the match
+        assert ring.fingerprint(a) != ring.fingerprint(
+            {"y": a["x"]})
+        assert ring.fingerprint(a) != ring.fingerprint(
+            {"x": a["x"].astype(np.float64)})
+        assert ring.fingerprint(a) != ring.fingerprint(
+            {"x": a["x"].reshape(3, 4)})
+        assert ring.fingerprint(a) != ring.fingerprint(
+            {"x": a["x"] + 1})
+        # non-contiguous views hash like their contiguous copy
+        t = np.asfortranarray(a["x"])
+        assert ring.fingerprint({"x": t}) == ring.fingerprint(a)
+
+    def test_hit_returns_resident_slab(self):
+        ring = InfeedRing(2)
+        fp = ring.fingerprint(_chunk(1.0))
+        assert ring.get(fp) is None
+        assert ring.admit(fp, {"x": "slab"}, 48) is True
+        assert ring.get(fp) == {"x": "slab"}
+        st = ring.state()
+        assert st["depth"] == 2 and st["live"] == 1
+        assert st["hits"] == 1 and st["resident_bytes"] == 48
+
+    def test_use_after_donate_raises(self):
+        ring = InfeedRing(2)
+        fp = ring.fingerprint(_chunk(1.0))
+        ring.admit(fp, {"x": "slab"}, 48)
+        ring.note_donated(fp)
+        with pytest.raises(RuntimeError, match="use-after-donate"):
+            ring.get(fp)
+        assert ring.state()["donated"] == 1
+
+    def test_admit_capacity_then_donate_through(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i))) for i in range(3)]
+        ring.tick()
+        assert ring.admit(fps[0], {"x": 0}, 8) is True
+        ring.tick()
+        assert ring.admit(fps[1], {"x": 1}, 8) is True
+        # every slot recently useful: the third chunk must NOT evict a
+        # hot slab — it streams through
+        ring.tick()
+        assert ring.admit(fps[2], {"x": 2}, 8) is False
+        assert ring.get(fps[0]) == {"x": 0}
+
+    def test_admit_reclaims_donated_slot_first(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i))) for i in range(3)]
+        ring.admit(fps[0], {"x": 0}, 8)
+        ring.admit(fps[1], {"x": 1}, 8)
+        ring.note_donated(fps[0])
+        assert ring.admit(fps[2], {"x": 2}, 8) is True
+        # the dead slab's index entry is gone (no use-after-donate
+        # left to trip) and the newcomer serves hits
+        assert ring.get(fps[0]) is None
+        assert ring.get(fps[2]) == {"x": 2}
+
+    def test_admit_evicts_stale_slot(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i))) for i in range(3)]
+        ring.admit(fps[0], {"x": 0}, 8)
+        ring.admit(fps[1], {"x": 1}, 8)
+        for _ in range(2 * ring.depth):
+            ring.tick()                  # both slots idle past 2*depth
+        assert ring.admit(fps[2], {"x": 2}, 8) is True
+        assert ring.get(fps[2]) == {"x": 2}
+
+    def test_retire_all_makes_slots_reclaimable(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i))) for i in range(3)]
+        ring.admit(fps[0], {"x": 0}, 8)
+        ring.admit(fps[1], {"x": 1}, 8)
+        ring.retire_all()
+        # retired slots still serve hits until actually evicted...
+        assert ring.get(fps[0]) == {"x": 0}
+        ring.retire_all()
+        ring.tick()
+        # ...but a miss claims one immediately, no 2*depth wait
+        assert ring.admit(fps[2], {"x": 2}, 8) is True
+
+    def test_note_shipped_detects_reship_with_bounded_history(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i)))
+               for i in range(70)]
+        assert ring.note_shipped(fps[0]) is False
+        assert ring.note_shipped(fps[0]) is True      # the re-ship
+        for fp in fps[1:]:
+            ring.note_shipped(fp)
+        # cap = max(64, 8*depth) = 64: fps[0] has been LRU-evicted
+        # from the history, so it no longer reads as a re-ship
+        assert ring.note_shipped(fps[0]) is False
+
+    def test_resize_grow_keeps_slabs_shrink_drops(self):
+        ring = InfeedRing(2)
+        fps = [ring.fingerprint(_chunk(float(i))) for i in range(3)]
+        ring.admit(fps[0], {"x": 0}, 8)
+        ring.admit(fps[1], {"x": 1}, 8)
+        ring.resize(4)
+        assert ring.depth == 4
+        assert ring.get(fps[0]) == {"x": 0}           # grow keeps
+        assert ring.admit(fps[2], {"x": 2}, 8) is True
+        ring.resize(2)
+        assert ring.get(fps[0]) == {"x": 0}
+        assert ring.get(fps[2]) is None               # shrink drops
+
+
+# ---------------------------------------------------------------------------
+# Env/ctor resolvers: typos degrade loudly, never raise
+
+
+class TestRingResolvers:
+    def test_env_typo_degrades_loudly(self, monkeypatch, caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        monkeypatch.setenv("SPARKDL_TPU_INFEED_RING", "bananas")
+        c0 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert resolve_infeed_ring(None) == rmod.DEFAULT_INFEED_RING
+        assert _c("ship.ring_config_errors") == c0 + 1
+        assert any("integer" in r.message for r in caplog.records)
+
+    def test_env_valid_and_ctor_wins(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_INFEED_RING", "4")
+        assert resolve_infeed_ring(None) == 4
+        assert resolve_infeed_ring(3) == 3            # ctor beats env
+        r = BatchRunner(_double_fn(), batch_size=4)
+        assert r.infeed_ring == 4                     # env engages
+
+    def test_negative_depth_degrades_to_off(self, monkeypatch, caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        c0 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert resolve_infeed_ring(-3) == 0
+        assert _c("ship.ring_config_errors") == c0 + 1
+
+    def test_depth_one_clamps_to_floor(self, monkeypatch, caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        c0 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert resolve_infeed_ring(1) == 2
+        assert _c("ship.ring_config_errors") == c0 + 1
+        assert any("double-buffer" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_interleave_negative_degrades_width_one_is_serial(
+            self, monkeypatch, caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        c0 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert resolve_transfer_interleave(-1) == 0
+        assert _c("ship.ring_config_errors") == c0 + 1
+        # width 1 IS the serial stream — a no-op, not a degrade
+        c1 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert resolve_transfer_interleave(1) == 0
+        assert _c("ship.ring_config_errors") == c1
+        assert resolve_transfer_interleave(4) == 4
+
+    def test_warn_once_dedupes_log_not_counter(self, monkeypatch,
+                                               caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        c0 = _c("ship.ring_config_errors")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            resolve_infeed_ring(-1)
+            resolve_infeed_ring(-1)
+        assert _c("ship.ring_config_errors") == c0 + 2
+        assert sum("negative" in r.getMessage()
+                   for r in caplog.records) == 1
+
+
+# ---------------------------------------------------------------------------
+# Donation probe: the no-op-backend degrade is counted, never silent
+
+
+class TestDonationProbe:
+    def test_noop_warning_degrades_to_undonated(self, monkeypatch,
+                                                caplog):
+        import warnings as wmod
+        monkeypatch.setattr(rmod, "_DONATION_STATE",
+                            {"probed": False, "supported": True})
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+
+        def donate_fn(params, chunk):
+            wmod.warn("Some donated buffers were not usable")
+            return {"out": chunk["x"] * 2}
+
+        def fn(params, chunk):
+            return {"out": chunk["x"] * 3}
+
+        c0 = _c("ship.ring_degrade_events")
+        chunk = {"x": np.ones(3, np.float32)}
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            res, donated = dispatch_donated(donate_fn, fn, None, chunk)
+        # the probe call itself ran the donated program (semantics are
+        # identical) but the verdict is NOT-donated
+        assert donated is False
+        np.testing.assert_allclose(res["out"], 2.0)
+        assert _c("ship.ring_degrade_events") == c0 + 1
+        assert any("cannot donate" in r.getMessage()
+                   for r in caplog.records)
+        # every later call dispatches the UNDONATED program, without
+        # re-probing or re-counting
+        res2, donated2 = dispatch_donated(donate_fn, fn, None, chunk)
+        assert donated2 is False
+        np.testing.assert_allclose(res2["out"], 3.0)
+        assert _c("ship.ring_degrade_events") == c0 + 1
+
+    def test_clean_probe_keeps_donating(self, monkeypatch):
+        monkeypatch.setattr(rmod, "_DONATION_STATE",
+                            {"probed": False, "supported": True})
+
+        def donate_fn(params, chunk):
+            return {"out": chunk["x"] * 2}
+
+        def fn(params, chunk):          # pragma: no cover - must not run
+            raise AssertionError("undonated fallback dispatched")
+
+        c0 = _c("ship.ring_degrade_events")
+        chunk = {"x": np.ones(3, np.float32)}
+        for _ in range(2):
+            res, donated = dispatch_donated(donate_fn, fn, None, chunk)
+            assert donated is True
+            np.testing.assert_allclose(res["out"], 2.0)
+        assert _c("ship.ring_degrade_events") == c0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: zero re-ship on a steady repeated corpus
+
+
+class TestSteadyRepeatedCorpus:
+    def test_second_pass_ships_zero_bytes_zero_retraces(self):
+        r = BatchRunner(_double_fn(), batch_size=4, infeed_ring=2)
+        assert r.warmup() is True
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        # pass 1 pays the placements (warmup retired its synthetic
+        # slabs, so both real chunks are admitted immediately)
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        hits0 = _c("ship.ring_hits")
+        reship0 = _c("ship.bytes_reshipped")
+        shipped0 = _c("ship.bytes_shipped")
+        retrace0 = _c("compile.unexpected_retraces")
+        # pass 2, same corpus: every chunk is a content hit — zero
+        # bytes cross the link, zero re-ships, zero retraces
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        assert _c("ship.ring_hits") == hits0 + 2
+        assert _c("ship.bytes_reshipped") == reship0
+        assert _c("ship.bytes_shipped") == shipped0
+        assert _c("compile.unexpected_retraces") == retrace0
+        st = r.ring_state()
+        assert st is not None
+        assert st["depth"] == 2 and st["live"] == 2 and st["hits"] >= 2
+
+    def test_resident_slab_owns_its_bytes(self):
+        """A retained slab must survive the host-side pad buffer being
+        rewritten (CPU backends may zero-copy alias device_put): after
+        running a DIFFERENT corpus through the same staging, the
+        original corpus's hit must still return the original rows."""
+        r = BatchRunner(_double_fn(), batch_size=4, infeed_ring=4)
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        b = a + 100.0
+        np.testing.assert_allclose(r.run({"input": a})["output"], a * 2)
+        np.testing.assert_allclose(r.run({"input": b})["output"], b * 2)
+        hits0 = _c("ship.ring_hits")
+        np.testing.assert_allclose(r.run({"input": a})["output"], a * 2)
+        assert _c("ship.ring_hits") == hits0 + 1
+
+
+# ---------------------------------------------------------------------------
+# Ring wrap-around under mid-stream LiveBatchHint changes
+
+
+class TestRingLiveBatchHints:
+    def test_batch_size_change_between_runs_stays_exact(self):
+        r = BatchRunner(_double_fn(), batch_size=4, infeed_ring=2)
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        # a live hint moves the chunk shape mid-stream: old-shape slots
+        # can never hit again; the new chunks stream through (or evict
+        # stale slots) — rows stay exact either way, nothing raises
+        r.batch_size = 3
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        # back to the original shape: slot turnover staggers across
+        # passes (stale eviction is clocked in dispatches), but the
+        # ring re-adapts — repeats of the restored corpus serve hits
+        # again, and every pass stays row-exact
+        r.batch_size = 4
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        hits0 = _c("ship.ring_hits")
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        assert _c("ship.ring_hits") >= hits0 + 1
+        assert r.ring_state()["live"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Warmup: every slot warmed, exactly two traced programs
+
+
+class TestWarmupRing:
+    def test_warmup_fills_every_slot_trace_count_pinned(
+            self, monkeypatch):
+        # pin the donation verdict so the overflow batch deterministically
+        # dispatches the DONATED program (the natural probe's verdict is
+        # platform-dependent)
+        monkeypatch.setattr(rmod, "_DONATION_STATE",
+                            {"probed": True, "supported": True})
+
+        def _warm(depth):
+            calls = {"n": 0}
+
+            def f(x):
+                calls["n"] += 1         # fires at TRACE time only
+                return x * 2.0
+
+            mf = ModelFunction.fromSingle(f, None, input_shape=(3,))
+            r = BatchRunner(mf, batch_size=4, infeed_ring=depth)
+            assert warmup_runner(r) is True
+            st = r.ring_state()
+            assert st["depth"] == depth and st["slots"] == depth
+            assert st["live"] == depth  # every slot warmed
+            donations = _c("ship.ring_donations")
+            return calls["n"], donations
+
+        d0 = _c("ship.ring_donations")
+        traces_k2, after_k2 = _warm(2)
+        traces_k4, after_k4 = _warm(4)
+        # every warm batch shares ONE device shape: at most the
+        # undonated + donated programs trace, and the count is pinned
+        # INDEPENDENT of ring depth (jax may share the jaxpr between
+        # the two — donation changes lowering, not tracing)
+        assert traces_k2 == traces_k4 <= 2
+        # each warmup's overflow batch streamed through donated —
+        # compiled here, never at a steady-state request
+        assert after_k2 == d0 + 1 and after_k4 == d0 + 2
+
+    def test_warmup_retires_slots_so_real_corpus_admits(self):
+        r = BatchRunner(_double_fn(), batch_size=4, infeed_ring=2)
+        assert r.warmup() is True
+        x = np.arange(12, dtype=np.float32).reshape(4, 3) + 7.0
+        donations0 = _c("ship.ring_donations")
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        hits0 = _c("ship.ring_hits")
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        # the first real chunk was ADMITTED (warmup slabs retired), so
+        # the repeat is a hit — it did not donate-through behind
+        # synthetic warmth
+        assert _c("ship.ring_hits") == hits0 + 1
+        assert _c("ship.ring_donations") == donations0
+
+
+# ---------------------------------------------------------------------------
+# interleaved_device_put: row identity, serial no-op, loud degrade
+
+
+class TestInterleavedDevicePut:
+    def test_row_identity_across_devices(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devs = jax.devices()
+        assert len(devs) >= 2           # conftest forces 8 virtual
+        mesh = Mesh(np.array(devs), ("d",))
+        sh = NamedSharding(mesh, PartitionSpec("d"))
+        x = np.arange(len(devs) * 4, dtype=np.float32).reshape(
+            len(devs), 4)
+        out = interleaved_device_put({"x": x}, sh, 4)
+        assert out is not None
+        np.testing.assert_array_equal(np.asarray(out["x"]), x)
+        assert out["x"].sharding.is_equivalent_to(sh, x.ndim)
+
+    def test_single_device_sharding_is_serial_not_a_degrade(
+            self, monkeypatch, caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+        sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        d0 = _c("ship.interleave_degrade_events")
+        x = np.ones((4, 3), np.float32)
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            out = interleaved_device_put({"x": x}, sh, 4)
+        np.testing.assert_array_equal(np.asarray(out["x"]), x)
+        assert _c("ship.interleave_degrade_events") == d0
+        assert not caplog.records
+
+    def test_unservable_sharding_degrades_loudly(self, monkeypatch,
+                                                 caplog):
+        monkeypatch.setattr(rmod, "_WARNED_REASONS", set())
+
+        class _BadSharding:
+            def addressable_devices_indices_map(self, shape):
+                raise NotImplementedError("no shard map here")
+
+        d0 = _c("ship.degrade_events")
+        i0 = _c("ship.interleave_degrade_events")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            out = interleaved_device_put(
+                {"x": np.ones((4, 3), np.float32)}, _BadSharding(), 2)
+        assert out is None
+        assert _c("ship.degrade_events") == d0 + 1
+        assert _c("ship.interleave_degrade_events") == i0 + 1
+        assert any("interleave" in r.getMessage()
+                   for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# Sharded runner: the ring over placed sharded slabs
+
+
+class TestShardedRunnerRing:
+    def test_sharded_steady_pass_zero_reship(self):
+        from sparkdl_tpu.parallel.inference import ShardedBatchRunner
+        r = ShardedBatchRunner(_double_fn(), batch_size=1,
+                               infeed_ring=2)
+        n = 2 * r.preferred_chunk       # a corpus that fits the ring
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        hits0 = _c("ship.ring_hits")
+        reship0 = _c("ship.bytes_reshipped")
+        shipped0 = _c("ship.bytes_shipped")
+        np.testing.assert_allclose(r.run({"input": x})["output"], x * 2)
+        assert _c("ship.ring_hits") == hits0 + 2
+        assert _c("ship.bytes_reshipped") == reship0
+        assert _c("ship.bytes_shipped") == shipped0
+        st = r.ring_state()
+        assert st is not None and st["live"] == 2
+
+    def test_record_run_feeds_shipped_override(self):
+        mf = _double_fn()
+        c0 = _c("ship.bytes_shipped")
+        rmod.record_run_feeds(mf, {"input": np.ones((64, 3),
+                                                    np.float32)},
+                              0.01, 0.0, batches=1, shipped_bytes=123)
+        # the override IS the link traffic — not the input-sum bytes
+        assert _c("ship.bytes_shipped") == c0 + 123
+
+
+# ---------------------------------------------------------------------------
+# RunnerTarget: ring knobs behind a link-bound ledger prior
+
+
+class _RingStubRunner:
+    def __init__(self, **kw):
+        self.strategy = "prefetch"
+        self.max_inflight = 8
+        self.prefetch_depth = 1
+        self.infeed_ring = 0
+        self.transfer_interleave = 0
+        self.metrics = RunnerMetrics()
+        self.__dict__.update(kw)
+
+
+class _BareStubRunner:
+    """The pre-ring runner surface (prebuilt custom runners, old
+    pickles): no infeed_ring / transfer_interleave attributes."""
+
+    def __init__(self):
+        self.strategy = "prefetch"
+        self.max_inflight = 8
+        self.prefetch_depth = 1
+        self.metrics = RunnerMetrics()
+
+
+def _busy_window(t, wait=0.001):
+    """One quiet traffic window: rows moved, negligible transfer wait
+    (so the wait_frac path stays out of the way of the link prior)."""
+    t.runner.metrics.add(1000, 10, 1.0, transfer_wait_seconds=wait)
+    return t.propose(warming=False)
+
+
+class TestRunnerTargetRingKnobs:
+    def test_link_prior_grows_ring_to_the_k2_floor(self):
+        t = RunnerTarget(_RingStubRunner())
+        t._ledger_prior = lambda: "link"
+        assert _busy_window(t) == []    # baseline window
+        out = _busy_window(t)
+        assert [p.knob.name for p in out] == ["infeed_ring"]
+        assert out[0].value == 2        # 0 -> 2 jumps the K>=2 floor
+        assert "link" in out[0].reason
+
+    def test_ring_at_cap_widens_interleave(self):
+        t = RunnerTarget(_RingStubRunner(infeed_ring=8))
+        t._ledger_prior = lambda: "link"
+        _busy_window(t)
+        out = _busy_window(t)
+        assert [p.knob.name for p in out] == ["transfer_interleave"]
+        assert out[0].value == 2
+        assert "transfer streams" in out[0].reason
+
+    def test_no_link_prior_no_ring_move(self):
+        for prior in ("decode", "compute", None):
+            t = RunnerTarget(_RingStubRunner())
+            t._ledger_prior = lambda p=prior: p
+            _busy_window(t)
+            assert _busy_window(t) == []
+
+    def test_wait_frac_path_still_wins_the_window(self):
+        """One move per window: while transfer waits dominate, the
+        existing overlap trial fires and the ring stays untouched."""
+        t = RunnerTarget(_RingStubRunner())
+        t._ledger_prior = lambda: "link"
+        _busy_window(t, wait=0.5)
+        out = _busy_window(t, wait=0.5)
+        assert [p.knob.name for p in out] == ["prefetch_depth"]
+
+    def test_bare_runner_tunes_exactly_as_before(self):
+        t = RunnerTarget(_BareStubRunner())
+        assert [k.name for k in t.knobs()] == ["max_inflight",
+                                               "prefetch_depth"]
+        t._ledger_prior = lambda: "link"
+        _busy_window(t)
+        assert _busy_window(t) == []    # no ring knobs to move
+
+    def test_ring_runner_exposes_four_knobs(self):
+        t = RunnerTarget(_RingStubRunner())
+        assert [k.name for k in t.knobs()] == [
+            "max_inflight", "prefetch_depth", "infeed_ring",
+            "transfer_interleave"]

@@ -54,10 +54,12 @@ from typing import List, Optional
 #: ... and the compile block's per-function table on which programs
 #: the round actually compiled (obs/compile_log.py), and the
 #: pipeline_overlap block's mode/worker shape on the measuring host's
-#: cores and start-method support (data/pipeline.py)
+#: cores and start-method support (data/pipeline.py), and the
+#: ship_ring block's ring depth / hit and byte tallies on the
+#: measuring host's corpus shape (runtime/runner.py InfeedRing)
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
                 "autotune", "tails", "slo", "resilience", "bound",
-                "compile", "pipeline_overlap"}
+                "compile", "pipeline_overlap", "ship_ring"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

@@ -124,6 +124,19 @@
 #      and recover; a PipelineTarget-armed controller must settle
 #      with zero oscillations; and the pipeline state must ride
 #      /statusz and a flight bundle
+#  18. infeed-ring gate (docs/PERFORMANCE.md "Infeed ring & transfer
+#      interleave"): the bench smoke's "ship_ring" block must show
+#      the repeated-corpus steady pass shipping ZERO bytes (every
+#      chunk a resident content hit), zero re-shipped bytes, zero
+#      unexpected retraces, and throughput not losing to the no-ring
+#      baseline outside the noise band; a live ringed ModelServer
+#      drill must grow ship.ring_hits with a zero bytes_reshipped
+#      delta and zero retraces, and surface ring state on /statusz +
+#      sparkdl_ship_ring_* (with # HELP) on /metricsz; and the
+#      per-device transfer-interleave drill must beat serial FIFO
+#      placement >= 1.2x aggregate when >= 2 cores exist (on a 1-core
+#      host the measured serial win is PRINTED — the degrade is
+#      gated, never silently skipped)
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -139,7 +152,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/17] native shim build =="
+echo "== [1/18] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -148,13 +161,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/17] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/18] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/17] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/18] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/17] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/18] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -163,7 +176,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/17] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/18] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -243,7 +256,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/17] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/18] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -282,11 +295,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/17] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/18] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/17] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/18] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -381,7 +394,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/17] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/18] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -491,7 +504,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/17] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/18] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -630,11 +643,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/17] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/18] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/17] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/18] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -699,7 +712,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/17] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/18] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -797,7 +810,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/17] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/18] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -889,7 +902,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/17] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/18] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1016,7 +1029,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/17] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/18] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1136,7 +1149,7 @@ python -m sparkdl_tpu.obs report --bound \
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
-echo "== [16/17] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+echo "== [16/18] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
 # (a) the bench smoke's "compile" block (step 4's result file): the
 # compile log was armed for the whole run, saw every jit compile, and
 # the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
@@ -1272,7 +1285,7 @@ grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
 grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
 grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
-echo "== [17/17] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
+echo "== [17/18] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's pipeline_overlap block: serial-vs-pooled ips
 # on one corpus + the overlap proof. On a multi-core host the pool
 # must have engaged and not lose >5% to serial; on a 1-core host the
@@ -1474,6 +1487,182 @@ print(json.dumps({"pipeline_gate": "ok", "cores": cores,
                       round(ratio, 3) if ratio else None,
                   "stalled_sources": stalled_names[:3],
                   "bundle": path}))
+EOF
+
+echo "== [18/18] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
+# (a) the bench smoke's ship_ring block: the repeated-corpus steady
+# pass must ship ZERO bytes (every chunk a content hit off a resident
+# slab — STRICTLY below the no-ring baseline's per-pass corpus
+# re-ship), re-ship zero, retrace zero, and not lose to the no-ring
+# baseline outside the recorded noise band (same 25% floor as the
+# autotune gate: 1-core scheduler jitter dominates).
+python - <<'EOF'
+import json
+
+with open("/tmp/sparkdl_bench_smoke.json") as f:
+    d = json.load(f)
+sr = d["ship_ring"]
+for k in ("batch", "rows", "ring_depth", "corpus_chunks",
+          "baseline_ips", "ring_ips", "noise_band_pct",
+          "baseline_bytes_per_pass", "steady_bytes_shipped",
+          "steady_bytes_reshipped", "steady_ring_hits",
+          "steady_bytes_resident", "unexpected_retraces",
+          "ring_state"):
+    assert k in sr, f"ship_ring block missing {k!r}: {sorted(sr)}"
+assert sr["ring_depth"] >= max(2, sr["corpus_chunks"]), sr
+assert sr["steady_bytes_reshipped"] == 0, \
+    f"steady pass re-shipped bytes: {sr}"
+assert sr["unexpected_retraces"] == 0, \
+    f"steady pass retraced: {sr}"
+assert sr["baseline_bytes_per_pass"] > 0, sr
+assert sr["steady_bytes_shipped"] == 0, \
+    (f"ring steady pass still shipped "
+     f"{sr['steady_bytes_shipped']} bytes over the link "
+     f"(no-ring baseline ships {sr['baseline_bytes_per_pass']}/pass)")
+assert sr["steady_ring_hits"] >= sr["corpus_chunks"], sr
+assert sr["steady_bytes_resident"] > 0, sr
+live = sr["ring_state"]
+assert live and live["live"] >= 1 and live["depth"] >= 2, live
+band = max(0.25, sr["noise_band_pct"] / 100.0)
+floor = sr["baseline_ips"] * (1.0 - band)
+assert sr["ring_ips"] >= floor, \
+    (f"ringed steady pass lost to the no-ring baseline outside the "
+     f"noise band: {sr['ring_ips']} < floor {floor:.1f} "
+     f"(baseline {sr['baseline_ips']}, band {band:.0%})")
+print(json.dumps({"ship_ring_gate": "ok",
+                  "ring_ips": sr["ring_ips"],
+                  "baseline_ips": sr["baseline_ips"],
+                  "steady_bytes_shipped": sr["steady_bytes_shipped"],
+                  "baseline_bytes_per_pass":
+                      sr["baseline_bytes_per_pass"],
+                  "steady_ring_hits": sr["steady_ring_hits"]}))
+EOF
+# (b) live ringed ModelServer drill: warmup warms every slot + the
+# donated program, repeated same-payload traffic hits the ring (zero
+# re-ship, zero retraces), and the ring state rides /statusz with
+# sparkdl_ship_ring_* (+ HELP) on /metricsz. Then (c) the per-device
+# transfer-interleave drill over the 8 virtual devices: >= 1.2x
+# aggregate placement throughput over serial FIFO when >= 2 cores
+# exist; on a 1-core host the measured serial win is printed and the
+# degrade asserted — gated, never silently skipped.
+SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
+import json
+import os
+import re
+import time
+import urllib.request
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry, start_telemetry
+from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+reg = default_registry()
+cores = os.cpu_count() or 1
+
+mf = ModelFunction.fromSingle(lambda x: x * 2.0, None,
+                              input_shape=(4,), name="ring_drill")
+server = ModelServer(ServeConfig(max_wait_s=0.0))
+session = server.register("ring", mf, batch_size=4, infeed_ring=2)
+assert session.runner.infeed_ring == 2, session.runner.infeed_ring
+warmed = server.warmup()
+assert warmed == {"ring": True}, warmed
+
+retr0 = reg.counter("compile.unexpected_retraces").value
+hits0 = reg.counter("ship.ring_hits").value
+resh0 = reg.counter("ship.bytes_reshipped").value
+x = np.ones((4, 4), np.float32)
+ref = server.submit({"input": x}).result(timeout=60)
+for _ in range(7):                       # the repeated corpus
+    out = server.submit({"input": x}).result(timeout=60)
+    np.testing.assert_array_equal(out["output"], ref["output"])
+np.testing.assert_allclose(out["output"], x * 2.0)
+hits = reg.counter("ship.ring_hits").value - hits0
+assert hits >= 6, f"repeated serve corpus earned only {hits} ring hits"
+assert reg.counter("ship.bytes_reshipped").value == resh0, \
+    "live ringed serve traffic re-shipped bytes"
+assert reg.counter("compile.unexpected_retraces").value == retr0, \
+    "ringed serve traffic retraced after warmup"
+
+tel = start_telemetry()
+with urllib.request.urlopen(tel.url("/statusz"), timeout=5) as r:
+    st = json.load(r)
+runner_st = st["servers"][0]["models"]["ring"]["runner"]
+assert runner_st["infeed_ring"] == 2, runner_st
+ring_st = runner_st["ring"]
+assert ring_st and ring_st["depth"] == 2 and ring_st["hits"] >= 6, \
+    ring_st
+with urllib.request.urlopen(tel.url("/metricsz"), timeout=5) as r:
+    body = r.read().decode()
+assert re.search(r"^sparkdl_ship_ring_hits ", body, re.M), body[:400]
+assert re.search(r"^# HELP sparkdl_ship_ring_hits ", body, re.M)
+assert re.search(r"^sparkdl_ship_ring_depth ", body, re.M)
+tel.close()
+server.close()
+
+# -- (c) interleaved per-device transfer streams ---------------------
+import jax
+
+from sparkdl_tpu.parallel.mesh import data_sharding, make_mesh
+from sparkdl_tpu.runtime.runner import interleaved_device_put
+
+devs = jax.local_devices()
+assert len(devs) >= 2, devs              # the 8-virtual-device mesh
+mesh = make_mesh(devices=devs)
+dat = data_sharding(mesh)
+v = np.random.default_rng(2).random(
+    (len(devs) * 512, 1024)).astype(np.float32)
+
+
+def serial_once():
+    imap = dat.addressable_devices_indices_map(v.shape)
+    shards = [jax.device_put(v[idx], d) for d, idx in imap.items()]
+    jax.make_array_from_single_device_arrays(
+        v.shape, dat, shards).block_until_ready()
+
+
+def inter_once():
+    placed = interleaved_device_put({"x": v}, dat, 4)
+    assert placed is not None, "interleave degraded on a multi-device mesh"
+    placed["x"].block_until_ready()
+
+
+# row identity through the interleaved path, then timed best-of-3
+placed = interleaved_device_put({"x": v}, dat, 4)
+np.testing.assert_array_equal(np.asarray(placed["x"]), v)
+serial_once(); inter_once()              # warm both paths
+
+
+def best(fn, n=3):
+    b = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        b = min(b, time.perf_counter() - t0)
+    return b
+
+
+ts, ti = best(serial_once), best(inter_once)
+ratio = ts / ti
+if cores >= 2:
+    assert ratio >= 1.2, \
+        (f"interleaved placement only {ratio:.2f}x serial on a "
+         f"{cores}-core host (serial {ts * 1e3:.1f}ms vs "
+         f"interleaved {ti * 1e3:.1f}ms)")
+else:
+    # 1-core degrade, visibly: one physical lane cannot overlap its
+    # own transfers — the measured loss is the expected verdict here,
+    # and a multi-core host runs the real >= 1.2x gate above
+    print(f"interleave drill DEGRADED on a {cores}-core host: "
+          f"{ratio:.2f}x vs serial (expected < 1.2x — thread "
+          f"overhead on one physical lane); the >= 1.2x gate needs "
+          f">= 2 cores")
+    assert cores < 2
+print(json.dumps({"ring_serve_gate": "ok", "cores": cores,
+                  "serve_ring_hits": int(hits),
+                  "interleave_ratio": round(ratio, 3),
+                  "interleave_gated": cores >= 2}))
 EOF
 
 echo "== ci.sh: ALL GREEN =="

@@ -33,7 +33,14 @@ CPU). ``--sweep --workers 0,2,4`` adds the parallel host pipeline's
 axis (data/pipeline.py): the fused decode→pack pipeline measured
 through a pooled ``LocalEngine`` at each worker count (0 = serial) —
 the measured priors behind ``PipelineTarget``'s worker/read-ahead
-bounds on this host.
+bounds on this host. ``--sweep --ring 0,2,4`` adds the device-resident
+infeed ring's axis (runtime/runner.py InfeedRing): a repeated-corpus
+steady pass at each ring depth (0 = no ring) with the steady pass's
+ring hits and re-shipped bytes alongside rows/s — the measured priors
+behind ``RunnerTarget``'s ``infeed_ring`` bound. ``--sweep
+--interleave 0,2,4`` adds the per-device transfer stream axis:
+aggregate host->device placement MB/s over this host's local devices,
+serial FIFO vs ``interleaved_device_put`` at each width.
 
 Prints one JSON object; run on the real chip (no JAX_PLATFORMS
 override) or CPU. Results feed BatchRunner's strategy choice,
@@ -207,6 +214,96 @@ def _sweep(model: str, batch: int, rows: int,
     return grid
 
 
+def _ring_sweep(model: str, batch: int, rows: int, depths) -> list:
+    """The infeed ring's depth axis through the production BatchRunner
+    (prefetch strategy — the ring rides the placement look-ahead):
+    warmup, one fill pass, then best-of-2 REPEATED-corpus steady
+    passes. The steady pass's ring hits and re-shipped bytes ride
+    along so the prior records not just rows/s but whether the corpus
+    actually fit (corpus_chunks > depth thrashes honestly and the
+    numbers say so)."""
+    from sparkdl_tpu.models.zoo import getModelFunction
+    from sparkdl_tpu.obs import default_registry
+    from sparkdl_tpu.runtime.runner import BatchRunner, warmup_runner
+
+    reg = default_registry()
+    mf = getModelFunction(model, featurize=True)
+    in_name = mf.input_names[0]
+    shape, dtype = mf.input_signature[in_name]
+    images = np.random.default_rng(2).integers(
+        0, 255, size=(rows,) + tuple(shape)).astype(dtype)
+    grid = []
+    for depth in depths:
+        runner = BatchRunner(mf, batch_size=batch, strategy="prefetch",
+                             infeed_ring=depth)
+        warmup_runner(runner)
+        runner.run({in_name: images})            # fill pass
+        h0 = reg.counter("ship.ring_hits").value
+        r0 = reg.counter("ship.bytes_reshipped").value
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            runner.run({in_name: images})
+            best = max(best, rows / (time.perf_counter() - t0))
+        grid.append({
+            "ring": int(runner.infeed_ring),
+            "corpus_chunks": -(-rows // batch),
+            "rows_per_s": round(best, 1),
+            "steady_ring_hits": int(
+                reg.counter("ship.ring_hits").value - h0),
+            "steady_bytes_reshipped": int(
+                reg.counter("ship.bytes_reshipped").value - r0)})
+    return grid
+
+
+def _interleave_sweep(widths, target_mb: int = 8) -> list:
+    """The per-device transfer stream axis: aggregate host->device
+    placement MB/s over this host's local devices at each interleave
+    width (0/1 = serial FIFO ``device_put`` per device shard), best of
+    3 passes. On a single-device host every width measures the serial
+    path — the degrade the production dispatch takes too."""
+    import jax
+
+    from sparkdl_tpu.parallel.mesh import data_sharding, make_mesh
+    from sparkdl_tpu.runtime.runner import interleaved_device_put
+
+    devs = jax.local_devices()
+    mesh = make_mesh(devices=devs)
+    dat = data_sharding(mesh)
+    n = len(devs)
+    row_bytes = 1024 * 4                          # float32 row
+    rows = n * max(1, (target_mb * 1024 * 1024) // (n * row_bytes))
+    v = np.random.default_rng(2).random((rows, 1024)).astype(np.float32)
+    nbytes = v.nbytes
+
+    def serial() -> None:
+        imap = dat.addressable_devices_indices_map(v.shape)
+        shards = [jax.device_put(v[idx], d) for d, idx in imap.items()]
+        jax.make_array_from_single_device_arrays(
+            v.shape, dat, shards).block_until_ready()
+
+    grid = []
+    for w in widths:
+        w = int(w)
+        best, mode = 0.0, "serial"
+        for _ in range(3):
+            t0 = time.perf_counter()
+            if w >= 2 and n >= 2:
+                placed = interleaved_device_put({"x": v}, dat, w)
+                if placed is None:
+                    serial()
+                else:
+                    placed["x"].block_until_ready()
+                    mode = "interleaved"
+            else:
+                serial()
+            best = max(best,
+                       nbytes / (time.perf_counter() - t0) / 1e6)
+        grid.append({"interleave": w, "devices": n, "mode": mode,
+                     "mb_per_s": round(best, 1)})
+    return grid
+
+
 def _workers_sweep(counts, n_images: int = 48,
                    size=(64, 64)) -> list:
     """The parallel host pipeline's worker axis: a fused
@@ -286,6 +383,16 @@ def main() -> None:
                              "(0 = serial; e.g. 0,2,4) — the measured "
                              "priors behind the PipelineTarget knob "
                              "bounds (docs/PERFORMANCE.md)")
+    parser.add_argument("--ring", default=None,
+                        help="comma-separated infeed-ring depths to "
+                             "sweep with --sweep (0 = no ring; e.g. "
+                             "0,2,4) — the measured priors behind "
+                             "RunnerTarget's infeed_ring bound")
+    parser.add_argument("--interleave", default=None,
+                        help="comma-separated transfer-interleave "
+                             "widths to sweep with --sweep (0/1 = "
+                             "serial FIFO; e.g. 0,2,4) — aggregate "
+                             "device_put MB/s over local devices")
     args = parser.parse_args()
 
     platform = jax.devices()[0].platform
@@ -300,6 +407,15 @@ def main() -> None:
             counts = [int(tok) for tok in args.workers.split(",")
                       if tok.strip() != ""]
             report["workers_sweep"] = _workers_sweep(counts)
+        if args.ring is not None:
+            depths = [int(tok) for tok in args.ring.split(",")
+                      if tok.strip() != ""]
+            report["ring_sweep"] = _ring_sweep(
+                args.model, batch, rows, depths)
+        if args.interleave is not None:
+            widths = [int(tok) for tok in args.interleave.split(",")
+                      if tok.strip() != ""]
+            report["interleave_sweep"] = _interleave_sweep(widths)
         print(json.dumps(report))
         return
     batch = args.batch or (256 if on_tpu else 8)
