@@ -133,7 +133,9 @@ def main(argv=None) -> int:
                     "blocking under a lock, H9 docs contract drift, "
                     "H10 jit-purity closure, H11 resource lifecycle, "
                     "H14 hot-path host syncs, H15 missing buffer "
-                    "donation, H16 dtype widening). "
+                    "donation, H16 dtype widening, and the static "
+                    "race rules: H17 unguarded access, H18 unsafe "
+                    "publication, H19 atomicity split). "
                     "Rule reference: docs/LINT.md")
     parser.add_argument(
         "paths", nargs="*",

@@ -36,9 +36,11 @@ from sparkdl_tpu.analysis.findings import Finding
 #: (v5: the effect-system facts — ModuleFacts.effects — joined the
 #: per-file schema; v6: rule H13 unbounded-retry-loops; v7: the
 #: device-dataflow facts — ModuleFacts.flows, rules H14–H16 — joined
-#: the per-file schema; a version bump MUST force a cold re-analysis,
-#: pinned by tests/test_effects.py)
-ANALYZER_VERSION = 7
+#: the per-file schema; v8: the thread/race facts —
+#: ModuleFacts.threads + class_guards, rules H17–H19; a version bump
+#: MUST force a cold re-analysis, pinned by tests/test_effects.py and
+#: tests/test_races.py)
+ANALYZER_VERSION = 8
 
 
 def default_cache_path() -> str:

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from sparkdl_tpu.analysis import dataflow as _dataflow
 from sparkdl_tpu.analysis import effects as _effects
+from sparkdl_tpu.analysis import threads as _threads
 from sparkdl_tpu.analysis.locks import (
     CallEvent,
     FunctionFacts,
@@ -97,17 +98,26 @@ class ModuleFacts:
     #: per-function device-dataflow facts (dataflow.py), same keys
     flows: Dict[str, "_dataflow.DeviceFlow"] = \
         field(default_factory=dict)
+    #: per-function thread/race facts (threads.py), same keys
+    threads: Dict[str, "_threads.ThreadFacts"] = \
+        field(default_factory=dict)
+    #: class name -> attrs its ``_lock_guards`` declares (the H3
+    #: convention, authoritative for guarded-by inference)
+    class_guards: Dict[str, List[str]] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"module": self.module, "path": self.path,
                 "imports": self.imports, "classes": self.classes,
                 "functions": self.functions,
                 "module_locks": self.module_locks,
+                "class_guards": self.class_guards,
                 "facts": {k: f.to_dict() for k, f in self.facts.items()},
                 "effects": {k: e.to_dict()
                             for k, e in self.effects.items()},
                 "flows": {k: fl.to_dict()
-                          for k, fl in self.flows.items()}}
+                          for k, fl in self.flows.items()},
+                "threads": {k: t.to_dict()
+                            for k, t in self.threads.items()}}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ModuleFacts":
@@ -122,7 +132,27 @@ class ModuleFacts:
                       for k, v in d.get("effects", {}).items()}
         mf.flows = {k: _dataflow.DeviceFlow.from_dict(v)
                     for k, v in d.get("flows", {}).items()}
+        mf.threads = {k: _threads.ThreadFacts.from_dict(v)
+                      for k, v in d.get("threads", {}).items()}
+        mf.class_guards = {k: list(v) for k, v in
+                           d.get("class_guards", {}).items()}
         return mf
+
+
+def _class_guards(node: ast.ClassDef) -> List[str]:
+    """The class-body ``_lock_guards = ("field", ...)`` declaration
+    (the H3 convention — writes to these hold ``self._lock``), made
+    visible to the program-level guarded-by inference (races.py)."""
+    for item in node.body:
+        if not isinstance(item, ast.Assign):
+            continue
+        for tgt in item.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "_lock_guards" \
+                    and isinstance(item.value, (ast.Tuple, ast.List)):
+                return sorted({e.value for e in item.value.elts
+                               if isinstance(e, ast.Constant)
+                               and isinstance(e.value, str)})
+    return []
 
 
 def _collect_imports(tree: ast.Module) -> Dict[str, str]:
@@ -181,6 +211,8 @@ def scan_module(tree: ast.Module, path: str,
             fe.jit_line = fn.lineno
         mf.effects[key] = fe
         mf.flows[key] = _dataflow.scan_flow(fn, key, mf.imports, cls)
+        mf.threads[key] = _threads.scan_threads(
+            fn, key, module, path, cls, qualname, locks, mf.imports)
         name_keys.setdefault(fn.name, []).append(key)
 
     def iter_defs(body):
@@ -221,6 +253,9 @@ def scan_module(tree: ast.Module, path: str,
                 mf.classes[node.name] = methods
                 cls_mutables.setdefault(
                     node.name, _effects.mutable_class_attrs(node))
+                guards = _class_guards(node)
+                if guards:
+                    mf.class_guards[node.name] = guards
                 walk_defs(node.body, node.name + ".", node.name, {})
 
     walk_defs(tree.body, "", None, {})
@@ -339,6 +374,22 @@ class CallGraph:
             for c in f.calls:
                 c.held = tuple(h2 for h2 in (norm(h) for h in c.held)
                                if h2 is not None)
+        # the thread/race facts carry the same lock ids in their
+        # region tuples — same normalization, or a candidate spelling
+        # ("?mod::attr") would never match its confirmed one and the
+        # race rules would see "no common lock" where there is one
+        for m in self.modules.values():
+            for tf in getattr(m, "threads", {}).values():
+                for a in tf.accesses:
+                    a.regions = tuple(
+                        (lk, ln) for lk, ln in
+                        ((norm(lk0), ln0) for lk0, ln0 in a.regions)
+                        if lk is not None)
+                tf.local_muts = [
+                    (n, ln, tuple(h2 for h2 in
+                                  (norm(h) for h in held)
+                                  if h2 is not None))
+                    for n, ln, held in tf.local_muts]
 
     def _match_module(self, dotted: str) -> Optional[str]:
         """The analyzed module an import path names: exact, else by

@@ -40,6 +40,7 @@ from sparkdl_tpu.analysis.dataflow import check_h14, check_h15, check_h16
 from sparkdl_tpu.analysis.effects import check_h10, check_h11
 from sparkdl_tpu.analysis.findings import Finding
 from sparkdl_tpu.analysis.locks import FunctionFacts
+from sparkdl_tpu.analysis.races import check_h17, check_h18, check_h19
 
 
 def short_lock(lock: str) -> str:
@@ -228,7 +229,8 @@ def _held_str(held: Tuple[str, ...]) -> str:
 #: because it needs the docs tree, not the call graph; H10/H11 live
 #: in effects.py with the effect closure they consume; H14–H16 live
 #: in dataflow.py with the device-dataflow replay + hot-path
-#: classification they run on)
+#: classification they run on; H17–H19 live in races.py with the
+#: thread topology + guarded-by model they share)
 PROGRAM_RULES = {
     "H7": check_h7,
     "H8": check_h8,
@@ -237,4 +239,7 @@ PROGRAM_RULES = {
     "H14": check_h14,
     "H15": check_h15,
     "H16": check_h16,
+    "H17": check_h17,
+    "H18": check_h18,
+    "H19": check_h19,
 }

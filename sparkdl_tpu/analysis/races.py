@@ -1,0 +1,421 @@
+"""H17/H18/H19 — the RacerD-style lock-consistency rules.
+
+These run on two layers at once: the PR-8/9 lock model (which lock is
+held at each statement, with class-scoped lock identity) and the
+thread topology (``threads.py`` — which OS threads may execute each
+function, with a witness chain to every root). Neither layer alone
+can see a race: the lock model has no threads, the topology has no
+locks. Together they support the classic guarded-by argument.
+
+**Guarded-by inference.** For each class attribute the package
+touches (``self.X`` accesses collected per ``module::Class``), vote:
+a lock guards the attribute when it is held at a strict majority of
+the non-``__init__`` accesses AND at >= 2 of them (one guarded site
+is an accident; two is a convention). A class-body ``_lock_guards``
+declaration (the H3 convention) is AUTHORITATIVE when present — no
+vote, the guard is ``self._lock``, and the declaration wins even if
+the majority disagrees, because a human wrote it down. Construction
+paths (``__init__`` and friends) never vote and are never flagged:
+before the object escapes its constructor there is no second thread.
+
+**H17 — unguarded access.** A read/write/mutation of an inferred-
+guarded attribute, from a function at least two threads may execute,
+without the guarding lock held. The witness names both threads (the
+spawn root's label and chain, plus the implicit main thread), the
+lock identity, and the vote that made the attribute guarded. Plain
+WRITES to a ``_lock_guards``-declared attribute inside the declaring
+class are H3's beat (the per-file rule already flags them) — H17
+skips those so one decision never needs two suppressions.
+
+**H18 — unsafe publication.** A mutable local (list/dict/set/deque
+binding) handed across a thread boundary — as a ``Thread``/``submit``
+argument or captured by a nested def that becomes the spawn target —
+then mutated on BOTH sides with no lock common to all the mutation
+sites. Each side's mutation lines are named; "no common lock" is the
+evidence, so adding ANY shared lock (or handing over an immutable
+snapshot) clears it.
+
+**H19 — atomicity split.** A check of a guarded attribute (a read in
+an ``if``/``while`` test) under the guard, whose lock scope ends
+before a later write/mutation of the same attribute under a SEPARATE
+hold of the same guard, in a function >= 2 threads may execute. Both
+holds are correct in isolation — H17 sees nothing — but the decision
+made under the first hold is stale by the second: the classic TOCTOU
+on ``self._closed`` / queue-depth patterns. The region identity that
+tells two holds of one lock apart is scanned per-function by
+``threads.py`` (``with`` holds keyed by their opening line;
+``acquire()`` regions by the acquire line).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkdl_tpu.analysis.callgraph import CallGraph
+from sparkdl_tpu.analysis.findings import Finding
+from sparkdl_tpu.analysis.threads import (
+    AccessEvent,
+    ThreadFacts,
+    thread_topology,
+)
+
+#: construction/serialization paths never vote and are never flagged:
+#: no second thread can hold the object yet (mirrors H3's exemption)
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__",
+                   "__setstate__", "__getstate__", "__del__",
+                   "__repr__"}
+
+#: inference thresholds: a lock guards an attr when held at >= 2
+#: accesses AND a strict majority — one guarded site is an accident
+_MIN_GUARDED_SITES = 2
+
+
+def _short(key: str) -> str:
+    mod, _, qual = key.partition("::")
+    mod = mod[len("sparkdl_tpu."):] if mod.startswith("sparkdl_tpu.") \
+        else mod
+    return f"{mod}:{qual}" if qual else mod
+
+
+# ---------------------------------------------------------------------------
+# guarded-by inference
+
+
+@dataclass
+class GuardInfo:
+    """Why an attribute is considered lock-guarded."""
+
+    lock: str                   # canonical lock id
+    declared: bool              # _lock_guards said so (authoritative)
+    guarded: int = 0            # majority vote: sites with the lock
+    total: int = 0              # ... out of this many accesses
+
+    def evidence(self) -> str:
+        if self.declared:
+            return "declared by `_lock_guards`"
+        return (f"majority evidence: {_short(self.lock)} held at "
+                f"{self.guarded} of {self.total} accesses")
+
+
+class GuardModel:
+    """guarded-by facts for one CallGraph: ``(module::Class, attr)``
+    -> :class:`GuardInfo`, plus the per-function access inventory the
+    rules iterate (exempt methods already dropped)."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.guards: Dict[Tuple[str, str], GuardInfo] = {}
+        #: fn key -> (class key, non-exempt accesses) for methods
+        self.method_accesses: Dict[
+            str, Tuple[str, List[AccessEvent]]] = {}
+        self._build()
+
+    def _build(self) -> None:
+        votes: Dict[Tuple[str, str], Dict[str, int]] = {}
+        totals: Dict[Tuple[str, str], int] = {}
+        for m in self.graph.modules.values():
+            for key, tf in getattr(m, "threads", {}).items():
+                f = self.graph.functions.get(key)
+                if f is None:
+                    continue
+                parts = f.qualname.split(".")
+                if len(parts) < 2 or parts[0] not in m.classes:
+                    continue
+                if parts[1] in _EXEMPT_METHODS:
+                    continue
+                ck = f"{m.module}::{parts[0]}"
+                self.method_accesses[key] = (ck, tf.accesses)
+                for a in tf.accesses:
+                    slot = (ck, a.attr)
+                    totals[slot] = totals.get(slot, 0) + 1
+                    table = votes.setdefault(slot, {})
+                    for lock in a.held:
+                        table[lock] = table.get(lock, 0) + 1
+        # the vote
+        for slot, total in totals.items():
+            table = votes.get(slot, {})
+            best = max(table, key=lambda lk: (table[lk], lk)) \
+                if table else None
+            if best is not None and \
+                    table[best] >= _MIN_GUARDED_SITES and \
+                    table[best] * 2 > total:
+                self.guards[slot] = GuardInfo(
+                    best, False, table[best], total)
+        # _lock_guards declarations override the vote
+        for m in self.graph.modules.values():
+            for cls, attrs in getattr(m, "class_guards", {}).items():
+                ck = f"{m.module}::{cls}"
+                lock = f"{m.module}::{cls}._lock"
+                for attr in attrs:
+                    slot = (ck, attr)
+                    have = self.guards.get(slot)
+                    self.guards[slot] = GuardInfo(
+                        lock, True,
+                        have.guarded if have and have.lock == lock
+                        else 0,
+                        have.total if have else 0)
+
+    #: declared slots, for "H3 owns plain writes" coordination
+    def is_declared(self, ck: str, attr: str) -> bool:
+        gi = self.guards.get((ck, attr))
+        return gi is not None and gi.declared
+
+
+def _guard_model(graph: CallGraph) -> GuardModel:
+    state = getattr(graph, "_sparkdl_guard_model", None)
+    if state is None:
+        state = GuardModel(graph)
+        graph._sparkdl_guard_model = state
+    return state
+
+
+def _all_threads(graph: CallGraph) -> Dict[str, ThreadFacts]:
+    out: Dict[str, ThreadFacts] = {}
+    for m in graph.modules.values():
+        out.update(getattr(m, "threads", {}) or {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# H17 — unguarded access to a guarded attribute
+
+
+_VERB = {"read": "read", "write": "written", "mut": "mutated",
+         "check": "read (in a branch test)"}
+
+
+def check_h17(graph: CallGraph) -> List[Finding]:
+    topo = thread_topology(graph)
+    model = _guard_model(graph)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for key, (ck, accesses) in sorted(model.method_accesses.items()):
+        if not topo.is_concurrent(key):
+            continue
+        f = graph.functions[key]
+        for a in accesses:
+            gi = model.guards.get((ck, a.attr))
+            if gi is None or gi.lock in a.held:
+                continue
+            if a.kind == "write" and gi.declared:
+                continue    # the per-file H3 owns plain writes
+            marker = (f.path, a.line, a.attr)
+            if marker in seen:
+                continue
+            seen.add(marker)
+            findings.append(Finding(
+                rule="H17", path=f.path, line=a.line, col=0,
+                qualname=f.qualname,
+                message=(
+                    f"`self.{a.attr}` {_VERB[a.kind]} without holding "
+                    f"{_short(gi.lock)}: the attribute is lock-guarded "
+                    f"({gi.evidence()}) and {_short(key)} is reachable "
+                    f"by {topo.witness(key)} — hold the lock around "
+                    "this access or suppress with `# sparkdl-lint: "
+                    "allow[H17] -- <why unguarded is safe here>`")))
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# H18 — unsafe publication of mutable state
+
+
+def _lock_token(lock: str) -> str:
+    """Function-scoped lock ids (``module::qual.<name>`` — a local or
+    parameter named like a lock) compare by their bare ``<name>``: the
+    same lexical lock seen from a spawner and from the nested def it
+    hands work to carries two qualnames but one name. An over-
+    approximation in the conservative direction — a false "common
+    lock" only mutes a finding."""
+    mod, sep, qual = lock.partition("::")
+    if sep and qual.endswith(">") and "<" in qual:
+        return qual[qual.rindex("<"):]
+    return lock
+
+
+def _common_lock(*held_sets: Tuple[str, ...]) -> Optional[str]:
+    """A lock held at EVERY site, or None."""
+    if not held_sets:
+        return None
+    common = {_lock_token(lk) for lk in held_sets[0]}
+    for held in held_sets[1:]:
+        common &= {_lock_token(lk) for lk in held}
+    return sorted(common)[0] if common else None
+
+
+def check_h18(graph: CallGraph) -> List[Finding]:
+    topo = thread_topology(graph)
+    tfacts = _all_threads(graph)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for key in sorted(tfacts):
+        tf = tfacts[key]
+        f = graph.functions.get(key)
+        if f is None:
+            continue
+        for sp in tf.spawns:
+            targets = topo._spawn_targets(f, sp)
+            for tkey in targets:
+                ttf = tfacts.get(tkey)
+                if ttf is None:
+                    continue
+                _h18_args(findings, seen, graph, f, tf, sp, tkey, ttf)
+                _h18_capture(findings, seen, graph, f, tf, sp, tkey,
+                             ttf, key)
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings
+
+
+def _h18_args(findings, seen, graph, f, tf, sp, tkey, ttf):
+    """The argument hand-off shape: ``Thread(target=w, args=(buf,))``
+    / ``pool.submit(w, buf)`` where ``buf`` is a mutable local the
+    spawner keeps mutating and the target mutates its mapped param."""
+    for idx, ref in enumerate(sp.args):
+        if not ref or "." in ref or ref not in tf.mutable_locals:
+            continue
+        ours = [(ln, held) for n, ln, held in tf.local_muts
+                if n == ref and ln > sp.line]
+        if not ours:
+            continue
+        if idx >= len(ttf.params):
+            continue
+        param = ttf.params[idx]
+        theirs = [(ln, held) for n, ln, held in ttf.local_muts
+                  if n == param]
+        if not theirs:
+            continue
+        if _common_lock(*[h for _, h in ours],
+                        *[h for _, h in theirs]) is not None:
+            continue
+        marker = (f.path, sp.line, ref)
+        if marker in seen:
+            continue
+        seen.add(marker)
+        our_lines = ", ".join(str(ln) for ln, _ in ours[:3])
+        their_lines = ", ".join(str(ln) for ln, _ in theirs[:3])
+        findings.append(Finding(
+            rule="H18", path=f.path, line=sp.line, col=0,
+            qualname=f.qualname,
+            message=(
+                f"mutable local `{ref}` handed to `{sp.display}` "
+                f"({_h18_kind(sp)}) and mutated on both sides with no "
+                f"common lock: {_short(f.key)} keeps mutating it "
+                f"(line {our_lines}) while {_short(tkey)} mutates its "
+                f"`{param}` parameter (line {their_lines}) — guard "
+                "both sides with one lock, hand over an immutable "
+                "snapshot, or suppress with `# sparkdl-lint: "
+                "allow[H18] -- <why the sharing is safe>`")))
+
+
+def _h18_capture(findings, seen, graph, f, tf, sp, tkey, ttf, key):
+    """The closure-capture shape: the spawn target is a def nested in
+    the spawner, mutating a mutable local it captured (not a param,
+    not rebound locally) that the spawner mutates too."""
+    if not tkey.startswith(key + "."):
+        return
+    for n, ln, held in ttf.local_muts:
+        if n in ttf.params or n in ttf.locals_bound:
+            continue
+        if n not in tf.mutable_locals:
+            continue
+        ours = [(ln2, h) for n2, ln2, h in tf.local_muts if n2 == n]
+        if not ours:
+            continue
+        theirs = [(ln2, h) for n2, ln2, h in ttf.local_muts
+                  if n2 == n]
+        if _common_lock(*[h for _, h in ours],
+                        *[h for _, h in theirs]) is not None:
+            continue
+        marker = (f.path, sp.line, n)
+        if marker in seen:
+            continue
+        seen.add(marker)
+        findings.append(Finding(
+            rule="H18", path=f.path, line=sp.line, col=0,
+            qualname=f.qualname,
+            message=(
+                f"mutable local `{n}` captured by `{sp.display}` "
+                f"({_h18_kind(sp)}) and mutated on both sides with no "
+                f"common lock: {_short(key)} mutates it at line "
+                f"{ours[0][0]} while the captured {_short(tkey)} "
+                f"mutates it at line {theirs[0][0]} — guard both "
+                "sides with one lock or suppress with "
+                "`# sparkdl-lint: allow[H18] -- <why>`")))
+
+
+def _h18_kind(sp) -> str:
+    return {"thread": "a thread target", "timer": "a timer callback",
+            "pool": "an executor task",
+            "callback": "a future done-callback",
+            "http": "a per-request handler",
+            "signal": "a signal handler"}.get(sp.kind, sp.kind)
+
+
+# ---------------------------------------------------------------------------
+# H19 — atomicity split (check-then-act across separate holds)
+
+
+def check_h19(graph: CallGraph) -> List[Finding]:
+    topo = thread_topology(graph)
+    model = _guard_model(graph)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for key, (ck, accesses) in sorted(model.method_accesses.items()):
+        if not topo.is_concurrent(key):
+            continue
+        f = graph.functions[key]
+        by_attr: Dict[str, List[AccessEvent]] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, events in by_attr.items():
+            gi = model.guards.get((ck, attr))
+            if gi is None:
+                continue
+            checks = [(a, dict(a.regions).get(gi.lock))
+                      for a in events if a.kind == "check"
+                      and gi.lock in a.held]
+            acts = [(a, dict(a.regions).get(gi.lock))
+                    for a in events if a.kind in ("write", "mut")
+                    and gi.lock in a.held]
+            # double-checked locking is the REMEDY, not the hazard: an
+            # act whose own hold re-checks the attribute first (a
+            # check in the same region, at or before the act) made the
+            # stale first check harmless — exempt those regions
+            rechecked: Dict[Tuple[str, int], int] = {}
+            for a, region in checks:
+                if region is not None:
+                    slot = (a.attr, region)
+                    rechecked[slot] = min(
+                        rechecked.get(slot, a.line), a.line)
+            for chk, chk_region in checks:
+                for act, act_region in acts:
+                    if act.line <= chk.line or \
+                            act_region == chk_region:
+                        continue
+                    recheck = rechecked.get((act.attr, act_region))
+                    if recheck is not None and recheck <= act.line:
+                        continue
+                    marker = (f.path, act.line, attr)
+                    if marker in seen:
+                        continue
+                    seen.add(marker)
+                    findings.append(Finding(
+                        rule="H19", path=f.path, line=act.line, col=0,
+                        qualname=f.qualname,
+                        message=(
+                            f"check-then-act split on `self.{attr}`: "
+                            f"checked under {_short(gi.lock)} at line "
+                            f"{chk.line} but acted on under a "
+                            f"SEPARATE hold at line {act.line} — the "
+                            "lock was dropped in between, so the "
+                            "checked condition can be stale (TOCTOU); "
+                            f"{_short(key)} is reachable by "
+                            f"{topo.witness(key)} — widen one hold "
+                            "over both, re-check under the second, or "
+                            "suppress with `# sparkdl-lint: "
+                            "allow[H19] -- <why staleness is safe>`")))
+                    break
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings

@@ -930,6 +930,23 @@ _RULE_DOCS = {
            "a device-tracked value on a hot function — the promoted "
            "float64 payload is a silent 2x byte tax on a link-bound "
            "pipeline; pin the dtype at the producer",
+    "H17": "unguarded access to a guarded attribute (whole-program): "
+           "a read/write of a class attribute the guarded-by "
+           "inference ties to a lock (majority of accesses hold it, "
+           "or `_lock_guards` declares it), from a function >= 2 "
+           "threads may execute (thread-topology reachability over "
+           "the call graph), without the guard held — the witness "
+           "names both thread roots, the lock, and the vote",
+    "H18": "unsafe publication (whole-program): a mutable local "
+           "handed across a thread boundary — Thread/Timer args, "
+           "executor submit/map, a done-callback, or closure capture "
+           "by the spawned def — then mutated on both sides with no "
+           "common lock; hand over a snapshot or share a lock",
+    "H19": "atomicity split (whole-program): check-then-act on a "
+           "guarded attribute where the check's lock hold ends "
+           "before the acting hold — both sides locked, decision "
+           "stale (the TOCTOU on self._closed / queue-depth "
+           "patterns); widen one hold over both",
 }
 
 

@@ -90,6 +90,15 @@ DEFAULT_ALLOWLIST: Dict[str, Tuple[AllowEntry, ...]] = {
             "timed — a hot path may materialize HERE and nowhere "
             "else"),
     ),
+    "H17": (
+        AllowEntry(
+            "sparkdl_tpu/obs/registry.py", "Reservoir._offer_exemplar",
+            "caller-holds contract: observe() wraps every call in "
+            "self._lock (the same decision the method's inline H3 "
+            "suppressions document, lifted to one entry instead of "
+            "five line annotations); the private-helper shape is "
+            "runtime-asserted elsewhere under SPARKDL_TPU_SANITIZE=1"),
+    ),
     "H8": (
         AllowEntry(
             "sparkdl_tpu/serve/batching.py", "RequestQueue.collect",

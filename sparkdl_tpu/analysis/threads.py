@@ -1,0 +1,744 @@
+"""Thread-topology inference for the H17–H19 race rules.
+
+Rules H1–H16 model locks (H7/H8) without ever asking
+*which thread* executes a function — so an unguarded shared-attribute
+write from a pool done-callback is invisible: the lock model sees no
+lock and the per-file H3 sees no ``_lock_guards`` violation. This
+module adds the missing axis. Per function, one scan records a
+serializable fact set (:class:`ThreadFacts`):
+
+* **spawn events** — every place a callable is handed to another
+  thread: ``threading.Thread(target=...)`` / ``Timer``, executor
+  ``submit``/``map`` (pool-shaped receivers), ``add_done_callback``
+  (directly or through a single-call lambda), ``ThreadingHTTPServer``
+  handler classes (their ``do_*`` methods run one-thread-per-request),
+  and ``signal.signal`` handlers;
+* **shared-attribute accesses** — every ``self.X`` read / write /
+  container mutation / branch-test check, each carrying the exact
+  lock *regions* lexically held at that point (``with self._lock:``
+  blocks keyed by their opening line; ``acquire()``..``release()``
+  line regions). Regions — not just held sets — are what lets H19
+  see a check and an act under the SAME lock but in SEPARATE holds;
+* **publication material** — mutable locals (list/dict/set/deque
+  bindings), local mutations with their held sets, and parameter
+  mutations, which is what H18's hand-off analysis runs on.
+
+At program time :class:`ThreadTopology` resolves every spawn target
+through the PR-8 call graph (same lexical contract as ``may_block``,
+plus the nested-def rule hot-path classification uses) into a **thread
+-root inventory**, then flows thread context DOWN the call graph
+exactly like ``hotpath.py`` hotness: every function carries the set of
+thread roots that may execute it plus a witness chain back to each
+root. The main thread is implicit — any function the program can call
+runs on it — so "reachable by >= 2 threads" reduces to "reachable
+from >= 1 spawn root" (plus the class rule below), and a function
+no spawn root reaches stays single-threaded and exempt.
+
+**The class rule.** A method nobody calls from a thread root can
+still race: ``StallWatchdog.arm()`` runs on the caller's thread while
+``_monitor`` (the spawned root) reads the same instance state. So a
+method of class ``C`` is also considered concurrent when ANY method
+of ``C`` is thread-root-reachable — the instance is shared with that
+thread, and the witness names the sibling method that carries the
+root (RacerD's ownership idea, reduced to lexical classes).
+
+Known loops that are roots by construction (the serve dispatcher, the
+watchdog monitor, the autotune apply path — driven by ``poll()`` from
+every hot-loop thread at once) sit in :data:`KNOWN_THREAD_ROOTS`, the
+``EXTRA_HOT_ROOTS`` precedent: spawn-site detection finds them too,
+but the table keeps them roots even when the spawn site moves.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# NOTE: no import of callgraph here — callgraph imports this module
+# for the per-file scan; the CallGraph is always passed in (the same
+# no-cycle discipline hotpath.py keeps).
+from sparkdl_tpu.analysis.locks import (
+    CallEvent,
+    FunctionScanner,
+    ModuleLocks,
+    _dotted,
+)
+
+#: thread/timer constructors whose target runs on a NEW thread
+_THREAD_CTORS = {"threading.Thread": "thread", "Thread": "thread",
+                 "threading.Timer": "timer", "Timer": "timer"}
+
+#: receiver names that make a ``.submit``/``.map`` call an executor
+#: hand-off (the repo's pools are all named like pools)
+_POOLISH = re.compile(r"pool|executor|workers", re.IGNORECASE)
+
+#: container-mutator method names (the "mut" access kind): calling
+#: one of these on ``self.X`` / a local mutates the object in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "insert", "remove", "discard", "pop", "popleft", "clear",
+             "update", "setdefault", "put", "put_nowait", "rotate",
+             "sort", "reverse"}
+
+#: ctor names that bind a MUTABLE container to a local (H18 material)
+_MUTABLE_CTORS = {"list", "dict", "set", "deque", "collections.deque",
+                  "defaultdict", "collections.defaultdict",
+                  "OrderedDict", "collections.OrderedDict",
+                  "bytearray"}
+
+#: (module suffix, qualname, label, multi): thread roots by
+#: construction — found at their spawn sites too, but pinned here so
+#: a moved spawn site cannot silently drop the package's known
+#: concurrent loops out of the model (the EXTRA_HOT_ROOTS precedent)
+KNOWN_THREAD_ROOTS: Tuple[Tuple[str, str, str, bool], ...] = (
+    ("serve.server", "ModelSession._serve_loop",
+     "the serve dispatcher thread", False),
+    ("obs.watchdog", "StallWatchdog._monitor",
+     "the watchdog monitor thread", False),
+    ("autotune.core", "AutotuneController.step",
+     "the autotune apply path (poll() drives it from every hot-loop "
+     "thread at once)", True),
+)
+
+
+def _ref_text(node: ast.AST) -> str:
+    """A stable textual handle for a handed-over argument: a bare
+    local name, ``self.X``, or "" when the shape is untrackable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# the serializable per-function facts
+
+
+@dataclass
+class SpawnEvent:
+    """One callable handed across a thread boundary."""
+
+    kind: str           # "thread"|"timer"|"pool"|"callback"|"http"|"signal"
+    target_kind: str    # CallEvent kinds, plus "class" (HTTP handler)
+    name: str           # callable/class name (last segment)
+    qualifier: str      # "self": enclosing class; "dotted": import src
+    line: int
+    display: str        # what the source says, for messages
+    args: Tuple[str, ...] = ()   # handed positional arg refs (_ref_text)
+    multi: bool = False          # pool/per-request: >1 thread runs it
+
+
+@dataclass
+class AccessEvent:
+    """One ``self.X`` touch with its exact lock-region context."""
+
+    attr: str
+    kind: str           # "read" | "write" | "mut" | "check"
+    line: int
+    #: (lock id, region opening line) for every lock lexically held —
+    #: the region line is what tells H19 two holds of ONE lock apart
+    regions: Tuple[Tuple[str, int], ...] = ()
+
+    @property
+    def held(self) -> Tuple[str, ...]:
+        return tuple(lock for lock, _ in self.regions)
+
+
+@dataclass
+class ThreadFacts:
+    """The per-function thread/race facts, plain data (cacheable)."""
+
+    key: str
+    spawns: List[SpawnEvent] = field(default_factory=list)
+    accesses: List[AccessEvent] = field(default_factory=list)
+    #: positional parameter names, call-mapping order (self dropped)
+    params: List[str] = field(default_factory=list)
+    #: local name -> line where it was bound to a mutable container
+    mutable_locals: Dict[str, int] = field(default_factory=dict)
+    #: in-place mutations of bare names: (name, line, held lock ids)
+    local_muts: List[Tuple[str, int, Tuple[str, ...]]] = \
+        field(default_factory=list)
+    #: every bare name this function assigns (closure-capture fence:
+    #: a name a nested def binds itself is NOT captured state)
+    locals_bound: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "spawns": [[s.kind, s.target_kind, s.name, s.qualifier,
+                        s.line, s.display, list(s.args), s.multi]
+                       for s in self.spawns],
+            "accesses": [[a.attr, a.kind, a.line,
+                          [[lk, ln] for lk, ln in a.regions]]
+                         for a in self.accesses],
+            "params": self.params,
+            "mutable_locals": self.mutable_locals,
+            "local_muts": [[n, ln, list(held)]
+                           for n, ln, held in self.local_muts],
+            "locals_bound": self.locals_bound,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ThreadFacts":
+        tf = cls(key=d["key"])
+        tf.spawns = [SpawnEvent(s[0], s[1], s[2], s[3], s[4], s[5],
+                                tuple(s[6]), s[7]) for s in d["spawns"]]
+        tf.accesses = [AccessEvent(a[0], a[1], a[2],
+                                   tuple((lk, ln) for lk, ln in a[3]))
+                       for a in d["accesses"]]
+        tf.params = list(d["params"])
+        tf.mutable_locals = dict(d["mutable_locals"])
+        tf.local_muts = [(m[0], m[1], tuple(m[2]))
+                         for m in d["local_muts"]]
+        tf.locals_bound = list(d["locals_bound"])
+        return tf
+
+
+# ---------------------------------------------------------------------------
+# the per-function scan
+
+
+class ThreadScanner:
+    """One function body → its :class:`ThreadFacts`. Mirrors
+    ``locks.FunctionScanner``'s region discipline (lexical ``with``
+    scoping; ``acquire()``..``release()`` by source-line region) but
+    keeps each hold's IDENTITY — ``(lock, opening line)`` — because
+    the race rules need to tell two separate holds of one lock apart.
+    Lock identity itself is delegated to a ``FunctionScanner`` so the
+    two models can never disagree about what a lock is."""
+
+    def __init__(self, key: str, module: str, path: str,
+                 cls: Optional[str], qualname: str, locks: ModuleLocks,
+                 imports: Dict[str, str]):
+        self.facts = ThreadFacts(key=key)
+        self.cls = cls
+        self.module = module
+        self.imports = imports
+        self._ids = FunctionScanner(module, path, cls, qualname, locks,
+                                    imports)
+        self._locks = locks
+        #: flat acquire()..release() regions: (lock, lo, hi)
+        self._flat: List[Tuple[str, int, int]] = []
+
+    # -- entry ---------------------------------------------------------------
+
+    def scan(self, fn: ast.AST) -> ThreadFacts:
+        args = getattr(fn, "args", None)
+        if args is not None:
+            names = [a.arg for a in args.posonlyargs + args.args]
+            if names and names[0] in ("self", "cls"):
+                names = names[1:]
+            self.facts.params = names
+        self._walk(fn.body, ())
+        self._apply_flat_regions()
+        return self.facts
+
+    # -- statement walk ------------------------------------------------------
+
+    def _walk(self, stmts: List[ast.stmt],
+              regions: Tuple[Tuple[str, int], ...]):
+        for stmt in stmts:
+            self._visit_stmt(stmt, regions)
+
+    def _visit_stmt(self, stmt: ast.stmt,
+                    regions: Tuple[Tuple[str, int], ...]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return      # nested defs are scanned as their own functions
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new = tuple(regions)
+            for item in stmt.items:
+                lock = self._ids._with_item_lock(item.context_expr)
+                self._scan_expr(item.context_expr, regions,
+                                skip_lock_read=True)
+                if lock is not None and lock not in \
+                        tuple(lk for lk, _ in new):
+                    new = new + ((lock, stmt.lineno),)
+            self._walk(stmt.body, new)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            # the branch test is where check-then-act checks live
+            self._scan_expr(stmt.test, regions, check=True)
+            self._walk(stmt.body, regions)
+            if isinstance(stmt, ast.If):
+                self._walk(stmt.orelse, regions)
+            else:
+                self._walk(stmt.orelse, regions)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._visit_assign(stmt, regions)
+            return
+        # acquire()/release() expression statements: flat regions
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call) and isinstance(
+                stmt.value.func, ast.Attribute):
+            call = stmt.value
+            attr = call.func.attr
+            if attr in ("acquire", "release"):
+                lock = self._ids.lock_id(call.func.value)
+                if lock is not None:
+                    if attr == "acquire" and not \
+                            FunctionScanner._is_try_acquire(call):
+                        self._flat.append((lock, call.lineno, 1 << 30))
+                    elif attr == "release":
+                        for i, (lk, lo, hi) in enumerate(self._flat):
+                            if lk == lock and hi == 1 << 30 \
+                                    and lo < call.lineno:
+                                self._flat[i] = (lk, lo, call.lineno)
+                                break
+                    return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._visit_stmt(child, regions)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child, regions)
+            elif isinstance(child, ast.ExceptHandler):
+                self._walk(child.body, regions)
+            elif isinstance(child, ast.match_case):
+                self._walk(child.body, regions)
+
+    def _visit_assign(self, stmt, regions: Tuple[Tuple[str, int], ...]):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        value = stmt.value
+        # the RHS first: reads happen before the store binds
+        if value is not None:
+            self._scan_expr(value, regions)
+        aug = isinstance(stmt, ast.AugAssign)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                self.facts.locals_bound.append(tgt.id)
+                if aug:
+                    # x += [..] rebinding still mutates shared state
+                    # only for in-place types; treat as a local mut
+                    self._note_local_mut(tgt.id, stmt.lineno, regions)
+                elif value is not None and self._is_mutable_ctor(value):
+                    self.facts.mutable_locals.setdefault(
+                        tgt.id, stmt.lineno)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    if isinstance(elt, ast.Name):
+                        self.facts.locals_bound.append(elt.id)
+                    else:
+                        self._visit_assign_target(elt, stmt.lineno,
+                                                  regions, aug)
+            else:
+                self._visit_assign_target(tgt, stmt.lineno, regions,
+                                          aug)
+
+    def _visit_assign_target(self, tgt: ast.AST, line: int,
+                             regions: Tuple[Tuple[str, int], ...],
+                             aug: bool):
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            if aug:
+                # read-modify-write: record the read half too
+                self._note_access(tgt.attr, "read", line, regions)
+            self._note_access(tgt.attr, "write", line, regions)
+        elif isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                # self.X[k] = v mutates the container behind X
+                self._note_access(base.attr, "mut", line, regions)
+            elif isinstance(base, ast.Name):
+                self._note_local_mut(base.id, line, regions)
+            self._scan_expr(tgt.slice, regions)
+        elif isinstance(tgt, ast.Attribute):
+            # obj.attr = v: scan the receiver for self.X reads
+            self._scan_expr(tgt.value, regions)
+
+    # -- expression walk -----------------------------------------------------
+
+    def _scan_expr(self, expr: ast.AST,
+                   regions: Tuple[Tuple[str, int], ...],
+                   check: bool = False, skip_lock_read: bool = False):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._record_call(node, regions)
+            elif (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and isinstance(node.ctx, ast.Load)):
+                if skip_lock_read:
+                    continue
+                self._note_access(node.attr,
+                                  "check" if check else "read",
+                                  node.lineno, regions)
+
+    def _record_call(self, call: ast.Call,
+                     regions: Tuple[Tuple[str, int], ...]):
+        spawn = self._classify_spawn(call)
+        if spawn is not None:
+            self.facts.spawns.append(spawn)
+        if not isinstance(call.func, ast.Attribute):
+            return
+        recv = call.func.value
+        if call.func.attr in _MUTATORS:
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                self._note_access(recv.attr, "mut", call.lineno,
+                                  regions)
+            elif isinstance(recv, ast.Name):
+                self._note_local_mut(recv.id, call.lineno, regions)
+
+    # -- spawn classification ------------------------------------------------
+
+    def _classify_spawn(self, call: ast.Call) -> Optional[SpawnEvent]:
+        name = _dotted(call.func)
+        # Thread(target=f) / Timer(interval, f)
+        if name in _THREAD_CTORS:
+            kind = _THREAD_CTORS[name]
+            target = None
+            handed: List[ast.expr] = []
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    target = kw.value
+                elif kw.arg == "args" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    handed = list(kw.value.elts)
+            if target is None and kind == "timer" and len(call.args) >= 2:
+                target = call.args[1]
+                handed = handed or (
+                    list(call.args[2].elts)
+                    if len(call.args) >= 3 and isinstance(
+                        call.args[2], (ast.Tuple, ast.List)) else [])
+            if target is None and kind == "thread" and call.args:
+                # positional Thread(group, target) is never written
+                # here; accept Thread(target) defensively
+                target = call.args[0]
+            return self._spawn_from(kind, target, handed, call,
+                                    multi=False)
+        # signal.signal(SIG, handler)
+        if name in ("signal.signal",) and len(call.args) >= 2:
+            return self._spawn_from("signal", call.args[1], [], call,
+                                    multi=False)
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv_name = (_dotted(call.func.value) or "").rsplit(".", 1)[-1]
+        # executor.submit(f, *args) / executor.map(f, it)
+        if attr in ("submit", "map") and _POOLISH.search(recv_name):
+            if not call.args:
+                return None
+            handed = list(call.args[1:]) if attr == "submit" else []
+            return self._spawn_from("pool", call.args[0], handed,
+                                    call, multi=True)
+        # fut.add_done_callback(cb): cb runs on a pool/worker thread
+        if attr == "add_done_callback" and call.args:
+            return self._spawn_from("callback", call.args[0], [],
+                                    call, multi=True)
+        # ThreadingHTTPServer(addr, Handler): every do_* method of
+        # Handler runs per-request on its own thread
+        if name and name.rsplit(".", 1)[-1] == "ThreadingHTTPServer" \
+                and len(call.args) >= 2 and isinstance(
+                    call.args[1], ast.Name):
+            return SpawnEvent(
+                kind="http", target_kind="class",
+                name=call.args[1].id, qualifier="", line=call.lineno,
+                display=f"{name}(..., {call.args[1].id})", multi=True)
+        return None
+
+    def _spawn_from(self, kind: str, target: Optional[ast.AST],
+                    handed: List[ast.expr], call: ast.Call,
+                    multi: bool) -> Optional[SpawnEvent]:
+        if target is None:
+            return None
+        # a single-call lambda hands its CALLEE across the boundary
+        # (the pipeline's `lambda _f, p=pos: _unwatch(p)` idiom)
+        if isinstance(target, ast.Lambda) and isinstance(
+                target.body, ast.Call):
+            inner = target.body
+            handed = handed or list(inner.args)
+            target = inner.func
+        name = _dotted(target)
+        if name is None:
+            return None
+        args = tuple(_ref_text(a) for a in handed)
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return SpawnEvent(kind, "self", parts[1], self.cls or "",
+                              call.lineno, name, args, multi)
+        if len(parts) == 1:
+            return SpawnEvent(kind, "name", parts[0], "",
+                              call.lineno, name, args, multi)
+        if len(parts) == 2 and parts[0] in self.imports:
+            return SpawnEvent(kind, "dotted", parts[1],
+                              self.imports[parts[0]], call.lineno,
+                              name, args, multi)
+        return SpawnEvent(kind, "method", parts[-1], "", call.lineno,
+                          name, args, multi)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _note_access(self, attr: str, kind: str, line: int,
+                     regions: Tuple[Tuple[str, int], ...]):
+        # a lock attribute is synchronization, not shared data — and
+        # its Condition alias is the same lock
+        cls = self.cls or ""
+        canon = self._locks.canonical_attr(cls, attr)
+        if canon in self._locks.class_locks.get(cls, ()):
+            return
+        if attr != canon or re.search(
+                r"^_?(lock|mutex|cond|sem)\b", attr):
+            return
+        self.facts.accesses.append(AccessEvent(attr, kind, line,
+                                               regions))
+
+    def _note_local_mut(self, name: str, line: int,
+                        regions: Tuple[Tuple[str, int], ...]):
+        self.facts.local_muts.append(
+            (name, line, tuple(lk for lk, _ in regions)))
+
+    def _apply_flat_regions(self):
+        """Fold acquire()..release() line regions into every recorded
+        event (the lexical ``with`` regions were exact already)."""
+        if not self._flat:
+            return
+
+        def fold(line: int, regions: Tuple[Tuple[str, int], ...]
+                 ) -> Tuple[Tuple[str, int], ...]:
+            out = list(regions)
+            held = {lk for lk, _ in out}
+            for lk, lo, hi in self._flat:
+                if lo < line <= hi and lk not in held:
+                    out.append((lk, lo))
+                    held.add(lk)
+            return tuple(out)
+
+        for a in self.facts.accesses:
+            a.regions = fold(a.line, a.regions)
+        self.facts.local_muts = [
+            (n, ln, tuple(dict.fromkeys(
+                list(held) + [lk for lk, lo, hi in self._flat
+                              if lo < ln <= hi])))
+            for n, ln, held in self.facts.local_muts]
+
+    @staticmethod
+    def _is_mutable_ctor(value: ast.AST) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            name = _dotted(value.func)
+            return name in _MUTABLE_CTORS
+        return False
+
+
+def scan_threads(fn: ast.AST, key: str, module: str, path: str,
+                 cls: Optional[str], qualname: str, locks: ModuleLocks,
+                 imports: Dict[str, str]) -> ThreadFacts:
+    """One function def → its serializable thread/race facts."""
+    return ThreadScanner(key, module, path, cls, qualname, locks,
+                         imports).scan(fn)
+
+
+# ---------------------------------------------------------------------------
+# program-time topology
+
+
+def _short(key: str) -> str:
+    mod, _, qual = key.partition("::")
+    mod = mod[len("sparkdl_tpu."):] if mod.startswith("sparkdl_tpu.") \
+        else mod
+    return f"{mod}:{qual}" if qual else mod
+
+
+@dataclass
+class ThreadRoot:
+    """One entry in the thread-root inventory."""
+
+    key: str            # function key of the root
+    label: str          # human "why is this a thread"
+    kind: str           # spawn kind, or "known"
+    multi: bool         # more than one OS thread may run this root
+    site: str = ""      # "path:line" of the spawn, "" for known roots
+
+
+class ThreadTopology:
+    """Thread-context reachability over one CallGraph.
+
+    ``reach[key]`` maps each thread root that may execute ``key`` to
+    the witness chain (function keys, root first). ``class_reach``
+    lifts that to classes: a method of a class with any thread-rooted
+    method shares the instance with that thread (see module
+    docstring). The main thread is implicit everywhere.
+    """
+
+    def __init__(self, graph, tfacts: Dict[str, ThreadFacts]):
+        self.graph = graph
+        self.tfacts = tfacts
+        self.roots: Dict[str, ThreadRoot] = {}
+        #: fn key -> {root key -> witness chain (keys, root first)}
+        self.reach: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+        #: "module::Class" -> {root key -> the reachable method's key}
+        self.class_reach: Dict[str, Dict[str, str]] = {}
+        self._collect_roots()
+        self._close()
+        self._lift_classes()
+
+    # -- roots ---------------------------------------------------------------
+
+    def _collect_roots(self) -> None:
+        for key, f in self.graph.functions.items():
+            for suffix, qual, label, multi in KNOWN_THREAD_ROOTS:
+                if f.qualname == qual and (
+                        f.module == suffix
+                        or f.module.endswith("." + suffix)):
+                    self.roots.setdefault(key, ThreadRoot(
+                        key, label, "known", multi))
+        for key, tf in self.tfacts.items():
+            caller = self.graph.functions.get(key)
+            if caller is None:
+                continue
+            for sp in tf.spawns:
+                for target in self._spawn_targets(caller, sp):
+                    site = f"{caller.path}:{sp.line}"
+                    label = self._root_label(sp, caller)
+                    have = self.roots.get(target)
+                    if have is None or (sp.multi and not have.multi):
+                        self.roots[target] = ThreadRoot(
+                            target, label, sp.kind,
+                            sp.multi or (have.multi if have else False),
+                            site)
+
+    def _root_label(self, sp: SpawnEvent, caller) -> str:
+        what = {"thread": "threading.Thread target",
+                "timer": "threading.Timer callback",
+                "pool": "executor worker task",
+                "callback": "future done-callback (runs on a worker "
+                            "thread)",
+                "http": "ThreadingHTTPServer per-request handler",
+                "signal": "signal handler"}[sp.kind]
+        return (f"{what} spawned by {_short(caller.key)} "
+                f"({caller.path}:{sp.line})")
+
+    def _spawn_targets(self, caller, sp: SpawnEvent) -> List[str]:
+        """Resolved function keys a spawn event hands over (an HTTP
+        handler class contributes every per-request method)."""
+        if sp.target_kind == "class":
+            mod = caller.module
+            methods = self.graph.modules.get(mod)
+            out = []
+            if methods is not None:
+                for m in methods.classes.get(sp.name, ()):
+                    if m.startswith("do_") or m == "log_message":
+                        k = f"{mod}::{sp.name}.{m}"
+                        if k in self.graph.functions:
+                            out.append(k)
+            return out
+        # the nested-def rule first (the pipeline's lambda ->
+        # _unwatch hand-off binds to the enclosing def's nested fn)
+        if sp.target_kind == "name":
+            probe = caller.qualname
+            while True:
+                nested = f"{caller.module}::{probe}.{sp.name}" if probe \
+                    else f"{caller.module}::{sp.name}"
+                if nested in self.graph.functions:
+                    return [nested]
+                if "." not in probe:
+                    break
+                probe = probe.rsplit(".", 1)[0]
+        call = CallEvent(sp.target_kind, sp.name, sp.display, sp.line,
+                         (), sp.qualifier)
+        target = self.graph.resolve(caller, call)
+        return [target] if target is not None else []
+
+    # -- reachability --------------------------------------------------------
+
+    def _close(self) -> None:
+        """BFS the resolved call edges from every root: thread
+        context flows DOWN the call graph, exactly like hotness."""
+        from sparkdl_tpu.analysis.hotpath import _resolve
+        for root in sorted(self.roots):
+            work = [root]
+            self.reach.setdefault(root, {})[root] = (root,)
+            while work:
+                key = work.pop(0)
+                f = self.graph.functions.get(key)
+                if f is None:
+                    continue
+                chain = self.reach[key][root]
+                for call in f.calls:
+                    target = _resolve(self.graph, f, call)
+                    if target is None:
+                        continue
+                    seen = self.reach.setdefault(target, {})
+                    if root in seen:
+                        continue
+                    seen[root] = chain + (target,)
+                    work.append(target)
+
+    def _lift_classes(self) -> None:
+        for key, roots in self.reach.items():
+            f = self.graph.functions.get(key)
+            if f is None or "." not in f.qualname:
+                continue
+            cls = f.qualname.split(".", 1)[0]
+            mod = self.graph.modules.get(f.module)
+            if mod is None or cls not in mod.classes:
+                continue    # a nested def's prefix is not a class
+            ck = f"{f.module}::{cls}"
+            table = self.class_reach.setdefault(ck, {})
+            for root in roots:
+                table.setdefault(root, key)
+
+    # -- queries -------------------------------------------------------------
+
+    def threads_of(self, key: str) -> Dict[str, Tuple[str, ...]]:
+        """root key -> witness chain for every thread root that may
+        execute ``key``, including class-shared roots (the chain then
+        runs to the sibling method that carries the root)."""
+        out = dict(self.reach.get(key, {}))
+        f = self.graph.functions.get(key)
+        if f is not None and "." in f.qualname:
+            cls = f.qualname.split(".", 1)[0]
+            ck = f"{f.module}::{cls}"
+            for root, via in self.class_reach.get(ck, {}).items():
+                out.setdefault(root, self.reach[via][root])
+        return out
+
+    def is_concurrent(self, key: str) -> bool:
+        """True when >= 2 OS threads may touch state this function
+        touches: reachable from a spawn root (the main thread is the
+        implicit second), or a method of a class with such a method."""
+        return bool(self.threads_of(key))
+
+    def witness(self, key: str, limit: int = 2) -> str:
+        """The printable both-roots witness: each root's label plus
+        its module-by-module chain, ending with the implicit main
+        thread."""
+        entries = []
+        for root, chain in sorted(self.threads_of(key).items()):
+            info = self.roots[root]
+            path = " -> ".join(_short(k) for k in chain)
+            onto = "" if chain[-1] == key else \
+                f" (shares {_short(key).rsplit('.', 1)[0]}'s instance " \
+                f"state)"
+            many = " [multi-worker]" if info.multi else ""
+            entries.append(f"[{info.label}{many}: {path}{onto}]")
+            if len(entries) >= limit:
+                break
+        entries.append("[the main thread: any direct caller]")
+        return " and ".join(entries)
+
+
+def thread_topology(graph) -> ThreadTopology:
+    """The (memoized) topology for one CallGraph — built once per
+    analyzer invocation, shared by H17/H18/H19 (the _flow_state
+    precedent)."""
+    state = getattr(graph, "_sparkdl_thread_topology", None)
+    if state is None:
+        tfacts: Dict[str, ThreadFacts] = {}
+        for m in graph.modules.values():
+            tfacts.update(getattr(m, "threads", {}) or {})
+        state = ThreadTopology(graph, tfacts)
+        graph._sparkdl_thread_topology = state
+    return state

@@ -215,6 +215,17 @@ def analyze_paths(targets: Sequence[str],
             _flow_state(graph)
             timing["dataflow-closure"] = timing.get(
                 "dataflow-closure", 0.0) + (time.perf_counter() - t0)
+        if any(r in ("H17", "H18", "H19") for r in wanted):
+            # same discipline for the thread topology + guarded-by
+            # model H17–H19 share: built once, timed under its own
+            # key (sorted(PROGRAM_RULES) would book it to H17)
+            from sparkdl_tpu.analysis.races import _guard_model
+            from sparkdl_tpu.analysis.threads import thread_topology
+            t0 = time.perf_counter()
+            thread_topology(graph)
+            _guard_model(graph)
+            timing["threads-topology"] = timing.get(
+                "threads-topology", 0.0) + (time.perf_counter() - t0)
         for rule in sorted(PROGRAM_RULES):
             if rule in wanted:
                 t0 = time.perf_counter()

@@ -75,6 +75,7 @@ class _DeferredSide:
             # Post-pickle (remote task) path: there is no process-local
             # cache another partition could reuse — compute just this
             # partition instead of pool-mapping the whole side.
+            # sparkdl-lint: allow[H17] -- _sources is immutable after __init__ (bound once, never rebound/mutated); the lock guards the _batches memoization, the source list just rides inside it
             return self._run_partition(self._sources[i], i)
         with self._lock:
             if self._batches is None:

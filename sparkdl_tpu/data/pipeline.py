@@ -614,12 +614,16 @@ class HostPipeline:
 
     # -- pools ---------------------------------------------------------------
 
-    @staticmethod
-    def _retire_locked(handle: Optional[_PoolHandle]
+    def _retire_locked(self, handle: Optional[_PoolHandle]
                        ) -> Optional[_PoolHandle]:
         """Mark ``handle`` retired (caller holds the lock); returns it
         when no stream still pins it — i.e. when the CALLER must shut
         it down (outside the lock)."""
+        # deferred: the data layer must not pull the jax-importing
+        # runtime package in at module load; retires are rare (pool
+        # resize / close), so the import cost lands off the hot path
+        from sparkdl_tpu.runtime.sanitize import assert_lock_owned
+        assert_lock_owned(self._lock, "HostPipeline._retire_locked")
         if handle is None:
             return None
         handle.retired = True

@@ -579,6 +579,7 @@ class UtilizationLedger:
             due = (self._last_t is None
                    or (now - self._last_t) >= self.window_s)
         if due:
+            # sparkdl-lint: allow[H17] -- window_s is immutable config after __init__; the hold above guards _last_t, window_s just rode inside it
             return self.tick(now=now, min_dt=self.window_s)
         return None
 
@@ -620,7 +621,8 @@ class UtilizationLedger:
         now = time.perf_counter()
         dt = max(now - self._epoch, 1e-9)
         totals = self._read_feeds()
-        ceilings = self._ceilings or {}
+        with self._lock:
+            ceilings = self._ceilings or {}
         # cumulative totals include any pooled busy-seconds this
         # process ever banked — divide the decode lane by the
         # process-lifetime worker high-water, not the serial ceiling

@@ -219,6 +219,7 @@ class StallWatchdog:
         """Deactivate one :meth:`begin`. Cheap when nothing is tracked;
         never checks ``armed`` beyond that, so a disarm between begin
         and end cannot leak an eternally-active source."""
+        # sparkdl-lint: allow[H17] -- lock-free emptiness fast path (a GIL-atomic len); the authoritative lookup re-runs under the lock below
         if not self._sources:
             return
         with self._lock:
@@ -237,6 +238,7 @@ class StallWatchdog:
         """Record progress for ``source`` — one float write into the
         entry's beat slot (GIL-atomic; no lock on the hot path). A
         pulse outside any watch block is ignored."""
+        # sparkdl-lint: allow[H17] -- the documented hot-path contract: one GIL-atomic dict lookup + float slot write per unit of work, no lock by design (a stale miss costs one beat, never corruption)
         entry = self._sources.get(source)
         if entry is not None:
             entry[1] = time.perf_counter()
@@ -345,6 +347,7 @@ class StallWatchdog:
             thread.join(timeout=1.0)
 
     def _monitor(self) -> None:
+        # sparkdl-lint: allow[H17] -- binds this monitor's OWN stop Event once at thread start by design: _ensure_thread swaps a fresh Event in (under the lock) before spawning, so a later swap must not retarget a retiring monitor
         stop = self._stop
         while not stop.wait(self._interval()):
             if not self.armed:

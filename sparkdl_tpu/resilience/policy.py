@@ -327,7 +327,8 @@ class CircuitBreaker:
     def state_code(self) -> int:
         """The ``serve.circuit_state`` gauge encoding (0 closed /
         1 open / 2 half-open)."""
-        return _STATE_CODES[self.state]
+        with self._lock:
+            return _STATE_CODES[self.state]
 
     def status(self) -> dict:
         """``/statusz`` / flight-bundle shape."""

@@ -121,7 +121,7 @@ from sparkdl_tpu.obs.ledger import ledger_poll
 from sparkdl_tpu.obs.watchdog import pulse as watchdog_pulse
 from sparkdl_tpu.obs.watchdog import watch as watchdog_watch
 from sparkdl_tpu.resilience.faults import maybe_fail
-from sparkdl_tpu.runtime.sanitize import ship_guard
+from sparkdl_tpu.runtime.sanitize import assert_lock_owned, ship_guard
 
 # In-flight device batches before the oldest result is fetched, for the
 # "deferred" strategy. 2 = classic double-buffering (one executing, one
@@ -500,6 +500,10 @@ class InfeedRing:
             collections.OrderedDict()
         self._clock = 0
         self._victim = 0
+        # the owning runner's checkout lock, attached by
+        # _checkout_ring; a bare ring (unit tests, single-threaded
+        # use) carries None and the sanitizer contract check stays off
+        self._guard: Optional[threading.Lock] = None
 
     def fingerprint(self, chunk: Dict[str, np.ndarray]) -> bytes:
         """Content address of one host chunk (name+dtype+shape+bytes,
@@ -525,6 +529,8 @@ class InfeedRing:
         consumed by donation: handing out donated buffers is a read of
         dead device memory — the runtime use-after-donate guard
         backing the static H15 donation-safety analysis."""
+        if self._guard is not None:
+            assert_lock_owned(self._guard, "InfeedRing.get")
         i = self._index.get(fp)
         if i is None:
             return None
@@ -553,6 +559,8 @@ class InfeedRing:
     def note_donated(self, fp: bytes) -> None:
         """Mark ``fp``'s retained slot consumed-by-donation: any later
         :meth:`get` of it raises instead of returning dead buffers."""
+        if self._guard is not None:
+            assert_lock_owned(self._guard, "InfeedRing.note_donated")
         i = self._index.get(fp)
         if i is not None:
             self._slots[i].donated = True
@@ -563,6 +571,8 @@ class InfeedRing:
         caller dispatches UNDONATED — the slab must stay alive); False
         = every slot is recently useful, stream the chunk through
         (donate) rather than evicting a hot slab."""
+        if self._guard is not None:
+            assert_lock_owned(self._guard, "InfeedRing.admit")
         for i, slot in enumerate(self._slots):
             if slot.donated:        # dead slab: reclaim first
                 self._install(i, fp, placed, nbytes)
@@ -1291,7 +1301,8 @@ class RunnerMetrics:
 
     @property
     def rows_per_second(self) -> float:
-        return self.rows / self.seconds if self.seconds else 0.0
+        with self._lock:
+            return self.rows / self.seconds if self.seconds else 0.0
 
     def publish(self, registry) -> None:
         """Set this runner's cumulative counters as ``ship.*`` gauges
@@ -1376,6 +1387,9 @@ class BatchRunner:
             self._ring = InfeedRing(depth)
         else:
             self._ring.resize(depth)
+        # arm the sanitizer's caller-holds check: every ring mutation
+        # from here on must happen while this checkout hold is live
+        self._ring._guard = self._ring_lock
         reg = default_registry()
         reg.gauge("ship.ring_depth").set(depth)
         reg.gauge("ship.interleave_width").set(

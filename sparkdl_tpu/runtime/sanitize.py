@@ -18,6 +18,13 @@ module is the dynamic half of that pair.
 (process-global, set once on first armed entry): aligned runs then
 fault at the op that produced a NaN instead of shipping it.
 
+``SPARKDL_TPU_SANITIZE=1`` also arms :func:`assert_lock_owned` — the
+dynamic half of the H17 guarded-by pair the way ship_guard is H1's:
+caller-holds-the-lock helpers (serve queue shedding, the infeed ring,
+the pipeline pool registry) assert their contract on entry, so the
+suppressions the static race rules carry are re-validated on every
+sanitized bench run instead of trusted forever.
+
 Backends without the transfer-guard API degrade ONCE, with a warning —
 the same probe-and-degrade discipline as ``start_host_copies`` /
 ``start_device_prefetch`` in runner.py: sanitizing must never change
@@ -70,6 +77,35 @@ def _configure_debug_nans_once() -> None:
     jax.config.update("jax_debug_nans", True)
     logging.getLogger(__name__).info(
         "sanitizer: jax_debug_nans enabled (SPARKDL_TPU_SANITIZE_NANS)")
+
+
+def assert_lock_owned(lock, what: str) -> None:
+    """Debug cross-check for the static guarded-by model (sparkdl-lint
+    H17): private helpers whose contract is "caller holds the lock" —
+    the serve queue's shed helpers, the infeed ring's mutators, the
+    pipeline pool registry — call this on entry so the contract the
+    analyzer takes on faith (and the suppression documents) is
+    VALIDATED on every sanitized CI bench run. No-op unless
+    ``SPARKDL_TPU_SANITIZE=1``: steady-state serving pays nothing.
+
+    An RLock/Condition knows its owner (``_is_owned``); a plain Lock
+    only knows it is held at all (``locked``) — good enough to catch
+    the real regression shape, a refactor that starts calling the
+    helper outside any hold."""
+    if not sanitize_enabled():
+        return
+    if lock is None:
+        raise AssertionError(
+            f"sanitizer: {what} requires its guard lock held, but no "
+            "guard is attached (the owner never handed one over)")
+    probe = getattr(lock, "_is_owned", None)
+    owned = probe() if callable(probe) else lock.locked()
+    if not owned:
+        default_registry().counter("sanitize.lock_violations").add()
+        raise AssertionError(
+            f"sanitizer: {what} called without its guard lock held — "
+            "the caller-holds contract sparkdl-lint H17 suppresses on "
+            "is broken here")
 
 
 @contextlib.contextmanager

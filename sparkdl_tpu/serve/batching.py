@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sparkdl_tpu.obs import span
+from sparkdl_tpu.runtime.sanitize import assert_lock_owned
 
 
 class ServerOverloaded(RuntimeError):
@@ -265,8 +266,9 @@ class RequestQueue:
         place). None when shedding cannot free enough rows. Requests
         with rows already placed in a micro-batch (``taken > 0``) are
         never shed: their device work is already paid for."""
+        assert_lock_owned(self._lock, "RequestQueue._pick_victims")
         candidates = sorted(
-            (r for r in self._q
+            (r for r in self._q  # sparkdl-lint: allow[H17] -- caller-holds contract: offer() invokes this inside its condition hold; runtime-asserted above under SPARKDL_TPU_SANITIZE=1
              if r.priority < priority and r.taken == 0
              and not r.future.done()),
             key=lambda r: (r.priority, -r.submitted))
@@ -284,7 +286,8 @@ class RequestQueue:
     def _max_queued_priority(self) -> int:
         """Holding self._lock: the highest priority class with live
         queued rows (-1 on an empty queue)."""
-        return max((r.priority for r in self._q
+        assert_lock_owned(self._lock, "RequestQueue._max_queued_priority")
+        return max((r.priority for r in self._q  # sparkdl-lint: allow[H17] -- caller-holds contract: offer() invokes this inside its condition hold; runtime-asserted above under SPARKDL_TPU_SANITIZE=1
                     if not r.future.done()), default=-1)
 
     def depth(self) -> int:

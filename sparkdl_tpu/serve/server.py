@@ -641,7 +641,11 @@ class ModelSession:
                 # event too — the caller was promised an answer
                 slo_tracker().record(ok=False)
                 self._record_outcome(req, "closed")
-        worker = self._worker
+        # read the dispatcher handle under the lock (a submit() racing
+        # this close may be swapping a fresh thread in via
+        # _ensure_worker); the join itself stays outside the hold
+        with self._lock:
+            worker = self._worker
         if worker is not None and worker.is_alive():
             worker.join(self.config.drain_timeout_s)
             if worker.is_alive():

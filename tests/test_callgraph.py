@@ -608,7 +608,7 @@ class TestCliJson:
             d = json.loads(r.stdout)
             assert d["cache"]["hits"] == expect_hits
 
-    def test_list_rules_covers_all_sixteen(self):
+    def test_list_rules_covers_all_nineteen(self):
         env = {**os.environ, "PYTHONPATH": REPO_ROOT}
         r = subprocess.run(
             [sys.executable, "-m", "sparkdl_tpu.analysis",
@@ -617,7 +617,7 @@ class TestCliJson:
         assert r.returncode == 0
         for rule in ("H1", "H2", "H3", "H4", "H5", "H6", "H7", "H8",
                      "H9", "H10", "H11", "H12", "H13", "H14", "H15",
-                     "H16"):
+                     "H16", "H17", "H18", "H19"):
             assert f"{rule}:" in r.stdout
 
 
@@ -628,7 +628,7 @@ class TestCliJson:
 class TestMetaNineRules:
     def test_package_tools_examples_lint_clean_all_rules(self):
         """THE acceptance gate: zero unsuppressed findings under the
-        full rule set (now sixteen — the program-level rules ride the
+        full rule set (now nineteen — the program-level rules ride the
         same default sweep) across the package + tools/ + examples/."""
         targets = [PKG_DIR]
         for extra in ("tools", "examples"):

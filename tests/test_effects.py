@@ -13,9 +13,9 @@ witness chain; a mutable-instance-attr capture is caught; an unclosed
 ModelServer is caught while every escape-analysis negative stays
 silent; a swallowing serve handler is caught while the
 counter-recording form is accepted; the real package + tools +
-examples are lint-clean under all sixteen rules (H13 rode in with
+examples are lint-clean under all nineteen rules (H13 rode in with
 ISSUE 11's resilience layer; H14-H16 with ISSUE 12's device-dataflow
-layer).
+layer; H17-H19 with ISSUE 17's static race detector).
 """
 
 import json
@@ -938,18 +938,18 @@ class TestCacheVersionBump:
 
 
 # ---------------------------------------------------------------------------
-# meta: the sixteen-rule acceptance gate
+# meta: the nineteen-rule acceptance gate
 
 
-class TestMetaSixteenRules:
+class TestMetaNineteenRules:
     def test_all_rules_includes_the_effect_system(self):
         assert {"H10", "H11", "H12", "H13", "H14", "H15",
-                "H16"} <= set(ALL_RULES)
-        assert len(ALL_RULES) == 16
+                "H16", "H17", "H18", "H19"} <= set(ALL_RULES)
+        assert len(ALL_RULES) == 19
 
-    def test_package_tools_examples_clean_under_sixteen_rules(self):
+    def test_package_tools_examples_clean_under_nineteen_rules(self):
         """THE acceptance gate: zero unsuppressed findings under all
-        sixteen rules across the package + tools/ + examples/."""
+        nineteen rules across the package + tools/ + examples/."""
         targets = [PKG_DIR]
         for extra in ("tools", "examples"):
             d = os.path.join(REPO_ROOT, extra)

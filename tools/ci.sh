@@ -108,7 +108,7 @@
 #      printed), H15 (undonated jit call with a dead device-array
 #      argument), and H16 (dtype-less float64 promotion into device
 #      arithmetic) must be CAUGHT; the dead-vs-escaping H15 negative
-#      must stay silent; SARIF must list all sixteen rules; and the
+#      must stay silent; SARIF must list all nineteen rules; and the
 #      analyzer's --json timing block must show the dataflow closure
 #      staying cheap (warm cached run: every file hits, wall time
 #      bounded) so the --changed-only fast loop keeps its point
@@ -137,6 +137,17 @@
 #      placement >= 1.2x aggregate when >= 2 cores exist (on a 1-core
 #      host the measured serial win is PRINTED — the degrade is
 #      gated, never silently skipped)
+#  19. static-race gate (docs/LINT.md "The static race layer"): the
+#      seeded fixture for each of H17 (unguarded access to a
+#      majority-guarded attribute, witness naming both thread roots +
+#      the lock + the vote), H18 (mutable local handed to a thread
+#      and mutated on both sides, both mutation lines named), and H19
+#      (check-then-act split across two holds of one lock, both hold
+#      lines named) must be CAUGHT with full witness content; the
+#      locked/atomic/double-checked negatives must stay silent; SARIF
+#      must be well-formed with all nineteen rules; the package +
+#      tools/ + examples/ must be clean under all nineteen; and the
+#      warm cached run must hit every file with total_s < 60
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -152,7 +163,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/18] native shim build =="
+echo "== [1/19] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -161,13 +172,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/18] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/19] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/18] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/19] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/18] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/19] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -176,7 +187,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/18] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/19] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -256,7 +267,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/18] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/19] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -295,11 +306,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/18] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/19] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/18] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/19] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -394,7 +405,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/18] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/19] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -504,7 +515,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/18] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/19] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -643,11 +654,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/18] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/19] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/18] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/19] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -712,7 +723,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/18] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/19] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -810,7 +821,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/18] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/19] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -902,7 +913,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/18] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/19] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1029,7 +1040,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/18] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/19] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1149,7 +1160,7 @@ python -m sparkdl_tpu.obs report --bound \
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
-echo "== [16/18] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+echo "== [16/19] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
 # (a) the bench smoke's "compile" block (step 4's result file): the
 # compile log was armed for the whole run, saw every jit compile, and
 # the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
@@ -1285,7 +1296,7 @@ grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
 grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
 grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
-echo "== [17/18] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
+echo "== [17/19] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's pipeline_overlap block: serial-vs-pooled ips
 # on one corpus + the overlap proof. On a multi-core host the pool
 # must have engaged and not lose >5% to serial; on a 1-core host the
@@ -1489,7 +1500,7 @@ print(json.dumps({"pipeline_gate": "ok", "cores": cores,
                   "bundle": path}))
 EOF
 
-echo "== [18/18] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
+echo "== [18/19] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's ship_ring block: the repeated-corpus steady
 # pass must ship ZERO bytes (every chunk a content hit off a resident
 # slab — STRICTLY below the no-ring baseline's per-pass corpus
@@ -1663,6 +1674,170 @@ print(json.dumps({"ring_serve_gate": "ok", "cores": cores,
                   "serve_ring_hits": int(hits),
                   "interleave_ratio": round(ratio, 3),
                   "interleave_gated": cores >= 2}))
+EOF
+
+echo "== [19/19] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
+python - <<'EOF'
+import json
+import os
+import tempfile
+
+from sparkdl_tpu.analysis import analyze_paths, to_sarif
+from sparkdl_tpu.analysis.walker import ALL_RULES
+
+assert len(ALL_RULES) == 19, sorted(ALL_RULES)
+
+RACY = (
+    "import threading\n"
+    "\n"
+    "class Buf:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.items = []\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self.worker).start()\n"
+    "    def worker(self):\n"
+    "        with self._lock:\n"
+    "            self.items.append(1)\n"
+    "    def size(self):\n"
+    "        with self._lock:\n"
+    "            return len(self.items)\n"
+    "    def peek(self):\n"
+    "        return self.items[0]\n")
+
+HANDOFF = (
+    "import threading\n"
+    "\n"
+    "def worker(buf):\n"
+    "    buf.append(1)\n"
+    "\n"
+    "def main():\n"
+    "    buf = []\n"
+    "    t = threading.Thread(target=worker, args=(buf,))\n"
+    "    t.start()\n"
+    "    buf.append(2)\n")
+
+SPLIT = (
+    "import threading\n"
+    "\n"
+    "class Q:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.rows = []\n"
+    "        self.cap = 4\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self.drain).start()\n"
+    "    def drain(self):\n"
+    "        with self._lock:\n"
+    "            if self.rows:\n"
+    "                self.rows.pop()\n"
+    "    def offer(self, row):\n"
+    "        with self._lock:\n"
+    "            if len(self.rows) >= self.cap:\n"
+    "                return False\n"
+    "        with self._lock:\n"
+    "            self.rows.append(row)\n"
+    "        return True\n")
+
+with tempfile.TemporaryDirectory() as d:
+    for name, src in (("racy.py", RACY), ("handoff.py", HANDOFF),
+                      ("split.py", SPLIT)):
+        with open(os.path.join(d, name), "w") as f:
+            f.write(src)
+    found = analyze_paths([d], cache_path=None)
+    by_rule = {}
+    for f in found:
+        if not f.suppressed:
+            by_rule.setdefault(f.rule, []).append(f)
+    # H17: the full guarded-by witness — verb, lock identity, vote,
+    # BOTH thread roots (spawned + implicit main)
+    h17 = [f for f in by_rule.get("H17", [])
+           if f.qualname == "Buf.peek"]
+    assert h17, [f.render() for f in by_rule.get("H17", [])]
+    msg = h17[0].message
+    for needle in ("read without holding", "Buf._lock",
+                   "majority evidence", "the main thread",
+                   "instance state"):
+        assert needle in msg, (needle, msg)
+    # H18: the hand-off witness — the local, the boundary kind, both
+    # sides' mutation sites
+    h18 = by_rule.get("H18", [])
+    assert any("mutable local `buf`" in f.message
+               and "a thread target" in f.message
+               and "`buf` parameter" in f.message
+               for f in h18), [f.render() for f in h18]
+    # H19: the split witness — both hold lines, the TOCTOU verdict
+    h19 = by_rule.get("H19", [])
+    assert any("check-then-act split on `self.rows`" in f.message
+               and "SEPARATE hold" in f.message
+               and "TOCTOU" in f.message
+               for f in h19), [f.render() for f in h19]
+
+# the negatives: locking every access, keeping check+act in ONE
+# hold, and double-checked locking must all stay silent
+with tempfile.TemporaryDirectory() as d:
+    safe_racy = RACY.replace(
+        "    def peek(self):\n"
+        "        return self.items[0]\n",
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return self.items[0]\n")
+    safe_split = SPLIT.replace(
+        "        with self._lock:\n"
+        "            self.rows.append(row)\n",
+        "        with self._lock:\n"
+        "            if len(self.rows) < self.cap:\n"
+        "                self.rows.append(row)\n")
+    for name, src in (("safe_racy.py", safe_racy),
+                      ("safe_split.py", safe_split)):
+        with open(os.path.join(d, name), "w") as f:
+            f.write(src)
+    found = analyze_paths([d], rules=["H17", "H18", "H19"],
+                          cache_path=None)
+    unsup = [f for f in found if not f.suppressed]
+    assert unsup == [], [f.render() for f in unsup]
+
+# SARIF: well-formed 2.1.0 with ALL nineteen rules in the driver
+sarif = to_sarif([], rules=ALL_RULES)
+json.dumps(sarif)                      # must round-trip as JSON
+assert sarif["$schema"].endswith("sarif-schema-2.1.0.json")
+rules = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+assert len(rules & set(ALL_RULES)) == 19, sorted(rules)
+assert {"H17", "H18", "H19"} <= rules, sorted(rules)
+print(json.dumps({"race_fixtures": "ok",
+                  "sarif_rules": len(rules)}))
+EOF
+# the warm acceptance pass: with the cache populated by steps 11/14,
+# the nineteen-rule sweep over package + tools + examples must hit
+# every file, stay clean, keep the race passes in the timing block,
+# and stay inside the interactive bound
+SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+
+env = dict(os.environ)
+r = subprocess.run(
+    [sys.executable, "-m", "sparkdl_tpu.analysis", "--json",
+     "sparkdl_tpu", "tools", "examples"],
+    capture_output=True, text=True, env=env)
+assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                           r.stderr[-2000:])
+d = json.loads(r.stdout)
+assert d["unsuppressed"] == 0, d["unsuppressed"]
+assert d["cache"]["misses"] == 0, \
+    ("warm run re-analyzed files", d["cache"])
+t = d["timing"]
+for key in ("H17", "H18", "H19", "threads-topology"):
+    assert key in t["per_rule_s"], (key, sorted(t["per_rule_s"]))
+assert t["total_s"] < 60.0, t
+print(json.dumps({"race_gate": "ok",
+                  "warm_total_s": t["total_s"],
+                  "h17_s": t["per_rule_s"]["H17"],
+                  "h18_s": t["per_rule_s"]["H18"],
+                  "h19_s": t["per_rule_s"]["H19"],
+                  "topology_s": t["per_rule_s"]["threads-topology"]}))
 EOF
 
 echo "== ci.sh: ALL GREEN =="

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Static-analysis entry point: sparkdl-lint (the repo-specific
-# hot-path rules H1-H6 + H12/H13 plus the whole-program passes H7-H11
-# and the device-dataflow throughput rules H14-H16, docs/LINT.md)
+# hot-path rules H1-H6 + H12/H13 plus the whole-program passes H7-H11,
+# the device-dataflow throughput rules H14-H16, and the static race
+# rules H17-H19, docs/LINT.md)
 # plus the generic ruff/mypy baseline from
 # pyproject.toml when those tools are installed (they are NOT hard
 # deps — the lint gate must be green from a fresh clone with no
@@ -41,7 +42,7 @@ else
   targets=("$@")
 fi
 
-echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality / H7 lock cycles / H8 blocking-under-lock / H9 contract drift / H10 jit-purity closure / H11 resource lifecycle / H12 exception-flow accounting / H13 unbounded retry loops / H14 hot-path host syncs / H15 missing donation / H16 dtype widening) =="
+echo "== sparkdl-lint (H1 transfers / H2 retrace / H3 locks / H4 quiesce / H5 clocks / H6 cardinality / H7 lock cycles / H8 blocking-under-lock / H9 contract drift / H10 jit-purity closure / H11 resource lifecycle / H12 exception-flow accounting / H13 unbounded retry loops / H14 hot-path host syncs / H15 missing donation / H16 dtype widening / H17 unguarded access / H18 unsafe publication / H19 atomicity split) =="
 python -m sparkdl_tpu.analysis ${lint_flags[@]+"${lint_flags[@]}"} "${targets[@]}"
 
 if [ "$fast" = "1" ]; then
