@@ -186,6 +186,13 @@ def _warn_once(key: str, msg: str, *args) -> None:
         fire = key not in _warned_once
         _warned_once.add(key)
     if fire:
+        # inside a telemetry-armed worker process the degrade event
+        # ships to the parent (which dedupes ACROSS workers and logs
+        # once); everywhere else this is one module-global None check
+        from sparkdl_tpu.obs import remote
+        if remote.capture_degrade(f"pipeline:{key}",
+                                  msg % args if args else msg):
+            return
         logger.warning(msg, *args)
 
 
@@ -299,9 +306,26 @@ def _decode_batch(data) -> pa.RecordBatch:
     return batch
 
 
+def _with_frame(result: tuple, agent) -> tuple:
+    """Append the telemetry frame to a task result tuple — ONLY when a
+    worker agent is armed, so the disarmed hand-off carries zero extra
+    bytes and keeps its exact pre-telemetry tuple shapes (the parent
+    demuxes by the per-kind base length, ``_split_frame``)."""
+    if agent is None:
+        return result
+    try:
+        return result + (agent.cut_frame(),)
+    except Exception:
+        # telemetry must never fail the fragment it rides with
+        logger.exception("pipeline worker: telemetry frame cut failed; "
+                         "fragment ships without it")
+        return result
+
+
 def _pooled_partition_task(token: str, plan_blob: bytes,
                            src_blob: bytes, index: int,
-                           shm_min: int) -> tuple:
+                           shm_min: int,
+                           tel: Optional[dict] = None) -> tuple:
     """One partition's source load + host-stage prefix, in a worker
     process. Returns a plain-picklable result tuple (never raises —
     exceptions ship back cloudpickled so their type survives):
@@ -313,9 +337,28 @@ def _pooled_partition_task(token: str, plan_blob: bytes,
     riding the result pipe;
     ``("err", exc_blob_or_None, repr, type_name)`` — the failure,
     typed where cloudpickle can carry it.
+
+    ``tel`` is the parent's telemetry config
+    (:func:`sparkdl_tpu.obs.remote.telemetry_config`): when set, this
+    process's :class:`~sparkdl_tpu.obs.remote.TelemetryAgent` arms
+    (once — pool workers persist) and every result tuple gains ONE
+    trailing frame element carrying the worker's spans, counter
+    deltas, watchdog verdict, degrade events, and fault state back to
+    the parent aggregator. ``None`` (disarmed) leaves the tuples
+    byte-identical to the pre-telemetry shapes.
     """
     import cloudpickle
+    agent = None
     try:
+        if tel is not None:
+            try:
+                from sparkdl_tpu.obs import remote as _remote
+                agent = _remote.worker_agent(tel)
+            except Exception:
+                # the fragment matters more than its telemetry
+                logger.exception("pipeline worker: telemetry agent "
+                                 "arming failed; task runs unobserved")
+                agent = None
         plan = _PLAN_CACHE.get(token)
         if plan is None:
             plan = cloudpickle.loads(plan_blob)
@@ -329,27 +372,50 @@ def _pooled_partition_task(token: str, plan_blob: bytes,
         if logical is not None:
             index = logical
         # the engine's fault-injection sites apply to pooled partitions
-        # too (env-armed config reaches the worker; per-site counters
-        # recorded here die with the worker process — the parent-side
-        # retry/typed-error path is what the drills observe)
+        # too (env-armed config reaches the worker — and the telemetry
+        # plane ships programmatic specs, so with an armed agent the
+        # per-site counters recorded here reach the parent as
+        # worker.<i>.faults.* instead of dying with the process)
         from sparkdl_tpu.resilience.faults import maybe_fail
+        try:
+            maybe_fail("pipeline.worker_death")
+        except BaseException:
+            # the ROADMAP-named worker-death drill: a REAL corpse (the
+            # parent sees BrokenProcessPool, exactly like an OOM
+            # kill), not a reportable error shipped back politely
+            os._exit(1)
+        from sparkdl_tpu.obs.watchdog import watchdog as _watchdog
+        wd = _watchdog()
         busy = 0.0
         timings: List[Tuple[str, float, int]] = []
-        maybe_fail("engine.source_load")
-        t0 = time.perf_counter()
-        batch = source.load()
-        busy += time.perf_counter() - t0
-        for stage in plan:
-            maybe_fail("engine.stage_apply")
-            rows_in = batch.num_rows
+        with wd.watch("pipeline.worker_decode"), \
+                span("worker.decode", lane="worker", partition=index):
+            maybe_fail("pipeline.worker_decode")
+            maybe_fail("engine.source_load")
             t0 = time.perf_counter()
-            batch = (stage.fn(batch, index) if stage.with_index
-                     else stage.fn(batch))
-            dt = time.perf_counter() - t0
-            busy += dt
-            timings.append((stage.name, dt, rows_in))
+            with span("worker.source_load", lane="worker",
+                      partition=index):
+                batch = source.load()
+            busy += time.perf_counter() - t0
+            for stage in plan:
+                wd.pulse("pipeline.worker_decode")
+                maybe_fail("engine.stage_apply")
+                rows_in = batch.num_rows
+                t0 = time.perf_counter()
+                with span(f"worker.stage:{stage.name}", lane="worker",
+                          partition=index, rows=rows_in):
+                    batch = (stage.fn(batch, index) if stage.with_index
+                             else stage.fn(batch))
+                dt = time.perf_counter() - t0
+                busy += dt
+                timings.append((stage.name, dt, rows_in))
         payload = _encode_batch(batch)
         rows = batch.num_rows
+        if agent is not None:
+            # worker-side row accounting for report --workers / the
+            # flight bundle's workers[] counter snapshot; parent-side
+            # mirror lands as worker.<i>.pipeline.worker_rows
+            _count("worker_rows", rows)
         if payload.size >= shm_min:
             try:
                 from multiprocessing import shared_memory
@@ -380,8 +446,11 @@ def _pooled_partition_task(token: str, plan_blob: bytes,
                     logger.debug("pipeline: resource-tracker "
                                  "unregister failed: %s", e)
                 shm.close()
-                return ("shm", name, payload.size, busy, timings, rows)
-        return ("buf", payload.to_pybytes(), busy, timings, rows)
+                return _with_frame(
+                    ("shm", name, payload.size, busy, timings, rows),
+                    agent)
+        return _with_frame(
+            ("buf", payload.to_pybytes(), busy, timings, rows), agent)
     except BaseException as exc:  # ships back typed; never raises
         blob = None
         try:
@@ -389,17 +458,50 @@ def _pooled_partition_task(token: str, plan_blob: bytes,
             blob = cloudpickle.dumps(exc)
         except Exception:
             blob = None
-        return ("err", blob, repr(exc), type(exc).__name__)
+        return _with_frame(
+            ("err", blob, repr(exc), type(exc).__name__), agent)
 
 
 # ---------------------------------------------------------------------------
 # consumer side
 # ---------------------------------------------------------------------------
 
+#: base tuple length per result kind — the frame demux key: a result
+#: longer than its base length carries EXACTLY one trailing telemetry
+#: frame (armed streams only; disarmed tuples are the base shapes)
+_RESULT_BASE_LEN = {"shm": 6, "buf": 5, "err": 4}
+
+
+def _split_frame(result: tuple) -> Tuple[tuple, Optional[dict]]:
+    """``(base_result, frame_or_None)`` — the parent half of the
+    transport seam (:mod:`sparkdl_tpu.obs.remote`)."""
+    if not isinstance(result, tuple) or not result:
+        return result, None
+    base = _RESULT_BASE_LEN.get(result[0])
+    if base is None or len(result) <= base:
+        return result, None
+    return result[:base], result[base]
+
+
+def _ingest_frame(frame: Optional[dict]) -> None:
+    if frame is None:
+        return
+    try:
+        from sparkdl_tpu.obs import remote
+        remote.aggregator().ingest(frame)
+    except Exception:
+        # ingest() guards itself (worker.ingest_errors); this catches
+        # an unimportable aggregator, which must not fail the fragment
+        default_registry().counter("worker.ingest_errors").add()
+        logger.exception("pipeline: telemetry frame ingest failed")
+
+
 def _release_result(result: tuple) -> None:
     """Free a completed-but-unconsumed task result (early-stop or
     error abandonment): the shared-memory segment must be unlinked or
     an abandoned stream leaks ``/dev/shm``."""
+    result, frame = _split_frame(result)
+    _ingest_frame(frame)  # an abandoned fragment's telemetry survives
     if not isinstance(result, tuple) or not result or result[0] != "shm":
         return
     try:
@@ -438,7 +540,13 @@ def _consume_result(result: tuple) -> Tuple[pa.RecordBatch, float,
     """A task result tuple -> (batch, busy_seconds, stage timings).
     Shared-memory fragments are copied ONCE into process-owned bytes
     and the segment is released immediately; the batch then aliases
-    the owned bytes zero-copy for the rest of its life."""
+    the owned bytes zero-copy for the rest of its life. An armed
+    stream's trailing telemetry frame is split off and ingested FIRST
+    — an "err" result's frame still reaches the aggregator (the
+    injected-fault drill is attributed even though the fragment
+    raises)."""
+    result, frame = _split_frame(result)
+    _ingest_frame(frame)
     kind = result[0]
     if kind == "err":
         _raise_worker_error(result)
@@ -557,6 +665,25 @@ def state() -> Dict[str, Any]:
     return out
 
 
+def _retire_worker_telemetry(handle) -> None:
+    """Before a CLEAN process-pool shutdown, tell the telemetry
+    aggregator these worker pids are retiring — otherwise a LATER pool
+    break probes the reaped pids and misattributes the clean exits as
+    deaths. Thread pools (no ``_processes``) are a no-op."""
+    if handle is None:
+        return
+    procs = getattr(handle.pool, "_processes", None)
+    if not procs:
+        return
+    try:
+        from sparkdl_tpu.obs import remote
+        remote.aggregator().note_pool_retired(list(procs.keys()))
+    # sparkdl-lint: allow[H12] -- best-effort lifecycle bookkeeping: the shutdown itself proceeds either way, and an unretired slot only risks a later over-count that note_pool_broken's ERROR log surfaces
+    except Exception:
+        logger.exception("pipeline: worker retirement bookkeeping "
+                         "failed")
+
+
 class _PoolHandle:
     """One pool GENERATION. Streams pin the handle for their whole
     life (``refs``), so a live resize — the autotuner moving
@@ -662,6 +789,7 @@ class HostPipeline:
                 self._proc_handle = new
                 shut = self._retire_locked(h)
         if shut is not None:
+            _retire_worker_telemetry(shut)
             shut.pool.shutdown(wait=False, cancel_futures=True)
         return new
 
@@ -697,15 +825,29 @@ class HostPipeline:
             handle.refs -= 1
             shut = handle.retired and handle.refs <= 0
         if shut:
+            _retire_worker_telemetry(handle)
             handle.pool.shutdown(wait=False, cancel_futures=True)
 
     def _mark_broken(self) -> None:
         with self._lock:
+            already = self._proc_broken
             self._proc_broken = True
             shut = self._retire_locked(self._proc_handle)
             self._proc_handle = None
         if shut is not None:
             shut.pool.shutdown(wait=False, cancel_futures=True)
+        if not already:
+            # attribute the corpse: probe the telemetry plane's known
+            # worker pids, mark the dead one, count
+            # pipeline.worker_deaths, dump a flight bundle naming it
+            try:
+                from sparkdl_tpu.obs import remote
+                remote.aggregator().note_pool_broken(
+                    "process pool broke (a worker process died)")
+            # sparkdl-lint: allow[H12] -- best-effort death attribution: the broken pool itself is already counted (pipeline.fallbacks) and raises typed (PipelineWorkerError) upstream
+            except Exception:
+                logger.exception("pipeline: worker-death attribution "
+                                 "failed")
 
     def shutdown(self) -> None:
         with self._lock:
@@ -717,6 +859,7 @@ class HostPipeline:
                     h.retired = True
         for h in handles:
             if h is not None:
+                _retire_worker_telemetry(h)
                 h.pool.shutdown(wait=False, cancel_futures=True)
 
     # -- mode resolution -----------------------------------------------------
@@ -796,6 +939,11 @@ class HostPipeline:
                         handle: _PoolHandle):
         plan_blob, src_blobs = payload
         token = uuid.uuid4().hex
+        # resolved ONCE per stream: None (disarmed) costs nothing and
+        # ships nothing; armed, every task carries the config so any
+        # worker the task lands on arms its agent
+        from sparkdl_tpu.obs import remote
+        tel = remote.telemetry_config()
 
         def submit(pos: int) -> Future:
             from concurrent.futures.process import BrokenProcessPool
@@ -803,7 +951,7 @@ class HostPipeline:
                 return handle.pool.submit(_pooled_partition_task,
                                           token, plan_blob,
                                           src_blobs[pos], pos,
-                                          self.shm_min_bytes)
+                                          self.shm_min_bytes, tel)
             except BrokenProcessPool as exc:
                 self._mark_broken()
                 _count("fallbacks")

@@ -2,7 +2,7 @@
 Perfetto-exportable timelines, and an operable health surface across
 engine → ship → device.
 
-Ten pieces (docs/OBSERVABILITY.md):
+Eleven pieces (docs/OBSERVABILITY.md):
 
 * :mod:`sparkdl_tpu.obs.compile_log` — compile forensics: every
   package jit compile routes through ONE CompileLog (callable name,
@@ -49,7 +49,16 @@ Ten pieces (docs/OBSERVABILITY.md):
   p99 from an exported trace);
 * :mod:`sparkdl_tpu.obs.slo` — rolling-window SLO evaluation (latency
   + availability objectives): error-budget remaining and burn rate,
-  published as ``sparkdl_slo_*`` on ``/metricsz``.
+  published as ``sparkdl_slo_*`` on ``/metricsz``;
+* :mod:`sparkdl_tpu.obs.remote` — the cross-process telemetry plane:
+  pipeline worker processes arm a :class:`TelemetryAgent` that ships
+  spans, counter deltas, watchdog verdicts, degrade events, and fault
+  state back over the result hand-off; the parent
+  :class:`TelemetryAggregator` merges worker spans into ONE
+  clock-aligned Perfetto trace, folds counters into ``worker.<i>.*``
+  (+ ``worker.all.*`` rollups), and extends ``/healthz``, flight
+  bundles (``workers[]``), and ``report --workers`` across process
+  boundaries.
 
 Import-light on purpose: nothing here pulls jax (the report CLI and
 the telemetry endpoint work on any machine); :func:`timed_device_get`
@@ -88,6 +97,12 @@ from sparkdl_tpu.obs.request_log import (
     RequestTimeline,
     request_log,
 )
+from sparkdl_tpu.obs.remote import (
+    TelemetryAgent,
+    TelemetryAggregator,
+    telemetry_config,
+)
+from sparkdl_tpu.obs.remote import aggregator as telemetry_aggregator
 from sparkdl_tpu.obs.slo import SLObjective, SLOTracker, slo_tracker
 from sparkdl_tpu.obs.trace import (
     SpanRecord,
@@ -113,6 +128,8 @@ __all__ = [
     "SLOTracker",
     "SpanRecord",
     "StallWatchdog",
+    "TelemetryAgent",
+    "TelemetryAggregator",
     "TelemetryServer",
     "Tracer",
     "UtilizationLedger",
@@ -130,6 +147,8 @@ __all__ = [
     "span",
     "stall_watchdog",
     "start_telemetry",
+    "telemetry_aggregator",
+    "telemetry_config",
     "timed_device_get",
     "tracer",
 ]

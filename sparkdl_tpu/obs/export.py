@@ -105,6 +105,10 @@ HELP_BY_PREFIX = (
              "high-watermark tracking (obs/compile_log.py)"),
     ("obs.", "the observability layer's own accounting "
              "(sparkdl_tpu/obs)"),
+    ("worker.", "cross-process telemetry shipped by pipeline worker "
+                "processes: per-worker (worker.<i>.*) and rollup "
+                "(worker.all.*) mirrors of worker-side counters, plus "
+                "the aggregator's own accounting (obs/remote.py)"),
     ("faults.", "armed fault-injection drill counters "
                 "(resilience/faults.py)"),
     ("resilience.", "shared retry-policy/budget accounting "
@@ -290,12 +294,25 @@ class TelemetryServer:
                             "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
                 verdict = self._watchdog.verdict()
-                code = 200 if verdict["healthy"] else 503
+                # a pipeline worker's OWN watchdog verdict reaches the
+                # liveness bit too (obs/remote.py): a wedged worker
+                # process is a stalled pipeline even when every
+                # parent-side loop still beats
+                try:
+                    from sparkdl_tpu.obs import remote
+                    worker_health = remote.aggregator().health()
+                except Exception as e:
+                    logger.debug("telemetry: worker health probe "
+                                 "failed: %s", e)
+                    worker_health = {"workers": 0, "stalled": [],
+                                     "dead": []}
+                code = (200 if verdict["healthy"]
+                        and not worker_health["stalled"] else 503)
                 # the compile-forensics detail (obs/compile_log.py):
                 # unexpected retraces are a perf-guarantee violation,
                 # not a liveness failure — the status code stays the
-                # watchdog's; the detail flips so a probe (and ci.sh's
-                # gate) sees the warm-start contract break
+                # stall verdicts'; the detail flips so a probe (and
+                # ci.sh's gate) sees the warm-start contract break
                 try:
                     from sparkdl_tpu.obs.compile_log import compile_log
                     retraces = compile_log().unexpected_retraces
@@ -304,6 +321,8 @@ class TelemetryServer:
                 body = json.dumps({
                     "status": "ok" if code == 200 else "stalled",
                     "stalled_sources": verdict["stalled_sources"],
+                    "worker_stalled": worker_health["stalled"],
+                    "worker_dead": worker_health["dead"],
                     "watchdog_armed": verdict["armed"],
                     "unexpected_retraces": retraces,
                     "compile_steady": (retraces == 0
@@ -378,6 +397,11 @@ class TelemetryServer:
             # bundle's section, so a curl and a postmortem never
             # disagree
             "pipeline": _flight.pipeline_state(),
+            # the cross-process telemetry plane's per-worker view
+            # (obs/remote.py) — same shape as the flight bundle's
+            # workers[] section, so a curl and a postmortem never
+            # disagree
+            "workers": _flight.workers_state(),
             # compile forensics (obs/compile_log.py): per-function
             # compile counts, retrace attribution, the steady-state
             # zero-retrace verdict — same shape as the flight

@@ -258,6 +258,21 @@ def pipeline_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def workers_state() -> list:
+    """The per-worker telemetry plane's ``workers[]`` section — agent
+    state, last spans, counter snapshot, fault config for every
+    pipeline worker process that has shipped a frame
+    (obs/remote.py) — ONE shape shared by the flight bundle and
+    ``/statusz`` so a curl and a postmortem never disagree (a
+    worker-death bundle NAMES the dead worker here); degrades like
+    every probe."""
+    try:
+        from sparkdl_tpu.obs import remote
+        return remote.aggregator().workers_status()
+    except Exception as e:
+        return [{"error": f"{type(e).__name__}: {e}"}]
+
+
 def _autotune_state() -> dict:
     """The autotune controller's knob/decision state — the bundle's
     "what was the loop doing" section; degrades like every other probe
@@ -382,6 +397,7 @@ class FlightRecorder:
             "compile": compile_state(),
             "ledger": ledger_state(),
             "pipeline": pipeline_state(),
+            "workers": workers_state(),
             "slo": _slo_state(),
             "requests": _request_state(),
             "resilience": resilience_state(),

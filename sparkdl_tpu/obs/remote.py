@@ -1,0 +1,636 @@
+"""Cross-process telemetry plane: worker-side agents, a parent-side
+aggregator, and a clock-aligned trace merge.
+
+PR 14's pipeline worker processes were observability blind spots: only
+busy-seconds folded back to the parent, while worker spans, registry
+counters, watchdog verdicts, ``warn_once`` degrade events, and
+fault-injection state all died with the worker. That is untenable for
+ROADMAP item 1 (pod-scale execution needs the same stall/bound/failure
+story a local run has) and item 3 (the tf.data-service shape —
+PAPERS.md, arxiv 2101.12127 — disaggregates input processing onto
+remote worker fleets, which only works with per-worker telemetry
+flowing to one aggregation point). This module is that plane:
+
+* :class:`TelemetryAgent` — ONE per worker process, armed from the
+  parent's shipped config (``telemetry_config()``) at first armed
+  task. It arms the worker's own tracer/watchdog/fault harness, then
+  ``cut_frame()`` packages everything recorded since the last cut —
+  span records, registry counter DELTAS and changed gauges, the
+  watchdog verdict, captured ``warn_once`` degrade events, and
+  ``faults.state()`` — as one plain-picklable frame riding the
+  existing result-pipe/shm hand-off (``data/pipeline.py`` appends it
+  to the task result tuple). The frame is the generalizable transport
+  seam: ROADMAP item 3's socket workers ship the same dict over a
+  socket instead of a pipe, and the aggregator cannot tell the
+  difference.
+* :class:`TelemetryAggregator` — ONE per parent process
+  (:func:`aggregator`). ``ingest(frame)`` (a) stores worker spans in a
+  bounded per-worker ring for the clock-aligned trace merge, (b) folds
+  worker counters into the registry under the bounded
+  ``worker.<i>.*`` namespace (``<i>`` is the worker SLOT index,
+  bounded by the pool size — never a request id; rule H6) plus
+  ``worker.all.*`` rollup totals, (c) dedupes degrade warnings across
+  processes (ONE parent log line per reason, per-worker counts
+  preserved), and (d) folds worker watchdog verdicts into the health
+  surface — a worker-reported stall reaches ``/healthz`` 503 detail
+  and triggers a flight dump.
+
+**Clock alignment** (the handshake): every frame carries a
+``(unix_time, perf_counter)`` pair sampled in the worker at cut time;
+the aggregator samples its own pair at ingest. Since both processes
+share one wall clock, a worker ``perf_counter`` value maps onto the
+parent's ``perf_counter`` timeline as::
+
+    offset = (worker_unix - worker_pc) - (parent_unix - parent_pc)
+    parent_equivalent_pc = worker_pc_value + offset
+
+so worker spans land time-aligned next to parent ship/device spans in
+ONE merged Perfetto trace (``Tracer.trace_events`` pulls
+:meth:`TelemetryAggregator.trace_events`), each worker on its own
+process track (pid ``WORKER_PID_BASE + index``).
+
+Arming: the plane follows the armed obs layers — the parent ships a
+non-``None`` config when the tracer, the watchdog, or the fault
+harness is armed. ``SPARKDL_TPU_REMOTE_TELEMETRY=1`` forces it on
+(workers trace even when the parent runs dark), ``=0`` pins it off.
+Disarmed, the whole plane is one ``None`` check per task on both
+sides, and the fragment hand-off carries ZERO extra bytes — the
+result tuples keep their exact pre-telemetry shapes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from sparkdl_tpu.obs.registry import Counter, Gauge, default_registry
+
+logger = logging.getLogger(__name__)
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+#: frame format tag — bump when the frame layout changes incompatibly
+FRAME_SCHEMA = 1
+
+#: env override for the whole plane: "1" forces it on even with the
+#: tracer/watchdog disarmed, "0" pins it off; unset follows the armed
+#: obs layers (docs/OBSERVABILITY.md "Cross-process telemetry")
+ENV_REMOTE = "SPARKDL_TPU_REMOTE_TELEMETRY"
+
+#: retained spans per worker at the parent — bounded ring, evictions
+#: counted (the tracer's no-silent-truncation discipline)
+WORKER_SPAN_CAPACITY = 4096
+
+#: Perfetto pid base for worker process groups: the parent tracer's
+#: lanes occupy small pids (1..N); workers land at BASE + slot index
+#: so the two families can never collide
+WORKER_PID_BASE = 1000
+
+#: retained degrade events / last-spans per worker in status views
+_STATUS_TAIL = 8
+
+
+def telemetry_config() -> Optional[dict]:
+    """The config the parent ships to worker processes — ``None`` when
+    the plane is disarmed (THE fast-path check: a ``None`` config
+    means the task tuple gains no frame and the worker arms nothing).
+    Armed when the parent tracer, watchdog, or fault harness is armed,
+    or when :data:`ENV_REMOTE` forces it; ``SPARKDL_TPU_REMOTE_TELEMETRY=0``
+    pins the plane off regardless."""
+    raw = os.environ.get(ENV_REMOTE, "").strip().lower()
+    if raw in _FALSE and raw:
+        return None
+    from sparkdl_tpu.obs.trace import tracer
+    from sparkdl_tpu.obs.watchdog import watchdog
+    from sparkdl_tpu.resilience import faults
+
+    trc, wd = tracer(), watchdog()
+    forced = raw in _TRUE
+    if not (forced or trc.armed or wd.armed or faults.armed()):
+        return None
+    return {
+        "v": FRAME_SCHEMA,
+        "trace": bool(trc.armed or forced),
+        "watchdog": wd.armed,
+        "threshold_s": wd.threshold_s,
+        "faults": faults.spec() or None,
+    }
+
+
+class TelemetryAgent:
+    """The worker-process side: arms the worker's obs layers from the
+    parent's config and cuts plain-picklable frames (module
+    docstring). One per worker process (:func:`worker_agent`);
+    standalone instances exist for tests."""
+
+    # sparkdl-lint H3 contract: a pool worker is single-threaded today,
+    # but the socket-worker reuse (ROADMAP item 3) is not — buffer
+    # writes hold self._lock
+    _lock_guards = ("_degrades", "_counter_base", "_gauge_base",
+                    "frames")
+
+    def __init__(self, config: dict):
+        self.config = dict(config)
+        self._lock = threading.Lock()
+        self._degrades: List[Tuple[str, str]] = []
+        self._counter_base: Dict[str, float] = {}
+        self._gauge_base: Dict[str, float] = {}
+        self.frames = 0
+        self._apply(self.config)
+
+    def _apply(self, config: dict) -> None:
+        """Arm the worker's obs layers per the parent's config, then
+        zero the baselines: a fork-started worker inherits the
+        parent's span ring and counter values, and shipping those back
+        would double-count everything the parent already has."""
+        from sparkdl_tpu.obs.trace import tracer
+        from sparkdl_tpu.obs.watchdog import watchdog
+        from sparkdl_tpu.resilience import faults
+
+        trc = tracer()
+        if config.get("trace"):
+            trc.arm()  # sparkdl-lint: allow[H11] -- armed for the worker PROCESS's whole life by design: spans buffer until each frame cut, and the arm state dies with the process (pool shutdown)
+        trc.clear()                      # drop fork-inherited spans
+        if config.get("watchdog"):
+            threshold = config.get("threshold_s")
+            threshold = (threshold if threshold and threshold > 0
+                         else None)
+            watchdog().arm(threshold_s=threshold)  # sparkdl-lint: allow[H11] -- process-lifetime arm mirroring the parent's watchdog config; verdicts ship per frame and the state dies with the worker process
+        spec = config.get("faults")
+        if spec:
+            faults.arm_spec(spec)
+        self._rebase()
+
+    def refit(self, config: dict) -> None:
+        """Apply a NEW stream's config to a persistent pool worker:
+        only the fault spec is live-switchable (a drill armed or
+        disarmed between streams must reach workers that already
+        exist); trace/watchdog arming is latched at agent creation.
+        Baselines are NOT rebased — the counter deltas of whatever the
+        worker did between frames still ship."""
+        from sparkdl_tpu.resilience import faults
+
+        spec = config.get("faults") or None
+        if spec == (self.config.get("faults") or None):
+            return
+        if spec:
+            faults.arm_spec(spec)
+        else:
+            faults.disarm()
+        self.config["faults"] = spec
+
+    def _rebase(self) -> None:
+        counters, gauges = _registry_values()
+        with self._lock:
+            self._counter_base = counters
+            self._gauge_base = gauges
+
+    def capture_degrade(self, reason: str, message: str) -> bool:
+        """Buffer one ``warn_once`` degrade event for the next frame;
+        returns True (captured — the caller suppresses its local log
+        so the PARENT emits the one deduped line)."""
+        with self._lock:
+            self._degrades.append((str(reason), str(message)))
+        return True
+
+    def cut_frame(self) -> dict:
+        """Everything recorded since the last cut, as one
+        plain-picklable dict — the transport payload the task result
+        carries back (or a socket worker ships verbatim)."""
+        from sparkdl_tpu.obs.trace import tracer
+        from sparkdl_tpu.obs.watchdog import watchdog
+        from sparkdl_tpu.resilience import faults
+
+        trc = tracer()
+        recs = trc.spans()
+        dropped = trc.dropped
+        trc.clear()
+        spans = [(r.name, r.lane, r.thread_id, r.thread_name,
+                  r.start, r.end, dict(r.attrs)) for r in recs]
+        counters, gauges = _registry_values()
+        with self._lock:
+            counter_deltas = {
+                k: v - self._counter_base.get(k, 0.0)
+                for k, v in counters.items()
+                if v != self._counter_base.get(k, 0.0)}
+            changed_gauges = {
+                k: v for k, v in gauges.items()
+                if v != self._gauge_base.get(k)}
+            self._counter_base = counters
+            self._gauge_base = gauges
+            degrades, self._degrades = self._degrades, []
+            self.frames += 1
+        wd = watchdog()
+        return {
+            "v": FRAME_SCHEMA,
+            "pid": os.getpid(),
+            # the clock-handshake pair: wall time is the ONLY bridge
+            # between per-process perf_counter origins; all span math
+            # stays on perf_counter deltas
+            "clock": (time.time(),  # sparkdl-lint: allow[H5] -- cross-process clock handshake: the wall stamp is the alignment bridge, not span/latency math
+                      time.perf_counter()),
+            "spans": spans,
+            "spans_dropped": dropped,
+            "counters": counter_deltas,
+            "gauges": changed_gauges,
+            "watchdog": wd.verdict() if wd.armed else None,
+            "degrades": degrades,
+            "faults": faults.state() if faults.armed() else None,
+        }
+
+    # locks don't pickle (H3); config travels, buffers stay local
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+def _registry_values() -> Tuple[Dict[str, float], Dict[str, float]]:
+    """(counters, gauges) value maps from the process registry —
+    kind-split because only counters difference meaningfully
+    (reservoirs stay worker-local: quantiles don't delta)."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for m in default_registry().metrics():
+        if isinstance(m, Counter):
+            counters[m.name] = m.value
+        elif isinstance(m, Gauge):
+            gauges[m.name] = m.value
+    return counters, gauges
+
+
+#: the one worker-process agent; ``None`` = disarmed (THE fast-path
+#: check for capture_degrade and the task's frame append)
+_AGENT: Optional[TelemetryAgent] = None
+
+
+def worker_agent(config: dict) -> TelemetryAgent:
+    """This process's agent, created (and armed) on first call — the
+    pool task's entry point. Later calls return the existing agent
+    (pool workers persist across streams), re-applying only the fault
+    spec when a new stream's config changed it (:meth:`refit`)."""
+    global _AGENT
+    agent = _AGENT
+    if agent is None:
+        agent = _AGENT = TelemetryAgent(config)
+    else:
+        agent.refit(config)
+    return agent
+
+
+def capture_degrade(reason: str, message: str) -> bool:
+    """The ``warn_once`` hook (runtime/runner.py, data/pipeline.py):
+    with an armed worker agent the degrade event ships to the parent
+    (which logs it ONCE across all workers) and this returns True so
+    the caller suppresses its local log. Disarmed — every parent
+    process, every disarmed worker — one global ``None`` check,
+    returns False, the caller logs exactly as before."""
+    agent = _AGENT
+    if agent is None:
+        return False
+    return agent.capture_degrade(reason, message)
+
+
+class TelemetryAggregator:
+    """The parent side: worker-frame ingest, counter folding, degrade
+    dedup, health verdicts, and the clock-aligned trace merge (module
+    docstring). One per process (:func:`aggregator`); standalone
+    instances exist for tests."""
+
+    # sparkdl-lint H3 contract: frames arrive on the pipeline consumer
+    # thread while /statusz, /healthz, flight dumps, and trace exports
+    # read concurrently — ALL worker-table state holds self._lock
+    _lock_guards = ("_workers", "_warned", "frames")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # pid -> slot dict; insertion order IS the worker index
+        # (bounded by the pool size — the H6 cardinality argument)
+        self._workers: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._warned: set = set()
+        self.frames = 0
+
+    # -- ingest ---------------------------------------------------------------
+
+    def ingest(self, frame: Optional[dict]) -> None:
+        """Fold one worker frame in. Never raises — a malformed frame
+        must not fail the fragment it rode with (counted in
+        ``worker.ingest_errors``, the H12 accounting)."""
+        if not isinstance(frame, dict):
+            return
+        try:
+            self._ingest(frame)
+        except Exception:
+            default_registry().counter("worker.ingest_errors").add()
+            logger.exception("remote telemetry: worker frame ingest "
+                             "failed")
+
+    def _slot_locked(self, pid: int) -> Dict[str, Any]:
+        slot = self._workers.get(pid)
+        if slot is None:
+            slot = self._workers[pid] = {
+                "index": len(self._workers),
+                "pid": pid,
+                "frames": 0,
+                "clock": None,          # (worker_unix, worker_pc)
+                "parent_clock": None,   # (parent_unix, parent_pc)
+                "spans": deque(maxlen=WORKER_SPAN_CAPACITY),
+                "spans_dropped": 0,
+                "span_evictions": 0,
+                "counters": {},
+                "watchdog": None,
+                "stalls_seen": 0,
+                "stalled": False,
+                "faults": None,
+                "degrades": deque(maxlen=_STATUS_TAIL),
+                "last_seen_unix": None,
+                "dead": False,
+                "retired": False,
+                "death_reason": None,
+            }
+        return slot
+
+    def _ingest(self, frame: dict) -> None:
+        reg = default_registry()
+        pid = int(frame.get("pid", 0))
+        parent_pair = (
+            time.time(),  # sparkdl-lint: allow[H5] -- the parent half of the clock handshake (wall bridge), not span/latency math
+            time.perf_counter())
+        counters = frame.get("counters") or {}
+        gauges = frame.get("gauges") or {}
+        spans = frame.get("spans") or []
+        degrades = frame.get("degrades") or []
+        verdict = frame.get("watchdog")
+        new_stalls = 0
+        fresh_warns: List[Tuple[int, str, str]] = []
+        with self._lock:
+            slot = self._slot_locked(pid)
+            idx = slot["index"]
+            slot["frames"] += 1
+            # a frame is proof of life: a reused slot (pool rebuild
+            # landing on the same pid) sheds any stale verdict
+            slot["dead"] = False
+            slot["retired"] = False
+            slot["death_reason"] = None
+            clock = frame.get("clock")
+            if (isinstance(clock, (tuple, list)) and len(clock) == 2):
+                slot["clock"] = (float(clock[0]), float(clock[1]))
+                slot["parent_clock"] = parent_pair
+            slot["last_seen_unix"] = parent_pair[0]
+            before = len(slot["spans"])
+            for rec in spans:
+                slot["spans"].append(tuple(rec))
+            overflow = before + len(spans) - len(slot["spans"])
+            slot["span_evictions"] += max(0, overflow)
+            slot["spans_dropped"] += int(frame.get("spans_dropped", 0)
+                                         or 0)
+            for key, delta in counters.items():
+                slot["counters"][key] = \
+                    slot["counters"].get(key, 0.0) + float(delta)
+            if verdict is not None:
+                slot["watchdog"] = verdict
+                fired = int(verdict.get("stalls_fired", 0) or 0)
+                new_stalls = max(0, fired - slot["stalls_seen"])
+                slot["stalls_seen"] = max(slot["stalls_seen"], fired)
+                slot["stalled"] = bool(verdict.get("stalled_sources"))
+            if frame.get("faults") is not None:
+                slot["faults"] = frame["faults"]
+            for reason, message in degrades:
+                if reason not in self._warned:
+                    self._warned.add(reason)
+                    fresh_warns.append((idx, pid, message))
+            self.frames += 1
+        # registry folding + logging OUTSIDE the lock (counter adds
+        # take their own locks; a flight dump re-enters workers_status)
+        reg.counter("worker.frames").add()
+        for key, delta in counters.items():
+            # bounded key family: <idx> is the worker slot index
+            # (pool-size bounded), <key> the worker's own documented
+            # registry key — rules H6/H9, docs/OBSERVABILITY.md
+            reg.counter(f"worker.{idx}.{key}").add(float(delta))
+            reg.counter(f"worker.all.{key}").add(float(delta))
+        for key, value in gauges.items():
+            reg.gauge(f"worker.{idx}.{key}").set(float(value))
+        for _ in range(len(degrades)):
+            reg.counter(f"worker.{idx}.degrade_events").add()
+            reg.counter("worker.all.degrade_events").add()
+        for widx, wpid, message in fresh_warns:
+            logger.warning("worker %d (pid %d): %s", widx, wpid,
+                           message)
+        if new_stalls:
+            reg.counter("worker.stalls").add(new_stalls)
+            logger.error(
+                "remote telemetry: worker %d (pid %d) reported %d "
+                "watchdog stall(s) from its own monitor — sources: %s",
+                idx, pid, new_stalls,
+                (verdict or {}).get("stalled_sources"))
+            self._dump_flight(
+                f"worker stall: worker {idx} (pid {pid}) reported "
+                f"{new_stalls} stall(s) from its own watchdog")
+
+    def _dump_flight(self, reason: str) -> None:
+        try:
+            from sparkdl_tpu.obs import flight
+            rec = flight.recorder()
+            if rec.armed:
+                rec.dump(reason=reason)
+        # sparkdl-lint: allow[H12] -- the stall/death IS accounted (worker.stalls / pipeline.worker_deaths counters + ERROR log fired before this call); the dump is best-effort forensics on top
+        except Exception:
+            logger.exception("remote telemetry: flight dump failed")
+
+    # -- worker death ---------------------------------------------------------
+
+    def note_pool_broken(self, reason: str) -> List[int]:
+        """Called when the process pool breaks (a worker process
+        died): probe every known worker pid, mark the gone ones dead,
+        count ``pipeline.worker_deaths``, and (armed) dump a flight
+        bundle whose ``workers[]`` section names the corpse. Returns
+        the newly-dead worker indexes."""
+        dead: List[Tuple[int, int]] = []
+        with self._lock:
+            # retired slots are workers a CLEAN pool shutdown already
+            # reaped (note_pool_retired) — their exit is not a death
+            probe = [(slot["index"], pid, slot)
+                     for pid, slot in self._workers.items()
+                     if not slot["dead"] and not slot["retired"]]
+        for idx, pid, slot in probe:
+            alive = True
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                alive = False
+            if alive:
+                continue
+            with self._lock:
+                if slot["dead"]:
+                    continue
+                slot["dead"] = True
+                slot["death_reason"] = reason
+            dead.append((idx, pid))
+        reg = default_registry()
+        for idx, pid in dead:
+            reg.counter("pipeline.worker_deaths").add()
+            logger.error(
+                "remote telemetry: worker %d (pid %d) is DEAD — %s",
+                idx, pid, reason)
+        if dead:
+            names = ", ".join(f"worker {i} (pid {p})" for i, p in dead)
+            self._dump_flight(f"pipeline worker death: {names} — "
+                              f"{reason}")
+        return [idx for idx, _pid in dead]
+
+    def note_pool_retired(self, pids: Optional[List[int]] = None
+                          ) -> None:
+        """Called on a CLEAN pool shutdown/resize: mark the named
+        worker pids (or, with ``None``, every live slot) retired so a
+        LATER pool break doesn't probe their reaped pids and
+        misattribute the clean exits as deaths. No counter, no dump —
+        retirement is the normal lifecycle."""
+        with self._lock:
+            wanted = None if pids is None else set(pids)
+            for pid, slot in self._workers.items():
+                if slot["dead"] or slot["retired"]:
+                    continue
+                if wanted is None or pid in wanted:
+                    slot["retired"] = True
+
+    # -- the merged trace -----------------------------------------------------
+
+    def trace_events(self, epoch: float) -> List[dict]:
+        """The retained worker spans as Chrome trace events on the
+        PARENT timeline: one process group per worker (pid
+        ``WORKER_PID_BASE + index``), timestamps converted through the
+        per-worker clock handshake so they land microsecond-aligned
+        next to parent spans exported against ``epoch``
+        (``Tracer.trace_events`` calls this for the merge)."""
+        with self._lock:
+            snap = [(s["index"], pid, s["clock"], s["parent_clock"],
+                     list(s["spans"]), s["spans_dropped"]
+                     + s["span_evictions"], s["dead"])
+                    for pid, s in self._workers.items()]
+        events: List[dict] = []
+        for idx, pid, clock, parent_clock, spans, dropped, dead in snap:
+            if clock is None or parent_clock is None:
+                continue
+            wpid = WORKER_PID_BASE + idx
+            offset = ((clock[0] - clock[1])
+                      - (parent_clock[0] - parent_clock[1]))
+            name = f"worker.{idx} (pid {pid})"
+            if dead:
+                name += " [DEAD]"
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": wpid, "tid": 0,
+                           "args": {"name": name}})
+            named_threads = set()
+            for rec in spans:
+                sname, lane, tid, tname, start, end, attrs = rec
+                tid = int(tid or 0)
+                if tid not in named_threads:
+                    named_threads.add(tid)
+                    events.append({"name": "thread_name", "ph": "M",
+                                   "pid": wpid, "tid": tid,
+                                   "args": {"name": tname}})
+                ts = round((start + offset - epoch) * 1e6, 3)
+                dur = round(max(end - start, 0.0) * 1e6, 3)
+                events.append({
+                    "name": sname, "cat": lane, "ph": "X",
+                    "ts": ts, "dur": dur,
+                    "pid": wpid, "tid": tid,
+                    "args": dict(attrs, worker=idx),
+                })
+            if dropped:
+                events.append({
+                    "name": f"worker.{idx} dropped {dropped} spans "
+                            "(worker ring + parent retention bounds)",
+                    "ph": "i", "s": "g", "ts": 0.0, "pid": wpid,
+                    "tid": 0, "args": {"dropped": dropped,
+                                       "worker": idx}})
+        return events
+
+    # -- health + status ------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """The rolled-up worker health verdict for ``/healthz``: which
+        workers' OWN watchdogs report a live stall, and which are
+        dead."""
+        with self._lock:
+            stalled = [f"worker.{s['index']}"
+                       for s in self._workers.values() if s["stalled"]]
+            dead = [f"worker.{s['index']}"
+                    for s in self._workers.values() if s["dead"]]
+            return {"workers": len(self._workers),
+                    "stalled": sorted(stalled), "dead": sorted(dead)}
+
+    def workers_status(self) -> List[dict]:
+        """The per-worker ``workers[]`` section — ONE shape shared by
+        the flight bundle, ``/statusz``, and ``report --workers``:
+        agent state, last spans, counter snapshot, fault config."""
+        with self._lock:
+            snap = [dict(slot, spans=list(slot["spans"]),
+                         degrades=list(slot["degrades"]))
+                    for slot in self._workers.values()]
+        out = []
+        for s in sorted(snap, key=lambda d: d["index"]):
+            last_spans = [
+                {"name": rec[0], "lane": rec[1],
+                 "dur_ms": round(max(rec[5] - rec[4], 0.0) * 1e3, 3)}
+                for rec in s["spans"][-_STATUS_TAIL:]]
+            out.append({
+                "index": s["index"],
+                "pid": s["pid"],
+                "frames": s["frames"],
+                "last_seen_unix": s["last_seen_unix"],
+                "dead": s["dead"],
+                "retired": s["retired"],
+                "death_reason": s["death_reason"],
+                "stalled": s["stalled"],
+                "spans_retained": len(s["spans"]),
+                "spans_dropped": s["spans_dropped"]
+                + s["span_evictions"],
+                "watchdog": s["watchdog"],
+                "faults": s["faults"],
+                "degrades": [{"reason": r, "message": m}
+                             for r, m in s["degrades"]],
+                "counters": {k: v
+                             for k, v in sorted(s["counters"].items())},
+                "last_spans": last_spans,
+            })
+        return out
+
+    def clear(self) -> None:
+        """Drop every worker slot and the degrade-dedup set (test
+        isolation; registry mirrors are the registry's to clear)."""
+        with self._lock:
+            self._workers.clear()
+            self._warned.clear()
+            self.frames = 0
+
+    # locks don't pickle (H3); the worker table is process-local
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        del state["_workers"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._workers = OrderedDict()
+
+
+_AGGREGATOR = TelemetryAggregator()
+
+
+def aggregator() -> TelemetryAggregator:
+    """THE parent-process aggregator every transport feeds (the
+    pipeline's frame demux today, socket workers tomorrow)."""
+    return _AGGREGATOR

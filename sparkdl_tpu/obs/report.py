@@ -31,6 +31,12 @@ the ``device_get``/``device_put`` wire edges, compute = ship-lane
 ``ledger.attribute()`` call, so the offline trace verdict and the
 live ``ledger.bound_by`` gauge are one code path.
 
+``report --workers [--bundle <flight.json>] <trace.json>`` renders the
+per-worker lanes a merged cross-process trace carries (the telemetry
+plane, obs/remote.py): per-worker busy % of the trace wall, span and
+partition counts, and — joined with a flight bundle's ``workers[]``
+section — rows decoded, degrade/fault counts, and dead/stalled flags.
+
 Forward-compat contract (both modes): event TYPES are data too — flow
 events (``ph`` s/t/f, how split requests link), counter events, and
 ``ph`` values this report has never heard of must all be skipped, not
@@ -434,6 +440,117 @@ def summarize_compile(events: Sequence[dict]) -> str:
     return "\n".join(lines)
 
 
+def workers_summary(events: Sequence[dict],
+                    bundle: Optional[dict] = None) -> Optional[dict]:
+    """Per-worker lanes of a merged trace (the cross-process telemetry
+    plane, obs/remote.py): every process group whose metadata name
+    starts with ``worker.`` becomes one row — busy % (union of its
+    span intervals over the WHOLE trace's wall, so worker lanes
+    compare directly against parent lanes), span/partition counts —
+    joined, when a flight ``bundle`` dict is given, with that worker's
+    ``workers[]`` entry (rows decoded, degrade/fault counts, dead
+    flag). Returns ``None`` for a trace with no worker process groups
+    (serial or disarmed run — forward AND backward compatible).
+    Forward-compat both ways: unknown worker tracks flow through as
+    rows here, and traces without them summarize fine everywhere
+    else."""
+    worker_of_pid = {}
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "process_name"
+                and "pid" in e):
+            name = str(e.get("args", {}).get("name", ""))
+            if name.startswith("worker."):
+                worker_of_pid[e["pid"]] = name
+    if not worker_of_pid:
+        return None
+    spans = [e for e in events
+             if e.get("ph") == "X" and "ts" in e and "pid" in e]
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+        wall_us = max(t1 - t0, 1e-9)
+    else:
+        wall_us = 1e-9
+    by_status: Dict[int, dict] = {}
+    if bundle:
+        for entry in bundle.get("workers") or []:
+            if isinstance(entry, dict) and "index" in entry:
+                by_status[entry["index"]] = entry
+    workers = []
+    for pid in sorted(worker_of_pid):
+        track = worker_of_pid[pid]
+        mine = [e for e in spans if e["pid"] == pid]
+        busy = _merged_length([(e["ts"], e["ts"] + e.get("dur", 0.0))
+                               for e in mine])
+        # the track name is "worker.<i> (pid NNNN)[ [DEAD]]" — the
+        # slot index keys the bundle join; a rename stays a plain row
+        try:
+            index = int(track.split()[0].split(".", 1)[1])
+        except (IndexError, ValueError):
+            index = None
+        status = by_status.get(index, {})
+        counters = status.get("counters") or {}
+        faults_state = status.get("faults") or {}
+        fault_count = sum(
+            s.get("injected", 0)
+            for s in (faults_state.get("sites") or {}).values()
+            if isinstance(s, dict))
+        workers.append({
+            "track": track,
+            "index": index,
+            "busy_pct": round(100.0 * busy / wall_us, 1),
+            "busy_ms": round(busy / 1e3, 3),
+            "spans": len(mine),
+            "partitions": sum(1 for e in mine
+                              if e.get("name") == "worker.decode"),
+            "rows": counters.get("pipeline.worker_rows"),
+            # bundle counters round-trip through JSON as floats
+            "degrades": int(counters.get("pipeline.degrade_events", 0)
+                            + len(status.get("degrades") or [])),
+            "faults_injected": int(fault_count),
+            "dead": bool(status.get("dead")),
+            "stalled": bool(status.get("stalled")),
+        })
+    return {"wall_ms": round(wall_us / 1e3, 3), "workers": workers}
+
+
+def summarize_workers(events: Sequence[dict],
+                      bundle: Optional[dict] = None) -> str:
+    """The ``--workers`` text section (unit-testable without the
+    CLI)."""
+    w = workers_summary(events, bundle=bundle)
+    if w is None:
+        return ("(no worker process tracks in trace — arm "
+                "SPARKDL_TPU_TRACE and run a pipeline_mode=process "
+                "stream to record cross-process worker timelines; "
+                "serial and thread-mode runs have none)")
+    lines = [f"pipeline workers (merged cross-process trace, "
+             f"{w['wall_ms']:.3f} ms wall; busy % is of the WHOLE "
+             "trace wall — directly comparable to parent lanes)",
+             "",
+             "worker            busy_ms   busy%  spans  parts  "
+             "rows  degrades  faults"]
+    for row in w["workers"]:
+        flags = ""
+        if row["dead"]:
+            flags += "  [DEAD]"
+        if row["stalled"]:
+            flags += "  [STALLED]"
+        rows = "?" if row["rows"] is None else f"{int(row['rows'])}"
+        lines.append(
+            f"{row['track'].split(' ')[0].ljust(16)}  "
+            f"{row['busy_ms']:8.3f}  {row['busy_pct']:5.1f}%  "
+            f"{row['spans']:5d}  {row['partitions']:5d}  "
+            f"{rows.rjust(4)}  {row['degrades']:8d}  "
+            f"{row['faults_injected']:6d}{flags}")
+    if not any(r["rows"] is not None for r in w["workers"]):
+        lines.append("")
+        lines.append("(rows/degrades/faults need a flight bundle: "
+                     "report --workers --bundle <bundle.json> "
+                     "<trace.json>)")
+    return "\n".join(lines)
+
+
 def main(argv: Sequence[str]) -> int:
     args = list(argv)
     tails = "--tails" in args
@@ -445,9 +562,27 @@ def main(argv: Sequence[str]) -> int:
     compile_ = "--compile" in args
     if compile_:
         args.remove("--compile")
+    workers = "--workers" in args
+    if workers:
+        args.remove("--workers")
+    bundle = None
+    if "--bundle" in args:
+        i = args.index("--bundle")
+        if i + 1 >= len(args):
+            print("error: --bundle needs a flight-bundle path")
+            return 2
+        bundle_path = args[i + 1]
+        del args[i:i + 2]
+        try:
+            with open(bundle_path, encoding="utf-8") as f:
+                bundle = json.load(f)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}")
+            return 2
     if len(args) != 2 or args[0] != "report":
         print("usage: python -m sparkdl_tpu.obs report [--tails] "
-              "[--bound] [--compile] <trace.json>")
+              "[--bound] [--compile] [--workers] "
+              "[--bundle <flight.json>] <trace.json>")
         return 2
     try:
         events = load_events(args[1])
@@ -466,4 +601,8 @@ def main(argv: Sequence[str]) -> int:
         print()
         print("compile forensics (retrace attribution)")
         print(summarize_compile(events))
+    if workers:
+        print()
+        print("cross-process workers (per-worker lanes)")
+        print(summarize_workers(events, bundle=bundle))
     return 0

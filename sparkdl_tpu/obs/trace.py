@@ -279,6 +279,24 @@ class Tracer:
                         "SPARKDL_TPU_TRACE_BUFFER)",
                 "ph": "i", "s": "g", "ts": 0.0, "pid": 0, "tid": 0,
                 "args": {"dropped": dropped}})
+        if self is _TRACER:
+            # ONE merged timeline is the whole point: the process-wide
+            # export additionally carries the spans pipeline worker
+            # processes shipped through the cross-process telemetry
+            # plane, clock-aligned onto THIS tracer's epoch, each
+            # worker on its own process track (obs/remote.py; lanes
+            # claim small pids, workers claim WORKER_PID_BASE+i, so
+            # the two families cannot collide)
+            try:
+                from sparkdl_tpu.obs import remote
+                events.extend(
+                    remote.aggregator().trace_events(self._epoch))
+            # sparkdl-lint: allow[H12] -- the parent-side trace must export even if the remote merge breaks; aggregator ingest errors are already counted (worker.ingest_errors)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "trace export: worker-span merge failed; exporting "
+                    "parent spans only")
         return events
 
     def export(self, path: str) -> int:

@@ -19,7 +19,20 @@ site                      where it fires
 ``collective.launch``     entering the collective launch lock
 ``serve.dispatch``        the serve dispatcher's micro-batch runner call
 ``model.fetch``           ``ModelFetcher`` cache/weight reads
+``pipeline.worker_decode``  per-task decode inside a pipeline WORKER process
+``pipeline.worker_death``   kills a live pipeline worker process outright
 ========================  ==================================================
+
+The two ``pipeline.worker_*`` sites fire inside pool worker
+*processes*: workers inherit ``SPARKDL_TPU_FAULTS`` through the
+environment (fork and spawn both re-run :func:`arm_from_env` at
+import), and the cross-process telemetry plane
+(:mod:`sparkdl_tpu.obs.remote`) additionally ships a parent's
+*programmatic* spec to workers via :func:`arm_spec`, so
+``inject(...)`` drills reach the worker fleet too.
+``pipeline.worker_death`` is the ROADMAP-named worker-death drill: the
+task handler converts the injected fault into ``os._exit(1)`` — a real
+process corpse, a real ``BrokenProcessPool``, not a simulated error.
 
 Arming:
 
@@ -70,6 +83,8 @@ SITES = (
     "collective.launch",
     "serve.dispatch",
     "model.fetch",
+    "pipeline.worker_decode",
+    "pipeline.worker_death",
 )
 
 _KINDS = ("transient", "permanent")
@@ -212,6 +227,13 @@ def armed() -> bool:
     return _PLAN is not None
 
 
+def spec() -> str:
+    """The armed spec string (``""`` disarmed) — what the telemetry
+    plane ships to worker processes so a parent-side ``inject()``
+    drill arms the fleet (:mod:`sparkdl_tpu.obs.remote`)."""
+    return _SPEC
+
+
 def state() -> dict:
     """The harness state for flight bundles / ``/statusz`` / bench:
     armed-ness, the effective spec, and per-site config + counts."""
@@ -250,26 +272,38 @@ def _parse_env(spec: str) -> Optional[Dict[str, _SiteFault]]:
     return plan or None
 
 
+def arm_spec(raw: str) -> bool:
+    """Arm from an explicit spec string — the same grammar and
+    degrade contract as the env path. This is how a worker-side
+    telemetry agent applies the parent's shipped spec
+    (:mod:`sparkdl_tpu.obs.remote`): a malformed spec degrades to the
+    current plan with one warning, never an unimportable worker."""
+    global _PLAN, _SPEC
+    raw = (raw or "").strip()
+    if not raw:
+        return _PLAN is not None
+    plan = _parse_env(raw)
+    if plan is None:
+        logger.warning(
+            "%r is not a valid fault spec "
+            "(<site>:<kind>:<rate>[:seed], comma-separated; sites: %s; "
+            "kinds: %s); fault injection stays disarmed",
+            raw, ", ".join(SITES), ", ".join(_KINDS))
+        return _PLAN is not None
+    _PLAN = plan
+    _SPEC = raw
+    return True
+
+
 def arm_from_env() -> bool:
     """Apply ``SPARKDL_TPU_FAULTS`` (idempotent; also runs at import).
     Returns whether the harness ended up armed. A malformed spec
     degrades to disarmed with one warning — the config-typo
     discipline every env knob in this tree follows."""
-    global _PLAN, _SPEC
-    spec = os.environ.get("SPARKDL_TPU_FAULTS", "").strip()
-    if not spec:
+    spec_str = os.environ.get("SPARKDL_TPU_FAULTS", "").strip()
+    if not spec_str:
         return _PLAN is not None
-    plan = _parse_env(spec)
-    if plan is None:
-        logger.warning(
-            "SPARKDL_TPU_FAULTS=%r is not a valid fault spec "
-            "(<site>:<kind>:<rate>[:seed], comma-separated; sites: %s; "
-            "kinds: %s); fault injection stays disarmed",
-            spec, ", ".join(SITES), ", ".join(_KINDS))
-        return _PLAN is not None
-    _PLAN = plan
-    _SPEC = spec
-    return True
+    return arm_spec(spec_str)
 
 
 arm_from_env()

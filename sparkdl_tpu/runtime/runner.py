@@ -310,10 +310,18 @@ _WARNED_REASONS: set = set()
 def warn_once(reason: str, msg: str, *args) -> None:
     """Log ``msg`` at WARNING exactly once per process per ``reason``
     key — every runner degrade path funnels through this so new
-    degrade reasons inherit the dedupe."""
+    degrade reasons inherit the dedupe. Inside a telemetry-armed
+    pipeline worker process the event ships to the parent instead
+    (which dedupes ACROSS workers and logs once,
+    :mod:`sparkdl_tpu.obs.remote`); everywhere else the hook is one
+    module-global ``None`` check."""
     if reason in _WARNED_REASONS:
         return
     _WARNED_REASONS.add(reason)
+    from sparkdl_tpu.obs import remote
+    if remote.capture_degrade(f"runner:{reason}",
+                              msg % args if args else msg):
+        return
     logging.getLogger(__name__).warning(msg, *args)
 
 

@@ -1,0 +1,517 @@
+"""Cross-process telemetry plane (obs/remote.py): config resolution,
+worker-side frames, the clock-aligned trace merge, counter folding,
+cross-process warn_once dedup, worker stall/death health, flight
+bundle ``workers[]``, ``report --workers``, and the disarmed
+zero-extra-bytes hand-off contract.
+
+The ISSUE-18 pins: skewed synthetic clocks align within tolerance, a
+merged Perfetto export carries one process group per worker with no
+timestamp inversions against the parent spans, an injected
+``pipeline.worker_decode`` fault in a directly-invoked worker task is
+counted AND attributed in its frame, and a dead pid is marked (while a
+cleanly-retired one never reads as a death).
+"""
+
+import json
+import multiprocessing
+import os
+import time
+import urllib.error
+import urllib.request
+
+import cloudpickle
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.data import DataFrame, LocalEngine
+from sparkdl_tpu.data import pipeline as host_pipeline
+from sparkdl_tpu.obs import default_registry, report, start_telemetry
+from sparkdl_tpu.obs import remote
+from sparkdl_tpu.obs.trace import span, tracer
+from sparkdl_tpu.obs.watchdog import watchdog
+from sparkdl_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def clean_plane(monkeypatch):
+    """Every test starts and ends with the plane disarmed: the agent
+    and aggregator are process-wide singletons, and an armed leftover
+    would leak worker groups into OTHER suites' trace exports."""
+    monkeypatch.delenv(remote.ENV_REMOTE, raising=False)
+    remote._AGENT = None
+    remote.aggregator().clear()
+    yield
+    remote._AGENT = None
+    remote.aggregator().clear()
+    faults.disarm()
+    wd = watchdog()
+    wd.disarm()
+    wd.arm_from_env()
+    trc = tracer()
+    trc.disarm()
+    trc.clear()
+    trc.arm_from_env()
+
+
+def _ids_df(ids, parts, engine):
+    return DataFrame(
+        DataFrame.from_table(pa.table({"id": ids}), parts)._sources,
+        engine=engine)
+
+
+def _frame(pid=4242, clock=None, spans=(), counters=None, gauges=None,
+           degrades=(), verdict=None, fault_state=None, dropped=0):
+    """A synthetic worker frame in the transport schema."""
+    if clock is None:
+        clock = (time.time(), time.perf_counter())
+    return {
+        "v": remote.FRAME_SCHEMA,
+        "pid": pid,
+        "clock": clock,
+        "spans": list(spans),
+        "spans_dropped": dropped,
+        "counters": dict(counters or {}),
+        "gauges": dict(gauges or {}),
+        "watchdog": verdict,
+        "degrades": list(degrades),
+        "faults": fault_state,
+    }
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------------
+
+class TestTelemetryConfig:
+    def test_disarmed_is_none(self):
+        assert remote.telemetry_config() is None
+
+    def test_armed_fields(self):
+        tracer().arm()
+        watchdog().arm(threshold_s=7.0)
+        faults.inject("pipeline.worker_decode", "transient", 0.5,
+                      seed=3)
+        cfg = remote.telemetry_config()
+        assert cfg is not None
+        assert cfg["v"] == remote.FRAME_SCHEMA
+        assert cfg["trace"] is True
+        assert cfg["watchdog"] is True
+        assert cfg["threshold_s"] == 7.0
+        assert "pipeline.worker_decode:transient:0.5" in cfg["faults"]
+
+    def test_env_pins_off(self, monkeypatch):
+        tracer().arm()
+        monkeypatch.setenv(remote.ENV_REMOTE, "0")
+        assert remote.telemetry_config() is None
+
+    def test_env_forces_on(self, monkeypatch):
+        monkeypatch.setenv(remote.ENV_REMOTE, "1")
+        cfg = remote.telemetry_config()
+        assert cfg is not None and cfg["trace"] is True
+
+
+# ---------------------------------------------------------------------------
+# the worker-side agent
+# ---------------------------------------------------------------------------
+
+class TestAgent:
+    def test_frame_carries_deltas_only(self):
+        reg = default_registry()
+        reg.counter("pipeline.worker_rows").add(100)   # pre-agent
+        agent = remote.TelemetryAgent({"v": 1, "trace": True})
+        with span("worker.decode", lane="worker", partition=0):
+            pass
+        reg.counter("pipeline.worker_rows").add(7)
+        frame = agent.cut_frame()
+        assert frame["pid"] == os.getpid()
+        assert len(frame["clock"]) == 2
+        names = [s[0] for s in frame["spans"]]
+        assert "worker.decode" in names
+        # the fork-inheritance rebase: only the post-arm delta ships
+        assert frame["counters"]["pipeline.worker_rows"] == 7.0
+        # a second cut ships nothing stale
+        again = agent.cut_frame()
+        assert again["spans"] == []
+        assert "pipeline.worker_rows" not in again["counters"]
+
+    def test_module_capture_degrade_disarmed(self):
+        assert remote.capture_degrade("pipeline:x", "msg") is False
+
+    def test_module_capture_degrade_armed(self):
+        remote._AGENT = remote.TelemetryAgent({"v": 1})
+        assert remote.capture_degrade("pipeline:x", "msg") is True
+        frame = remote._AGENT.cut_frame()
+        assert ("pipeline:x", "msg") in frame["degrades"]
+
+    def test_refit_switches_fault_spec_only(self):
+        agent = remote.worker_agent({"v": 1, "faults": None})
+        assert not faults.armed()
+        remote.worker_agent(
+            {"v": 1, "faults": "pipeline.worker_decode:transient:1.0"})
+        assert faults.armed()
+        assert agent is remote._AGENT
+        # spec removal disarms (a drill must not outlive its stream)
+        remote.worker_agent({"v": 1, "faults": None})
+        assert not faults.armed()
+
+    def test_disarmed_capture_overhead(self):
+        """The ISSUE's acceptance bound: the disarmed path is ONE
+        module-global check, same <10 µs/call regime as the tracer's
+        no-op span (min over repeats — noise only adds time)."""
+        n = 20_000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                remote.capture_degrade("hot", "msg")
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 10e-6, \
+            f"disarmed capture_degrade costs {best * 1e6:.2f} µs"
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+
+class TestClockAlignment:
+    def test_skewed_epochs_align_within_tolerance(self):
+        """A worker whose perf_counter origin is 100 s away from the
+        parent's still lands its spans at the right parent-relative
+        microsecond (the wall/mono bridge handshake)."""
+        agg = remote.TelemetryAggregator()
+        now_unix = time.time()
+        now_pc = time.perf_counter()
+        skew = 100.0
+        w_pc = now_pc - skew
+        # the worker saw this span end 0.5 s before it cut the frame
+        rec = ("worker.decode", "worker", 1, "MainThread",
+               w_pc - 0.5, w_pc - 0.4, {"partition": 0})
+        agg.ingest(_frame(clock=(now_unix, w_pc), spans=[rec]))
+        epoch = now_pc - 10.0
+        events = agg.trace_events(epoch)
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == 1
+        # true wall position: 10 s into the epoch window minus 0.5 s
+        expected_ts = 9.5e6
+        assert abs(xs[0]["ts"] - expected_ts) < 50_000, xs[0]
+        assert abs(xs[0]["dur"] - 0.1e6) < 1_000, xs[0]
+
+    def test_unclocked_worker_exports_nothing(self):
+        agg = remote.TelemetryAggregator()
+        f = _frame(spans=[("s", "worker", 1, "t", 0.0, 1.0, {})])
+        f["clock"] = None
+        agg.ingest(f)
+        assert agg.trace_events(0.0) == []
+
+
+# ---------------------------------------------------------------------------
+# merged perfetto schema (end-to-end, process pool)
+# ---------------------------------------------------------------------------
+
+class TestMergedTrace:
+    def test_process_stream_merges_aligned_worker_tracks(self, tmp_path,
+                                                         monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_PIPELINE_MPCTX", "fork")
+        tracer().arm()
+        tracer().clear()
+        eng = LocalEngine(pipeline_workers=2, pipeline_mode="process")
+        try:
+            ids = np.arange(120)
+            out = _ids_df(ids, 4, eng).map_batches(
+                lambda b: b, name="noop").collect()
+            np.testing.assert_array_equal(
+                out.column("id").to_numpy(zero_copy_only=False), ids)
+        finally:
+            eng.shutdown()
+        path = tmp_path / "merged.json"
+        tracer().export(str(path))
+        events = json.loads(path.read_text())
+        worker_pids = sorted({e["pid"] for e in events
+                              if e["pid"] >= remote.WORKER_PID_BASE})
+        assert worker_pids, "no worker process tracks in merged trace"
+        # ONE process group (one process_name meta) per worker pid
+        metas = [e for e in events if e["ph"] == "M"
+                 and e["name"] == "process_name"
+                 and e["pid"] >= remote.WORKER_PID_BASE]
+        assert sorted(m["pid"] for m in metas) == worker_pids
+        for m in metas:
+            assert m["args"]["name"].startswith("worker.")
+        wx = [e for e in events if e["ph"] == "X"
+              and e["pid"] >= remote.WORKER_PID_BASE]
+        px = [e for e in events if e["ph"] == "X"
+              and e["pid"] < remote.WORKER_PID_BASE]
+        assert {e["name"] for e in wx} >= {"worker.decode",
+                                           "worker.source_load"}
+        # no inversions: every worker span inside the parent stream's
+        # window (generous slack for the handshake's sampling delay)
+        pmin = min(e["ts"] for e in px)
+        pmax = max(e["ts"] + e["dur"] for e in px)
+        for e in wx:
+            assert pmin - 2e5 <= e["ts"] <= pmax + 2e5, \
+                (e["name"], e["ts"], pmin, pmax)
+            assert e["args"]["worker"] in (0, 1)
+
+    def test_non_singleton_tracer_does_not_merge(self):
+        """Only THE process tracer merges worker spans — a standalone
+        Tracer (tests, tools) exports its own spans only."""
+        from sparkdl_tpu.obs.trace import Tracer
+        agg = remote.aggregator()
+        agg.ingest(_frame(spans=[("worker.decode", "worker", 1, "t",
+                                  time.perf_counter() - 0.1,
+                                  time.perf_counter(), {})]))
+        solo = Tracer()
+        solo.arm()
+        with solo.span("mine", lane="engine"):
+            pass
+        events = solo.trace_events()
+        assert all(e["pid"] < remote.WORKER_PID_BASE for e in events)
+
+
+# ---------------------------------------------------------------------------
+# counter folding + warn_once dedup
+# ---------------------------------------------------------------------------
+
+class TestFolding:
+    def test_counters_fold_per_worker_and_rollup(self):
+        reg = default_registry()
+        agg = remote.aggregator()
+        k = "pipeline.worker_rows"
+        w0 = reg.counter(f"worker.0.{k}").value
+        w1 = reg.counter(f"worker.1.{k}").value
+        wall = reg.counter(f"worker.all.{k}").value
+        frames0 = reg.counter("worker.frames").value
+        agg.ingest(_frame(pid=111, counters={k: 5.0}))
+        agg.ingest(_frame(pid=222, counters={k: 7.0}))
+        agg.ingest(_frame(pid=111, counters={k: 2.0}))
+        assert reg.counter(f"worker.0.{k}").value == w0 + 7.0
+        assert reg.counter(f"worker.1.{k}").value == w1 + 7.0
+        assert reg.counter(f"worker.all.{k}").value == wall + 14.0
+        assert reg.counter("worker.frames").value == frames0 + 3
+        status = agg.workers_status()
+        assert [s["index"] for s in status] == [0, 1]
+        assert status[0]["counters"][k] == 7.0
+
+    def test_malformed_frame_counts_ingest_error(self):
+        reg = default_registry()
+        errs0 = reg.counter("worker.ingest_errors").value
+        bad = _frame()
+        bad["counters"] = {"k": "not-a-number"}
+        remote.aggregator().ingest(bad)
+        assert reg.counter("worker.ingest_errors").value == errs0 + 1
+
+    def test_warn_once_dedup_across_workers(self, caplog):
+        reg = default_registry()
+        agg = remote.aggregator()
+        d0 = reg.counter("worker.all.degrade_events").value
+        msg = ("pipeline: no usable process pool on this platform; "
+               "falling back to the thread pool")
+        with caplog.at_level("WARNING", logger="sparkdl_tpu.obs.remote"):
+            agg.ingest(_frame(pid=111,
+                              degrades=[("pipeline:noproc", msg)]))
+            agg.ingest(_frame(pid=222,
+                              degrades=[("pipeline:noproc", msg)]))
+        lines = [r for r in caplog.records if msg in r.getMessage()]
+        assert len(lines) == 1, "degrade reason logged more than once"
+        assert reg.counter("worker.0.degrade_events").value >= 1
+        assert reg.counter("worker.1.degrade_events").value >= 1
+        assert reg.counter("worker.all.degrade_events").value == d0 + 2
+
+
+# ---------------------------------------------------------------------------
+# worker stall + death health
+# ---------------------------------------------------------------------------
+
+class TestWorkerHealth:
+    def _stall_verdict(self):
+        return {"armed": True, "threshold_s": 0.2,
+                "active_sources": {"pipeline.worker_decode": 0.9},
+                "stalled_sources": ["pipeline.worker_decode"],
+                "stalls_fired": 1, "healthy": False}
+
+    def test_worker_stall_reaches_health_and_healthz(self):
+        reg = default_registry()
+        agg = remote.aggregator()
+        stalls0 = reg.counter("worker.stalls").value
+        agg.ingest(_frame(pid=111, verdict=self._stall_verdict()))
+        assert reg.counter("worker.stalls").value == stalls0 + 1
+        assert agg.health()["stalled"] == ["worker.0"]
+        tel = start_telemetry()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(tel.url("/healthz"), timeout=5)
+            assert exc_info.value.code == 503
+            body = json.loads(exc_info.value.read().decode())
+            assert body["worker_stalled"] == ["worker.0"]
+        finally:
+            tel.close()
+
+    def test_stall_recovery_clears_health(self):
+        agg = remote.aggregator()
+        agg.ingest(_frame(pid=111, verdict=self._stall_verdict()))
+        recovered = dict(self._stall_verdict(), stalled_sources=[],
+                         healthy=True)
+        agg.ingest(_frame(pid=111, verdict=recovered))
+        assert agg.health()["stalled"] == []
+
+    def _reaped_pid(self):
+        proc = multiprocessing.get_context("fork").Process(target=int)
+        proc.start()
+        proc.join()
+        return proc.pid
+
+    def test_dead_pid_marked_and_counted(self):
+        reg = default_registry()
+        agg = remote.aggregator()
+        deaths0 = reg.counter("pipeline.worker_deaths").value
+        agg.ingest(_frame(pid=self._reaped_pid()))
+        dead = agg.note_pool_broken("process pool broke (test)")
+        assert dead == [0]
+        assert reg.counter("pipeline.worker_deaths").value == deaths0 + 1
+        assert agg.health()["dead"] == ["worker.0"]
+        status = agg.workers_status()[0]
+        assert status["dead"] is True
+        assert "broke" in status["death_reason"]
+
+    def test_retired_worker_is_not_a_death(self):
+        reg = default_registry()
+        agg = remote.aggregator()
+        deaths0 = reg.counter("pipeline.worker_deaths").value
+        pid = self._reaped_pid()
+        agg.ingest(_frame(pid=pid))
+        agg.note_pool_retired([pid])
+        assert agg.note_pool_broken("pool broke later") == []
+        assert reg.counter("pipeline.worker_deaths").value == deaths0
+        assert agg.health()["dead"] == []
+        assert agg.workers_status()[0]["retired"] is True
+
+    def test_flight_bundle_carries_workers_section(self):
+        from sparkdl_tpu.obs import flight
+        remote.aggregator().ingest(
+            _frame(pid=111, counters={"pipeline.worker_rows": 3.0}))
+        bundle = flight.recorder().bundle(reason="test")
+        assert isinstance(bundle.get("workers"), list)
+        row = bundle["workers"][0]
+        assert row["pid"] == 111
+        assert row["counters"]["pipeline.worker_rows"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# the worker task end of the wire
+# ---------------------------------------------------------------------------
+
+class TestWorkerTask:
+    def _blobs(self, n=6, parts=1):
+        src = DataFrame.from_table(
+            pa.table({"id": list(range(n))}), parts)._sources[0]
+        return cloudpickle.dumps([]), cloudpickle.dumps(src)
+
+    def test_disarmed_tuples_are_base_shapes(self):
+        plan_blob, src_blob = self._blobs()
+        r = host_pipeline._pooled_partition_task(
+            "t1", plan_blob, src_blob, 0, 1 << 30, None)
+        assert r[0] == "buf"
+        assert len(r) == host_pipeline._RESULT_BASE_LEN["buf"]
+        base, frame = host_pipeline._split_frame(r)
+        assert base is r and frame is None
+
+    def test_armed_task_ships_frame(self):
+        plan_blob, src_blob = self._blobs()
+        r = host_pipeline._pooled_partition_task(
+            "t2", plan_blob, src_blob, 0, 1 << 30,
+            {"v": 1, "trace": True, "watchdog": False,
+             "threshold_s": 0.0, "faults": None})
+        assert len(r) == host_pipeline._RESULT_BASE_LEN["buf"] + 1
+        base, frame = host_pipeline._split_frame(r)
+        assert len(base) == host_pipeline._RESULT_BASE_LEN["buf"]
+        names = [s[0] for s in frame["spans"]]
+        assert "worker.decode" in names
+        assert frame["counters"]["pipeline.worker_rows"] == 6.0
+
+    def test_injected_worker_fault_attributed_in_frame(self):
+        """Rate-1.0 pipeline.worker_decode: the typed fault ships in
+        the err tuple AND its worker-side counters ride the frame."""
+        plan_blob, src_blob = self._blobs()
+        r = host_pipeline._pooled_partition_task(
+            "t3", plan_blob, src_blob, 0, 1 << 30,
+            {"v": 1, "trace": True, "watchdog": False,
+             "threshold_s": 0.0,
+             "faults": "pipeline.worker_decode:transient:1.0"})
+        base, frame = host_pipeline._split_frame(r)
+        assert base[0] == "err"
+        assert base[3] == "InjectedFault"
+        assert frame["faults"]["armed"] is True
+        site = frame["faults"]["sites"]["pipeline.worker_decode"]
+        assert site["injected"] == 1
+        assert frame["counters"][
+            "faults.pipeline.worker_decode.injected"] == 1.0
+        # the parent-side fold makes it a registry series
+        reg = default_registry()
+        before = reg.counter(
+            "worker.all.faults.pipeline.worker_decode.injected").value
+        host_pipeline._ingest_frame(frame)
+        assert reg.counter(
+            "worker.all.faults.pipeline.worker_decode.injected"
+        ).value == before + 1.0
+
+    def test_err_frames_ingest_before_raise(self):
+        agg = remote.aggregator()
+        err = ("err", None, "boom", "ValueError",
+               _frame(pid=333, counters={"pipeline.worker_rows": 1.0}))
+        with pytest.raises(host_pipeline.PipelineWorkerError):
+            host_pipeline._consume_result(err)
+        assert any(s["pid"] == 333 for s in agg.workers_status())
+
+
+# ---------------------------------------------------------------------------
+# report --workers
+# ---------------------------------------------------------------------------
+
+class TestReportWorkers:
+    def _events(self):
+        return [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "engine"}},
+            {"name": "pipeline.fragment", "cat": "engine", "ph": "X",
+             "ts": 0.0, "dur": 10_000.0, "pid": 1, "tid": 1,
+             "args": {}},
+            {"name": "process_name", "ph": "M", "pid": 1000, "tid": 0,
+             "args": {"name": "worker.0 (pid 4242)"}},
+            {"name": "worker.decode", "cat": "worker", "ph": "X",
+             "ts": 1_000.0, "dur": 4_000.0, "pid": 1000, "tid": 1,
+             "args": {"worker": 0, "partition": 0}},
+        ]
+
+    def test_workers_summary_rows(self):
+        w = report.workers_summary(self._events())
+        assert w is not None
+        assert len(w["workers"]) == 1
+        row = w["workers"][0]
+        assert row["index"] == 0
+        assert row["partitions"] == 1
+        assert row["busy_pct"] == pytest.approx(40.0, abs=1.0)
+
+    def test_workers_summary_bundle_join(self):
+        bundle = {"workers": [{
+            "index": 0, "pid": 4242, "dead": True,
+            "counters": {"pipeline.worker_rows": 64.0,
+                         "pipeline.degrade_events": 1.0},
+            "degrades": [{"reason": "r", "message": "m"}],
+            "faults": {"sites": {"pipeline.worker_decode":
+                                 {"injected": 2}}},
+        }]}
+        w = report.workers_summary(self._events(), bundle=bundle)
+        row = w["workers"][0]
+        assert row["rows"] == 64
+        assert row["faults_injected"] == 2
+        assert row["dead"] is True
+        text = report.summarize_workers(self._events(), bundle=bundle)
+        assert "worker.0" in text and "[DEAD]" in text
+
+    def test_no_worker_tracks_is_forward_compatible(self):
+        events = [e for e in self._events() if e["pid"] < 1000]
+        assert report.workers_summary(events) is None
+        assert "no worker process tracks" in \
+            report.summarize_workers(events)
+        # and the plain summary still renders merged traces
+        assert "worker.0" in report.summarize(self._events())

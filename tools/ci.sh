@@ -18,8 +18,9 @@
 #      parse. The FULL result is read from the bench result FILE
 #      (SPARKDL_TPU_BENCH_RESULT — bench.py's post-r05 contract); the
 #      stdout tail is separately gated to be the compact headline
-#      line (<1,500 chars, parseable, carrying result_path) the
-#      driver's 2,000-char tail window needs. Runs under
+#      line (<=1,200 chars, parsing standalone as JSON, carrying
+#      result_path, its note a <=80-char pointer rather than prose)
+#      so the driver's 2,000-char tail window always parses it. Runs under
 #      SPARKDL_TPU_SANITIZE=1 so jax.transfer_guard enforces the
 #      aligned ship path's zero-copy claim at runtime, not just in
 #      the counters.
@@ -148,6 +149,21 @@
 #      must be well-formed with all nineteen rules; the package +
 #      tools/ + examples/ must be clean under all nineteen; and the
 #      warm cached run must hit every file with total_s < 60
+#  20. cross-process telemetry gate (docs/OBSERVABILITY.md
+#      "Cross-process telemetry"): an ARMED (SPARKDL_TPU_TRACE=1)
+#      process-pool stream must export ONE merged Perfetto trace with
+#      each worker on its own process track (pid >= 1000), worker
+#      decode spans time-aligned inside the parent stream's window; a
+#      live /metricsz scrape must carry sparkdl_worker_* series with
+#      # HELP; an injected pipeline.worker_decode transient fault
+#      (shipped to workers through the telemetry config) must be
+#      retried by the parent with ZERO lost rows and its worker-side
+#      counters mirrored as worker.all.faults.* in the parent
+#      registry; a pipeline.worker_death drill (worker process
+#      os._exit mid-task) must surface pipeline.worker_deaths, a
+#      typed PipelineWorkerError, and a flight bundle whose workers[]
+#      names the dead worker; and `report --workers` must read the
+#      merged trace (with the bundle join)
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -163,7 +179,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/19] native shim build =="
+echo "== [1/20] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -172,13 +188,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/19] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/20] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/19] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/20] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/19] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/20] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -187,7 +203,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/19] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/20] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -196,16 +212,24 @@ import json
 
 # the driver-tail contract (the r05 lesson): the LAST stdout line must
 # be a compact headline that fits the driver's 2,000-char tail window
-# and points at the full result file
+# and points at the full result file — the margin is deliberate (the
+# tail window also swallows any stderr the run interleaves)
 with open("/tmp/sparkdl_bench_smoke_stdout.txt") as f:
     tail = f.read().strip().splitlines()[-1]
-assert len(tail) < 1500, \
-    f"bench headline line is {len(tail)} chars (driver tail is 2,000)"
-head = json.loads(tail)
+assert len(tail) <= 1200, \
+    f"bench headline line is {len(tail)} chars (gate: 1,200; the " \
+    "driver tail is 2,000 — keep prose in the result FILE, not here)"
+head = json.loads(tail)   # MUST parse standalone — no prose, no wrap
 for k in ("metric", "value", "unit", "vs_baseline", "result_path",
           "schema_version"):
     assert k in head, f"bench headline missing {k!r}: {sorted(head)}"
 assert head["result_path"] == "/tmp/sparkdl_bench_smoke.json", head
+# the note is a POINTER, not documentation: long notes are exactly how
+# the r05 headline outgrew the window in the first place
+note = head.get("note", "")
+assert len(note) <= 80, \
+    f"bench headline note is {len(note)} chars (keep it a pointer; " \
+    "full prose belongs in the result file)"
 
 # the FULL result comes from the file (SPARKDL_TPU_BENCH_RESULT)
 with open("/tmp/sparkdl_bench_smoke.json") as f:
@@ -267,7 +291,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/19] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/20] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -306,11 +330,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/19] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/20] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/19] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/20] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -405,7 +429,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/19] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/20] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -515,7 +539,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/19] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/20] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -654,11 +678,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/19] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/20] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/19] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/20] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -723,7 +747,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/19] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/20] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -821,7 +845,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/19] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/20] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -913,7 +937,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/19] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/20] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1040,7 +1064,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/19] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/20] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1160,7 +1184,7 @@ python -m sparkdl_tpu.obs report --bound \
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
-echo "== [16/19] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+echo "== [16/20] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
 # (a) the bench smoke's "compile" block (step 4's result file): the
 # compile log was armed for the whole run, saw every jit compile, and
 # the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
@@ -1296,7 +1320,7 @@ grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
 grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
 grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
-echo "== [17/19] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
+echo "== [17/20] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's pipeline_overlap block: serial-vs-pooled ips
 # on one corpus + the overlap proof. On a multi-core host the pool
 # must have engaged and not lose >5% to serial; on a 1-core host the
@@ -1500,7 +1524,7 @@ print(json.dumps({"pipeline_gate": "ok", "cores": cores,
                   "bundle": path}))
 EOF
 
-echo "== [18/19] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
+echo "== [18/20] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's ship_ring block: the repeated-corpus steady
 # pass must ship ZERO bytes (every chunk a content hit off a resident
 # slab — STRICTLY below the no-ring baseline's per-pass corpus
@@ -1676,7 +1700,7 @@ print(json.dumps({"ring_serve_gate": "ok", "cores": cores,
                   "interleave_gated": cores >= 2}))
 EOF
 
-echo "== [19/19] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
+echo "== [19/20] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1838,6 +1862,148 @@ print(json.dumps({"race_gate": "ok",
                   "h18_s": t["per_rule_s"]["H18"],
                   "h19_s": t["per_rule_s"]["H19"],
                   "topology_s": t["per_rule_s"]["threads-topology"]}))
+EOF
+
+echo "== [20/20] cross-process telemetry gate (merged worker trace + scrape + fault/death drills + report --workers, docs/OBSERVABILITY.md) =="
+SPARKDL_TPU_PIPELINE_MPCTX=fork SPARKDL_TPU_TRACE=1 \
+  SPARKDL_TPU_FLIGHT=1 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
+import json
+import os
+import re
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.data import DataFrame, LocalEngine
+from sparkdl_tpu.data.pipeline import PipelineWorkerError
+from sparkdl_tpu.obs import default_registry, start_telemetry
+from sparkdl_tpu.obs import remote
+from sparkdl_tpu.obs.trace import tracer
+from sparkdl_tpu.resilience import faults
+
+reg = default_registry()
+agg = remote.aggregator()
+
+
+def ids_df(ids, parts, engine):
+    return DataFrame(
+        DataFrame.from_table(pa.table({"id": ids}), parts)._sources,
+        engine=engine)
+
+
+# -- (a) armed pooled stream -> ONE merged, clock-aligned trace ------
+eng = LocalEngine(pipeline_workers=2, pipeline_mode="process")
+ids = np.arange(160)
+out = ids_df(ids, 4, eng).map_batches(lambda b: b).collect()
+np.testing.assert_array_equal(
+    out.column("id").to_numpy(zero_copy_only=False), ids)
+assert agg.health()["workers"] >= 1, agg.health()
+trace_path = "/tmp/sparkdl_ci_worker_trace.json"
+tracer().export(trace_path)
+with open(trace_path) as f:
+    events = json.load(f)
+worker_pids = sorted({e["pid"] for e in events
+                      if e["pid"] >= remote.WORKER_PID_BASE})
+assert worker_pids, "merged trace has no worker process tracks"
+procs = {e["pid"]: e["args"]["name"] for e in events
+         if e["ph"] == "M" and e["name"] == "process_name"}
+for pid in worker_pids:
+    assert procs.get(pid, "").startswith("worker."), (pid, procs)
+wx = [e for e in events if e["ph"] == "X"
+      and e["pid"] >= remote.WORKER_PID_BASE]
+px = [e for e in events if e["ph"] == "X"
+      and e["pid"] < remote.WORKER_PID_BASE]
+names = {e["name"] for e in wx}
+assert "worker.decode" in names, sorted(names)
+# time alignment: every worker span inside the parent stream's
+# window (generous slack for the handshake's clock sampling skew)
+pmin = min(e["ts"] for e in px)
+pmax = max(e["ts"] + e["dur"] for e in px)
+slack = 0.5e6
+for e in wx:
+    assert pmin - slack <= e["ts"] <= pmax + slack, \
+        (e["name"], e["ts"], pmin, pmax)
+
+# -- (b) sparkdl_worker_* on a live scrape, with # HELP --------------
+tel = start_telemetry()
+with urllib.request.urlopen(tel.url("/metricsz"), timeout=5) as r:
+    body = r.read().decode()
+assert re.search(r"^sparkdl_worker_", body, re.M), \
+    "no sparkdl_worker_* series on /metricsz"
+assert re.search(r"^# HELP sparkdl_worker_", body, re.M), \
+    "sparkdl_worker_* series scraped without # HELP"
+tel.close()
+
+# -- (c) injected worker-side transient fault: retried, counted, ----
+# zero lost rows (the spec ships via the telemetry config)
+faults.inject("pipeline.worker_decode", "transient", 0.3, seed=7)
+injected0 = reg.counter(
+    "worker.all.faults.pipeline.worker_decode.injected").value
+retries0 = reg.counter("engine.retries").value
+ids2 = np.arange(240)
+out2 = ids_df(ids2, 6, eng).map_batches(lambda b: b).collect()
+faults.disarm()
+np.testing.assert_array_equal(
+    out2.column("id").to_numpy(zero_copy_only=False), ids2)
+injected = reg.counter(
+    "worker.all.faults.pipeline.worker_decode.injected").value
+assert injected > injected0, \
+    "worker-side fault counters never reached the parent registry"
+assert reg.counter("engine.retries").value > retries0, \
+    "injected worker fault produced no parent-side retry"
+eng.shutdown()
+
+# -- (d) worker-death drill: a REAL corpse, named in the bundle ------
+eng2 = LocalEngine(pipeline_workers=2, pipeline_mode="process")
+# one clean stream first: the aggregator learns the fresh pool's pids
+# (a worker that dies on its FIRST task never ships a frame — death
+# attribution probes the pids the plane has seen)
+ids_df(np.arange(40), 4, eng2).map_batches(lambda b: b).collect()
+faults.inject("pipeline.worker_death", "transient", 1.0, seed=1)
+deaths0 = reg.counter("pipeline.worker_deaths").value
+err = None
+try:
+    ids_df(np.arange(40), 2, eng2).map_batches(lambda b: b).collect()
+except PipelineWorkerError as exc:
+    err = exc
+finally:
+    faults.disarm()
+    eng2.shutdown()
+assert err is not None, "worker death surfaced no PipelineWorkerError"
+assert reg.counter("pipeline.worker_deaths").value > deaths0, \
+    "worker death not counted as pipeline.worker_deaths"
+dead = agg.health()["dead"]
+assert dead, "aggregator marked no worker dead after the drill"
+bundles = sorted((p for p in os.listdir("/tmp")
+                  if p.startswith("sparkdl_flight_")),
+                 key=lambda p: os.path.getmtime(os.path.join("/tmp", p)))
+assert bundles, "worker death dumped no flight bundle"
+with open(os.path.join("/tmp", bundles[-1])) as f:
+    bundle = json.load(f)
+assert "workers" in bundle, sorted(bundle)
+dead_rows = [w for w in bundle["workers"] if w.get("dead")]
+assert dead_rows, \
+    "flight bundle workers[] names no dead worker"
+
+# -- (e) report --workers reads the merged trace + bundle join -------
+import subprocess
+import sys
+bundle_path = os.path.join("/tmp", bundles[-1])
+r = subprocess.run(
+    [sys.executable, "-m", "sparkdl_tpu.obs", "report", "--workers",
+     "--bundle", bundle_path, trace_path],
+    capture_output=True, text=True)
+assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+assert "worker.0" in r.stdout, r.stdout[-2000:]
+print(json.dumps({
+    "telemetry_gate": "ok",
+    "worker_tracks": len(worker_pids),
+    "worker_spans": len(wx),
+    "faults_mirrored": injected - injected0,
+    "dead_workers": dead,
+    "bundle": bundle_path,
+}))
 EOF
 
 echo "== ci.sh: ALL GREEN =="
