@@ -345,10 +345,27 @@ class PipelineTarget(_TrialMixin):
             set=lambda v: setattr(engine, "pipeline_read_ahead",
                                   int(v)),
             lo=1, hi=int(max_read_ahead))
+        # the disaggregated decode fleet's fan-out width
+        # (sparkdl_tpu/inputsvc; docs/DATA_SERVICE.md): only an engine
+        # CONFIGURED with endpoints grows this knob — the ceiling is
+        # the provisioned fleet size, and the apply is the same plain
+        # int attribute store the engine re-reads per execute()
+        fleet = len(getattr(engine, "inputsvc_endpoints", None) or ())
+        self._remote: Optional[Knob] = None
+        if fleet >= 1:
+            self._remote = Knob(
+                "inputsvc_workers",
+                get=lambda: int(engine.inputsvc_workers),
+                set=lambda v: setattr(engine, "inputsvc_workers",
+                                      int(v)),
+                lo=1, hi=fleet)
         self._prev: Optional[tuple] = None
 
     def knobs(self) -> List[Knob]:
-        return [self._workers, self._read_ahead]
+        out = [self._workers, self._read_ahead]
+        if self._remote is not None:
+            out.append(self._remote)
+        return out
 
     def _window(self) -> Optional[float]:
         """Merged rows per pooled-stream-ACTIVE second over the window
@@ -359,8 +376,14 @@ class PipelineTarget(_TrialMixin):
         spuriously revert-freeze a good step). None when no pooled
         stream finished in the window."""
         reg = default_registry()
-        rows = reg.counter("pipeline.rows").value
-        active = reg.counter("pipeline.stream_seconds").value
+        # remote decode streams (sparkdl_tpu/inputsvc) feed the same
+        # merged-rows-per-active-second signal through their own
+        # counters — a purely remote stream must still evaluate an
+        # inputsvc_workers trial
+        rows = (reg.counter("pipeline.rows").value
+                + reg.counter("inputsvc.rows").value)
+        active = (reg.counter("pipeline.stream_seconds").value
+                  + reg.counter("inputsvc.stream_seconds").value)
         prev, self._prev = self._prev, (rows, active)
         if prev is None:
             return None
@@ -394,7 +417,16 @@ class PipelineTarget(_TrialMixin):
             # a freeze epoch learning that
             return out
         reason = "ledger prior: decode lane binds; deepen host pipeline"
-        if self._workers.usable() \
+        if (self._remote is not None and self._remote.usable()
+                and self._remote.value < self._remote.hi):
+            # widen the PROVISIONED remote fleet before growing local
+            # pool processes: remote lanes are capacity that already
+            # exists (the trial still validates the step pays)
+            self._start_trial(
+                self._remote, self._remote.value + 1, tput,
+                "ledger prior: decode lane binds; widen the remote "
+                "decode fleet", out)
+        elif self._workers.usable() \
                 and self._workers.value < self._workers.hi:
             self._start_trial(self._workers, self._workers.value + 1,
                               tput, reason, out)

@@ -136,7 +136,8 @@ class LocalEngine:
                  retryable_exceptions: Optional[Tuple[type, ...]] = None,
                  pipeline_workers: Optional[int] = None,
                  pipeline_read_ahead: Optional[int] = None,
-                 pipeline_mode: Optional[str] = None):
+                 pipeline_mode: Optional[str] = None,
+                 inputsvc_endpoints=None):
         self.num_workers = num_workers or min(32, (os.cpu_count() or 4))
         # the parallel host pipeline (data/pipeline.py): >= 2 resolved
         # workers select the pooled streaming mode per execute() —
@@ -156,6 +157,16 @@ class LocalEngine:
         self.pipeline_read_ahead = resolve_read_ahead(
             pipeline_read_ahead, self.pipeline_workers)
         self.pipeline_mode = resolve_mode(pipeline_mode)
+        # the disaggregated decode fleet (sparkdl_tpu/inputsvc;
+        # docs/DATA_SERVICE.md): configured endpoints route the host
+        # prefix to remote DecodeServers per execute(), with loud
+        # local fallback when the fleet is unreachable.
+        # ``inputsvc_workers`` is the LIVE fan-out width — a plain int
+        # attribute re-read per execute, so the autotune controller's
+        # PipelineTarget can move it like the pipeline knobs
+        from sparkdl_tpu.inputsvc.client import resolve_endpoints
+        self.inputsvc_endpoints = resolve_endpoints(inputsvc_endpoints)
+        self.inputsvc_workers = len(self.inputsvc_endpoints)
         self._pipeline = None           # lazily-built HostPipeline
         self._pipeline_lock = threading.Lock()
         # Enough in-flight partitions to keep workers busy while the
@@ -320,6 +331,15 @@ class LocalEngine:
         if not sources:
             return iter(())
         plan = list(plan)
+        if self.inputsvc_endpoints and int(self.inputsvc_workers
+                                           or 0) >= 1:
+            # the disaggregated decode fleet (sparkdl_tpu/inputsvc):
+            # the host prefix runs on remote DecodeServers; returns
+            # None when no endpoint answers (counted + warned) and
+            # the local paths below take over unchanged
+            remoted = self._execute_remote(sources, plan)
+            if remoted is not None:
+                return remoted
         if int(self.pipeline_workers or 0) >= 2:
             # the parallel host pipeline (data/pipeline.py): the
             # source-load + host-stage prefix runs on N pool workers
@@ -372,6 +392,37 @@ class LocalEngine:
             if self._pipeline is None:
                 self._pipeline = HostPipeline(mode=self.pipeline_mode)
             return self._pipeline
+
+    def _execute_remote(self, sources: Sequence, plan: Sequence
+                        ) -> Optional[Iterator[pa.RecordBatch]]:
+        """The decode-fleet streaming mode (sparkdl_tpu/inputsvc): the
+        plan's host prefix runs on remote DecodeServers with an
+        ordered re-merge; the fragment stream then flows through the
+        same consumer-thread stage machinery as the pooled/serial
+        paths. ``inputsvc_workers`` bounds the fan-out width (the
+        autotune knob); None — nothing picklable, or no endpoint
+        reachable — falls through to the local paths, loudly
+        (``inputsvc.fallbacks``)."""
+        from sparkdl_tpu.inputsvc.client import RemotePipeline
+        width = max(1, int(self.inputsvc_workers))
+        dsplit = next((i for i, st in enumerate(plan)
+                       if st.kind == "device"), len(plan))
+        stream = RemotePipeline(
+            self.inputsvc_endpoints[:width]).stream(
+                sources, plan[:dsplit], self)
+        if stream is None:
+            return None
+        hints = [int(st.batch_hint) for st in plan[dsplit:]
+                 if self._rechunkable(st)]
+        for stage in plan[dsplit:]:
+            if self._rechunkable(stage):
+                stream = self._stream_rechunk(stream, stage,
+                                              max_hint=max(hints))
+            elif stage.kind == "device":
+                stream = self._stream_plain(stream, stage)
+            else:
+                stream = self._stream_pooled(stream, stage)
+        return (b for _, b in stream)
 
     def _execute_pipelined(self, sources: Sequence, plan: Sequence
                            ) -> Optional[Iterator[pa.RecordBatch]]:

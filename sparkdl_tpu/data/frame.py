@@ -1152,6 +1152,35 @@ class DataFrame:
         out._schema = self.schema
         return out
 
+    def snapshot(self, root: str, fingerprint: str = "",
+                 decode_key: Optional[str] = None) -> "DataFrame":
+        """A frame backed by the CONTENT-ADDRESSED snapshot store
+        (``sparkdl_tpu/inputsvc/snapshot.py``; docs/DATA_SERVICE.md) —
+        the multi-run, multi-tenant evolution of :meth:`cache_to_disk`.
+        The store key hashes ``fingerprint`` (corpus identity — e.g. a
+        hash of source paths) with ``decode_key`` (the decode
+        configuration; defaults to the plan's stage-name signature)
+        and the snapshot format version: a corpus change, a config
+        change, or a format bump each lands in a fresh key directory
+        and decodes cold, so a warm hit can NEVER be stale. Chunks are
+        self-validating (per-chunk blake2b digests): corruption or
+        truncation re-decodes that partition cleanly instead of
+        crashing or serving bad rows. The second epoch — or the second
+        tenant sharing ``root`` — streams with decode busy-seconds
+        ≈ 0 (the ``inputsvc.snapshot_*`` counters tell the story)."""
+        from sparkdl_tpu.inputsvc.snapshot import snapshot_sources
+        out = DataFrame(
+            snapshot_sources(self._sources, list(self._plan),
+                             self.schema, root, fingerprint,
+                             decode_key),
+            engine=self._engine)
+        # schema from the UNDERLYING frame (the cache_to_disk
+        # reasoning): the snapshot frame's plan is empty and its load
+        # IS the decode, so the default probe would decode+write a
+        # whole partition just to answer .columns
+        out._schema = self.schema
+        return out
+
     def filter_rows(self, mask: np.ndarray) -> "DataFrame":
         """Keep rows where the GLOBAL boolean mask is true (mask indexed in
         collected row order). Used by CrossValidator k-fold splits."""

@@ -1,0 +1,53 @@
+"""Disaggregated input service: a socket-transport decode fleet + a
+content-addressed corpus snapshot cache (docs/DATA_SERVICE.md).
+
+The tf.data *service mode* pair (PAPERS.md, arxiv 2101.12127) on top
+of the parallel host pipeline:
+
+* :class:`~sparkdl_tpu.inputsvc.server.DecodeServer` — one remote
+  decode worker, running the SAME partition task the process pool
+  runs, over the length-prefixed socket transport
+  (``python -m sparkdl_tpu.inputsvc serve --port N``);
+* :class:`~sparkdl_tpu.inputsvc.client.RemotePipeline` — the
+  accelerator-host client: fan-out, ordered re-merge with exact row
+  identity, typed-transient retry, loud local-decode failover
+  (engaged by :class:`~sparkdl_tpu.data.engine.LocalEngine` via
+  ``inputsvc_endpoints`` / ``SPARKDL_TPU_INPUTSVC_WORKERS``);
+* :func:`~sparkdl_tpu.inputsvc.snapshot.snapshot_sources` — the
+  epoch-amortized packed-tensor store behind
+  :meth:`DataFrame.snapshot <sparkdl_tpu.data.frame.DataFrame.snapshot>`.
+"""
+
+from sparkdl_tpu.inputsvc.client import (
+    ENV_ENDPOINTS,
+    RemotePipeline,
+    resolve_endpoints,
+    state,
+)
+from sparkdl_tpu.inputsvc.server import DecodeServer
+from sparkdl_tpu.inputsvc.snapshot import (
+    SNAPSHOT_VERSION,
+    snapshot_key,
+    snapshot_sources,
+)
+from sparkdl_tpu.inputsvc.transport import (
+    WIRE_VERSION,
+    TransportError,
+    recv_msg,
+    send_msg,
+)
+
+__all__ = [
+    "ENV_ENDPOINTS",
+    "SNAPSHOT_VERSION",
+    "WIRE_VERSION",
+    "DecodeServer",
+    "RemotePipeline",
+    "TransportError",
+    "recv_msg",
+    "resolve_endpoints",
+    "send_msg",
+    "snapshot_key",
+    "snapshot_sources",
+    "state",
+]

@@ -1,0 +1,56 @@
+"""CLI entrypoint: ``python -m sparkdl_tpu.inputsvc serve --port N``.
+
+Runs one :class:`~sparkdl_tpu.inputsvc.server.DecodeServer` in the
+foreground and prints ONE machine-parseable READY line naming the
+bound host:port — the handle the two-process CI drill (tools/ci.sh)
+and any process supervisor waits on. ``--port 0`` (the default) binds
+an ephemeral port, so fleets can launch without port bookkeeping.
+
+Fault drills and telemetry arm exactly as everywhere else:
+``SPARKDL_TPU_FAULTS`` parses at import, and a client's decode
+requests carry its telemetry config, so frames flow home over the
+same socket without any flag here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkdl_tpu.inputsvc",
+        description="sparkdl_tpu disaggregated input service "
+                    "(docs/DATA_SERVICE.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    serve = sub.add_parser(
+        "serve", help="run one decode worker in the foreground")
+    serve.add_argument("--host", default="127.0.0.1",
+                       help="bind address (default 127.0.0.1)")
+    serve.add_argument("--port", type=int, default=0,
+                       help="bind port (default 0 = ephemeral; the "
+                            "READY line names the bound port)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from sparkdl_tpu.inputsvc.server import DecodeServer
+    server = DecodeServer(host=args.host, port=args.port)
+    # ONE parseable line, flushed before serving: the launcher's
+    # readiness handle (and with --port 0, its only way to learn
+    # the bound port)
+    print(f"SPARKDL_TPU_INPUTSVC READY {server.host}:{server.port}",
+          flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

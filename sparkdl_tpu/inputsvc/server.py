@@ -1,0 +1,225 @@
+"""The decode-fleet worker: a socket server running partition decode.
+
+One :class:`DecodeServer` is one remote decode worker (the tf.data
+*service mode* shape, PAPERS.md arxiv 2101.12127). It accepts framed
+requests (``inputsvc/transport.py``) and runs each partition through
+**the same task the process pool runs**
+(:func:`~sparkdl_tpu.data.pipeline._pooled_partition_task`): source
+load + the host-stage prefix, fault sites, worker-lane spans, watchdog
+pulses, busy-second accounting — with shared memory disabled (a socket
+peer cannot attach a POSIX segment), so every fragment comes back as
+the ``("buf", payload, busy, timings, rows)`` tuple the client already
+knows how to consume.
+
+Telemetry crosses the same wire: the client forwards its parent-side
+:func:`~sparkdl_tpu.obs.remote.telemetry_config` in each decode
+request, the server-process :class:`~sparkdl_tpu.obs.remote.TelemetryAgent`
+arms once and appends one frame to each result tuple, and the client
+ingests it into the parent aggregator exactly as the pool transport
+does — a remote worker shows up in ``/statusz``'s ``workers`` list,
+the clock-aligned trace merge, and flight bundles like any pooled
+worker.
+
+Ops:
+
+* ``ping`` — handshake/liveness: replies ``{ok, pid, version}``. The
+  client pings each endpoint at stream start and drops unreachable
+  ones loudly.
+* ``decode`` — header carries ``token`` (plan-cache key), ``index``,
+  ``plan_len``, and the optional ``tel`` config; the payload is the
+  cloudpickled plan blob followed by the cloudpickled source blob.
+  The reply payload is the cloudpickled result tuple.
+
+A handler failure that can still be reported replies
+``{ok: False, error}``; one that cannot (broken socket) drops the
+connection — either way the CLIENT owns recovery (retry through the
+shared RetryPolicy, then local-decode failover), so a dying worker can
+never lose or duplicate a row. Accounting:
+``inputsvc.server_requests`` / ``inputsvc.server_errors``.
+
+``python -m sparkdl_tpu.inputsvc serve --port N`` runs one server in
+the foreground (``__main__.py``) and prints a READY line naming the
+bound port — the two-process CI drill's handle (tools/ci.sh).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from sparkdl_tpu.inputsvc import transport
+from sparkdl_tpu.obs import default_registry
+
+logger = logging.getLogger(__name__)
+
+#: shared-memory floor passed to the pooled task: effectively infinite,
+#: so every fragment rides the result tuple ("buf") — a socket peer
+#: cannot attach this process's POSIX segments
+_NO_SHM = 1 << 62
+
+
+def _count(what: str, amount: float = 1.0) -> None:
+    default_registry().counter(f"inputsvc.{what}").add(amount)
+
+
+class DecodeServer:
+    """One decode-fleet worker process (module docstring). Thread-per-
+    connection: decode is process-heavy, connection counts are tiny
+    (one client connection per stream per client), and the pooled task
+    it runs is already thread-safe."""
+
+    # sparkdl-lint H3 contract: the accept loop and close() race on the
+    # listener and connection bookkeeping — both hold self._lock
+    _lock_guards = ("_conns", "_closed")
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._closed = False
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # a server never ships (sockets don't pickle — H3): refuse loudly
+    # rather than arriving somewhere as a dead listener
+    def __getstate__(self):
+        raise TypeError("DecodeServer holds live sockets and cannot "
+                        "be pickled; ship its host:port endpoint "
+                        "instead")
+
+    def start(self) -> "DecodeServer":
+        """Serve in a background thread (tests, in-process fleets);
+        returns self so ``DecodeServer(port=0).start()`` composes."""
+        t = threading.Thread(target=self.serve_forever,
+                             name=f"inputsvc-accept:{self.port}",
+                             daemon=True)
+        self._accept_thread = t
+        t.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Accept connections until :meth:`close` (the CLI's foreground
+        loop)."""
+        while True:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                # listener closed (close()) — the clean exit path
+                return
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn, addr),
+                name=f"inputsvc-conn:{addr[1]}", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        try:
+            while True:
+                try:
+                    header, payload = transport.recv_msg(conn)
+                except transport.TransportError as e:
+                    # normal client hang-up lands here too — log at
+                    # debug; a mid-frame corruption is the client's
+                    # problem to retry (its send will see the close)
+                    logger.debug("inputsvc server: connection %s "
+                                 "ended: %s", addr, e)
+                    return
+                self._dispatch(conn, header, payload)
+        finally:
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            conn.close()
+
+    def _dispatch(self, conn: socket.socket, header: dict,
+                  payload: bytes) -> None:
+        _count("server_requests")
+        op = header.get("op")
+        try:
+            if op == "ping":
+                import os
+                transport.send_msg(conn, {
+                    "ok": True, "pid": os.getpid(),
+                    "version": transport.WIRE_VERSION})
+                return
+            if op == "decode":
+                self._handle_decode(conn, header, payload)
+                return
+            _count("server_errors")
+            transport.send_msg(conn, {
+                "ok": False,
+                "error": f"unknown op {op!r}"})
+        except transport.TransportError:
+            # the reply could not be sent — nothing left to tell this
+            # client; it will classify the dead socket as transient
+            # and retry/fail over on its side
+            _count("server_errors")
+            logger.warning("inputsvc server: reply to %r failed; "
+                           "dropping connection", op)
+            raise
+
+    def _handle_decode(self, conn: socket.socket, header: dict,
+                       payload: bytes) -> None:
+        import cloudpickle
+
+        from sparkdl_tpu.data.pipeline import _pooled_partition_task
+        token = str(header.get("token", ""))
+        index = int(header.get("index", 0))
+        plan_len = int(header.get("plan_len", 0))
+        tel = header.get("tel") or None
+        if not 0 <= plan_len <= len(payload):
+            _count("server_errors")
+            transport.send_msg(conn, {
+                "ok": False,
+                "error": f"plan_len {plan_len} out of range for a "
+                         f"{len(payload)}-byte payload"})
+            return
+        plan_blob = payload[:plan_len]
+        src_blob = payload[plan_len:]
+        # the pooled task NEVER raises — failures come back as a typed
+        # ("err", ...) tuple the client re-raises, so the transport
+        # only ever carries a well-formed reply
+        result = _pooled_partition_task(token, plan_blob, src_blob,
+                                        index, _NO_SHM, tel)
+        transport.send_msg(conn, {"ok": True},
+                           cloudpickle.dumps(result))
+
+    def close(self) -> None:
+        """Stop accepting and drop live connections (in-flight replies
+        abort — the client's transient-retry/failover path owns
+        recovery)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns)
+        try:
+            # close() alone does not wake a thread parked in accept()
+            # on Linux — shutdown() does, so the accept thread exits
+            # instead of leaking one parked thread per server
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError as e:
+            logger.debug("inputsvc server: listener shutdown "
+                         "failed: %s", e)
+        self._sock.close()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError as e:
+                logger.debug("inputsvc server: closing a connection "
+                             "failed: %s", e)
+        t = self._accept_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"host": self.host, "port": self.port,
+                    "connections": len(self._conns),
+                    "closed": self._closed}

@@ -1,0 +1,271 @@
+"""The corpus snapshot cache: a content-addressed store of decoded
+packed tensors, amortizing decode to ~zero across epochs and tenants.
+
+The tf.data paper's second disaggregation lever (PAPERS.md, arxiv
+2101.12127): once a corpus has been decoded under a given decode
+configuration, NOBODY should pay that decode again — not the second
+epoch, not the second job, not the second tenant sharing the store.
+:meth:`~sparkdl_tpu.data.frame.DataFrame.snapshot` extends
+``cache_to_disk`` with the three properties a SHARED multi-run store
+needs that a private spill dir does not:
+
+* **content addressing** — the store key is
+  ``blake2b(SNAPSHOT_VERSION | corpus fingerprint | decode-config
+  key)``: a corpus content change, a decode-config change, or a
+  snapshot-format version bump each lands in a DIFFERENT key
+  directory and decodes cold. Stale data is unreachable by
+  construction, not by bookkeeping.
+* **self-validating chunks** — each partition's Arrow IPC payload is
+  wrapped in a framed chunk file carrying its own blake2b digest. A
+  truncated or corrupted chunk fails CLOSED on read: the bad chunk is
+  deleted and that partition re-decodes cleanly
+  (``inputsvc.snapshot_corruptions``) — never a silent stale read,
+  never a crash.
+* **versioned manifest** — ``MANIFEST.json`` pins version /
+  fingerprint / decode key / schema / partition count. A manifest
+  that is unreadable or disagrees with the expected identity (a
+  tampered or half-written store) is wiped and rebuilt
+  (``inputsvc.snapshot_invalidations``).
+
+Warm reads run through the ``snapshot.read`` fault site
+(``SPARKDL_TPU_FAULTS``), so the corrupt/missing-chunk recovery path
+is drillable on demand; the second-epoch payoff — ``pipeline.decode``
+busy-seconds ≈ 0 at ≥ serial-decode throughput — is gated in
+tools/ci.sh (docs/DATA_SERVICE.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.resilience.errors import TransientError
+from sparkdl_tpu.resilience.faults import maybe_fail
+
+logger = logging.getLogger(__name__)
+
+#: snapshot FORMAT version: part of the store key (a bump makes every
+#: old snapshot unreachable-cold, never misread) AND pinned in the
+#: manifest + each chunk header (so a hand-edited store fails closed)
+SNAPSHOT_VERSION = 1
+
+#: chunk-file magic
+CHUNK_MAGIC = b"SNP1"
+
+#: chunk header: magic | u16 version | u64 payload_len | blake2b-32
+_CHUNK_HEADER = struct.Struct(">4sHQ32s")
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def _count(what: str, amount: float = 1.0) -> None:
+    default_registry().counter(f"inputsvc.{what}").add(amount)
+
+
+class SnapshotCorruption(TransientError):
+    """A chunk file failed validation (bad magic/version/digest,
+    truncation). TRANSIENT by design: the reader deletes the chunk and
+    re-decodes the partition — recovery is always possible because the
+    snapshot is a cache, never the only copy."""
+
+
+def snapshot_key(fingerprint: str, decode_key: str) -> str:
+    """The content address: corpus identity x decode configuration x
+    format version → one hex store key."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(f"v{SNAPSHOT_VERSION}|{fingerprint}|{decode_key}"
+             .encode("utf-8"))
+    return h.hexdigest()
+
+
+def _encode_chunk(payload: bytes) -> bytes:
+    digest = hashlib.blake2b(payload, digest_size=32).digest()
+    return _CHUNK_HEADER.pack(CHUNK_MAGIC, SNAPSHOT_VERSION,
+                              len(payload), digest) + payload
+
+
+def _read_chunk(path: str) -> bytes:
+    """Read + validate one chunk file → the Arrow IPC payload bytes.
+    Raises :class:`SnapshotCorruption` on ANY validation failure."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < _CHUNK_HEADER.size:
+        raise SnapshotCorruption(
+            f"snapshot chunk {path!r} is truncated below its header")
+    magic, version, payload_len, digest = _CHUNK_HEADER.unpack(
+        raw[:_CHUNK_HEADER.size])
+    if magic != CHUNK_MAGIC:
+        raise SnapshotCorruption(
+            f"snapshot chunk {path!r} has bad magic {magic!r}")
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotCorruption(
+            f"snapshot chunk {path!r} is format v{version}; this "
+            f"process reads v{SNAPSHOT_VERSION}")
+    payload = raw[_CHUNK_HEADER.size:]
+    if len(payload) != payload_len:
+        raise SnapshotCorruption(
+            f"snapshot chunk {path!r} is truncated: header promises "
+            f"{payload_len} payload bytes, file holds {len(payload)}")
+    if hashlib.blake2b(payload, digest_size=32).digest() != digest:
+        raise SnapshotCorruption(
+            f"snapshot chunk {path!r} failed its digest check "
+            "(corrupted on disk)")
+    return payload
+
+
+def _decode_payload(payload: bytes) -> pa.RecordBatch:
+    reader = pa.ipc.open_stream(pa.py_buffer(payload))
+    return reader.read_next_batch()
+
+
+def _encode_batch(batch: pa.RecordBatch) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, batch.schema) as writer:
+        writer.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+#: in-process lock for manifest check-then-act (the cache_to_disk
+#: precedent: concurrent callers sharing a store must not race the
+#: validation into spurious wipes)
+_manifest_lock = threading.Lock()
+
+
+def _wipe_store(directory: str) -> None:
+    """Delete a store directory's contents (invalid manifest) so the
+    caller rebuilds cold — the CLEAN re-decode contract: stale data
+    must be unreachable the moment identity stops matching."""
+    for name in os.listdir(directory):
+        path = os.path.join(directory, name)
+        try:
+            os.remove(path)
+        except OSError as e:
+            logger.warning("inputsvc snapshot: could not remove "
+                           "stale %r: %s", path, e)
+
+
+def _ensure_manifest(directory: str, manifest: dict) -> None:
+    """Validate-or-create the store manifest (caller-locked pattern
+    inside): a matching manifest is a warm store; a missing one is
+    cold; an unreadable or MISMATCHED one (hand-edited version field,
+    foreign fingerprint — identity says this is not our store) is
+    wiped and rebuilt, counted + logged, never silently read."""
+    manifest_path = os.path.join(directory, MANIFEST_NAME)
+    with _manifest_lock:
+        if os.path.exists(manifest_path):
+            existing = None
+            try:
+                # sparkdl-lint: allow[H8] -- the hold is the point: validate-wipe-rewrite must be atomic vs sibling streams of this process, and a manifest is tens of bytes
+                with open(manifest_path) as f:
+                    existing = json.load(f)
+            except (OSError, ValueError) as e:
+                logger.warning("inputsvc snapshot: manifest %r is "
+                               "unreadable (%s); invalidating the "
+                               "store", manifest_path, e)
+            if existing == manifest:
+                return
+            if existing is not None:
+                logger.warning(
+                    "inputsvc snapshot: store %r manifest does not "
+                    "match this corpus/decode-config/version; "
+                    "invalidating and re-decoding cold", directory)
+            _count("snapshot_invalidations")
+            _wipe_store(directory)
+        tmp = (f"{manifest_path}.tmp.{os.getpid()}"
+               f".{threading.get_ident()}")
+        # sparkdl-lint: allow[H8] -- same atomic validate-wipe-rewrite section: a second stream must not read the store between the wipe and this rewrite
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, manifest_path)
+
+
+def snapshot_sources(sources: Sequence, plan: Sequence,
+                     schema: pa.Schema, root: str, fingerprint: str,
+                     decode_key: Optional[str] = None) -> List:
+    """Build the snapshot-backed source list for
+    :meth:`DataFrame.snapshot` (data/frame.py): each source's first
+    load decodes through ``plan`` and writes a validated chunk; every
+    later load — this process, the next epoch, another tenant sharing
+    ``root`` — streams the chunk back with decode busy-seconds ≈ 0.
+    ``decode_key`` defaults to the plan's stage-name signature; pass
+    an explicit key when stage behavior changes under a stable name
+    (the fingerprint discipline of ``cache_to_disk``)."""
+    from sparkdl_tpu.data.frame import Source
+    plan = list(plan)
+    if decode_key is None:
+        decode_key = ",".join(st.name for st in plan)
+    key = snapshot_key(str(fingerprint), str(decode_key))
+    directory = os.path.join(root, key)
+    os.makedirs(directory, exist_ok=True)
+    manifest = {"version": SNAPSHOT_VERSION, "key": key,
+                "fingerprint": str(fingerprint),
+                "decode_key": str(decode_key),
+                "schema": schema.to_string(),
+                "num_partitions": len(sources)}
+    _ensure_manifest(directory, manifest)
+    preserving = all(st.row_preserving for st in plan)
+
+    def make(i: int, src) -> "Source":
+        logical = (src.logical_index
+                   if src.logical_index is not None else i)
+        path = os.path.join(directory, f"chunk_{logical:05d}.snap")
+
+        def _load(src=src, logical=logical, path=path
+                  ) -> pa.RecordBatch:
+            if os.path.exists(path):
+                try:
+                    # the corrupt/missing-chunk drill's seam
+                    # (resilience/faults.py; docs/RESILIENCE.md)
+                    maybe_fail("snapshot.read")
+                    payload = _read_chunk(path)
+                    _count("snapshot_hits")
+                    _count("snapshot_bytes", len(payload))
+                    return _decode_payload(payload)
+                except (OSError, TransientError) as e:
+                    # failed CLOSED: drop the bad chunk, re-decode
+                    # cleanly below — never a stale read, never a
+                    # crash (permanent injected faults propagate:
+                    # the fail-fast drill must stay fail-fast)
+                    _count("snapshot_corruptions")
+                    logger.warning(
+                        "inputsvc snapshot: chunk %r failed "
+                        "validation (%s: %s); re-decoding the "
+                        "partition", path, type(e).__name__, e)
+                    try:
+                        os.remove(path)
+                    except OSError as rm_err:
+                        logger.debug(
+                            "inputsvc snapshot: removing bad chunk "
+                            "failed: %s", rm_err)
+            _count("snapshot_misses")
+            from sparkdl_tpu.data.spark_binding import apply_plan
+            batch = apply_plan(plan, src.load(), logical)
+            # tmp unique per pid AND thread (the cache_to_disk
+            # overlap reasoning), atomic publish via rename
+            os.makedirs(directory, exist_ok=True)
+            tmp = (f"{path}.tmp.{os.getpid()}"
+                   f".{threading.get_ident()}")
+            with open(tmp, "wb") as f:
+                f.write(_encode_chunk(_encode_batch(batch)))
+            os.replace(tmp, path)
+            _count("snapshot_writes")
+            return batch
+
+        # effectful: the first load WRITES the chunk — the engine
+        # drains straggler loads on error/abandonment so none can
+        # re-create a chunk after a cleanup rmtree (the cache_to_disk
+        # Source contract)
+        return Source(_load,
+                      src.num_rows if preserving else None,
+                      logical_index=src.logical_index,
+                      effectful=True)
+
+    return [make(i, s) for i, s in enumerate(sources)]

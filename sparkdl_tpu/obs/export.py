@@ -397,6 +397,10 @@ class TelemetryServer:
             # bundle's section, so a curl and a postmortem never
             # disagree
             "pipeline": _flight.pipeline_state(),
+            # the disaggregated input service's fleet/snapshot picture
+            # (sparkdl_tpu/inputsvc, docs/DATA_SERVICE.md) — same
+            # shape as the flight bundle's section
+            "inputsvc": _flight.inputsvc_state(),
             # the cross-process telemetry plane's per-worker view
             # (obs/remote.py) — same shape as the flight bundle's
             # workers[] section, so a curl and a postmortem never

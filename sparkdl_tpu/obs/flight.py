@@ -258,6 +258,20 @@ def pipeline_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def inputsvc_state() -> dict:
+    """The disaggregated input service's live state — the last
+    stream's resolved/live fleet plus the ``inputsvc.*`` counters
+    (decode RPCs, failovers, snapshot hits/corruptions;
+    sparkdl_tpu/inputsvc, docs/DATA_SERVICE.md) — ONE shape shared by
+    the flight bundle, ``/statusz``, and bench's ``input_service``
+    block; degrades like every probe."""
+    try:
+        from sparkdl_tpu.inputsvc.client import state
+        return state()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def workers_state() -> list:
     """The per-worker telemetry plane's ``workers[]`` section — agent
     state, last spans, counter snapshot, fault config for every
@@ -397,6 +411,7 @@ class FlightRecorder:
             "compile": compile_state(),
             "ledger": ledger_state(),
             "pipeline": pipeline_state(),
+            "inputsvc": inputsvc_state(),
             "workers": workers_state(),
             "slo": _slo_state(),
             "requests": _request_state(),

@@ -423,6 +423,8 @@ class UtilizationLedger:
         cur = self._read_feeds()
         from sparkdl_tpu.data.pipeline import consume_workers_peak
         consume_workers_peak()
+        from sparkdl_tpu.inputsvc import client as _inputsvc
+        _inputsvc.consume_workers_peak()
         with self._lock:
             self._last_t, self._last = now, cur
 
@@ -470,6 +472,15 @@ class UtilizationLedger:
         decode_workers = max(
             default_registry().gauge("pipeline.workers").value,
             consume_workers_peak())
+        # the disaggregated decode fleet ADDS lanes on top of the
+        # host's own (sparkdl_tpu/inputsvc): N live remote workers
+        # ship N workers' busy-seconds home per wall second, beyond
+        # whatever the local pool (or serial path) contributes — so
+        # the ceiling is local peak + remote peak, same window-peak
+        # reasoning as above (docs/DATA_SERVICE.md)
+        from sparkdl_tpu.inputsvc import client as _inputsvc
+        decode_workers = decode_workers + \
+            _inputsvc.consume_workers_peak()
         util, link_basis, compute_basis, decode_basis = self._utils(
             deltas, dt, ceilings, decode_workers)
         verdict = attribute(util)
@@ -627,8 +638,11 @@ class UtilizationLedger:
         # process ever banked — divide the decode lane by the
         # process-lifetime worker high-water, not the serial ceiling
         from sparkdl_tpu.data.pipeline import alltime_workers_peak
+        from sparkdl_tpu.inputsvc import client as _inputsvc
         util, _basis, _cbasis, _dbasis = self._utils(
-            totals, dt, ceilings, alltime_workers_peak())
+            totals, dt, ceilings,
+            alltime_workers_peak()
+            + _inputsvc.alltime_workers_peak())
         v = attribute(util)
         v["basis"] = "cumulative"
         return v

@@ -21,6 +21,8 @@ site                      where it fires
 ``model.fetch``           ``ModelFetcher`` cache/weight reads
 ``pipeline.worker_decode``  per-task decode inside a pipeline WORKER process
 ``pipeline.worker_death``   kills a live pipeline worker process outright
+``inputsvc.rpc``          the decode fleet's per-fragment RPC (client side)
+``snapshot.read``         a snapshot chunk's warm read (corrupt/missing drill)
 ========================  ==================================================
 
 The two ``pipeline.worker_*`` sites fire inside pool worker
@@ -85,6 +87,8 @@ SITES = (
     "model.fetch",
     "pipeline.worker_decode",
     "pipeline.worker_death",
+    "inputsvc.rpc",
+    "snapshot.read",
 )
 
 _KINDS = ("transient", "permanent")

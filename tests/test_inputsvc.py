@@ -1,0 +1,664 @@
+"""Disaggregated input service + snapshot tier tests
+(sparkdl_tpu/inputsvc/, docs/DATA_SERVICE.md): socket transport
+framing, remote-fleet decode with exact row identity, fault drills at
+the two new sites, loud degrade paths (unreachable fleet, killed
+worker, malformed endpoint spec), the snapshot invalidation matrix
+(corpus change, decode-config change, truncated/corrupted chunk,
+manifest version bump — each forces a clean re-decode, never a silent
+stale read or a crash), the ledger's scaled decode ceiling, and the
+``python -m sparkdl_tpu.inputsvc serve`` CLI."""
+
+import json
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from sparkdl_tpu.data.engine import LocalEngine
+from sparkdl_tpu.data.frame import DataFrame
+from sparkdl_tpu.inputsvc import (
+    DecodeServer,
+    RemotePipeline,
+    TransportError,
+    recv_msg,
+    resolve_endpoints,
+    send_msg,
+    snapshot_key,
+)
+from sparkdl_tpu.inputsvc import client as isvc_client
+from sparkdl_tpu.inputsvc import snapshot as isvc_snapshot
+from sparkdl_tpu.inputsvc import transport as isvc_transport
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.resilience import faults as rfaults
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test here starts and ends with the fault plane disarmed —
+    injection is per-test, never ambient."""
+    rfaults.disarm()
+    yield
+    rfaults.disarm()
+
+
+def _counter(name):
+    return default_registry().snapshot().get(name, 0.0)
+
+
+def _table(n=100):
+    return pa.table({"id": pa.array(range(n), type=pa.int64()),
+                     "x": pa.array([float(i) for i in range(n)],
+                                   type=pa.float64())})
+
+
+def _double(batch):
+    i = batch.schema.get_field_index("x")
+    return batch.set_column(i, "x", pc.multiply(batch.column("x"), 2.0))
+
+
+def _collect(engine, n=100, parts=8):
+    df = DataFrame.from_table(_table(n), parts, engine)
+    return df.map_batches(_double, name="double").collect()
+
+
+@pytest.fixture()
+def server():
+    srv = DecodeServer().start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def local_result():
+    engine = LocalEngine(num_workers=0)
+    try:
+        return _collect(engine)
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# transport framing
+# ---------------------------------------------------------------------------
+
+class TestTransport:
+    def test_round_trip(self):
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"kind": "ping", "n": 7}, b"payload-bytes")
+            header, payload = recv_msg(b)
+            assert header == {"kind": "ping", "n": 7}
+            assert payload == b"payload-bytes"
+        finally:
+            a.close()
+            b.close()
+
+    def test_empty_payload(self):
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"kind": "ok"})
+            header, payload = recv_msg(b)
+            assert header["kind"] == "ok" and payload == b""
+        finally:
+            a.close()
+            b.close()
+
+    def test_bad_magic_raises_transport_error(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"XXXX" + b"\x00" * 14)
+            with pytest.raises(TransportError):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_oversized_header_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            prefix = struct.pack(
+                ">4sHIQ", isvc_transport.MAGIC,
+                isvc_transport.WIRE_VERSION,
+                isvc_transport.MAX_HEADER_BYTES + 1, 0)
+            a.sendall(prefix)
+            with pytest.raises(TransportError):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncated_stream_raises(self):
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"kind": "ping"}, b"full-payload")
+            a.close()
+            recv_msg(b)                     # the complete message
+            with pytest.raises(TransportError):
+                recv_msg(b)                 # peer gone mid-frame
+        finally:
+            b.close()
+
+    def test_parse_endpoint(self):
+        assert isvc_transport.parse_endpoint("127.0.0.1:80") == \
+            ("127.0.0.1", 80)
+        assert isvc_transport.parse_endpoint("host:0") is None
+        assert isvc_transport.parse_endpoint("no-port") is None
+        assert isvc_transport.parse_endpoint("h:notanint") is None
+        assert isvc_transport.parse_endpoint("h:99999") is None
+        assert isvc_transport.parse_endpoint("") is None
+
+
+# ---------------------------------------------------------------------------
+# endpoint config resolution
+# ---------------------------------------------------------------------------
+
+class TestResolveEndpoints:
+    def test_explicit_string_and_list(self):
+        assert resolve_endpoints("h1:1234, h2:5678") == \
+            [("h1", 1234), ("h2", 5678)]
+        assert resolve_endpoints(["h1:1234"]) == [("h1", 1234)]
+
+    def test_env_spec(self, monkeypatch):
+        monkeypatch.setenv(isvc_client.ENV_ENDPOINTS,
+                           "h1:1111,h2:2222")
+        assert resolve_endpoints() == [("h1", 1111), ("h2", 2222)]
+
+    def test_malformed_spec_degrades_whole_fleet(self, monkeypatch,
+                                                 caplog):
+        """ANY malformed entry drops the WHOLE spec (a partial fleet
+        is a different deployment than the one configured), with one
+        warning and a counted config error — never a crash."""
+        before = _counter("inputsvc.config_errors")
+        monkeypatch.setenv(isvc_client.ENV_ENDPOINTS,
+                           "h1:1111;badness")
+        with caplog.at_level(
+                "WARNING", logger="sparkdl_tpu.inputsvc.client"):
+            assert resolve_endpoints() == []
+        assert _counter("inputsvc.config_errors") == before + 1
+        assert any("badness" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_empty_env_means_no_fleet(self, monkeypatch):
+        monkeypatch.delenv(isvc_client.ENV_ENDPOINTS, raising=False)
+        assert resolve_endpoints() == []
+
+
+# ---------------------------------------------------------------------------
+# remote decode: identity, fleet fan-out, degrade paths
+# ---------------------------------------------------------------------------
+
+class TestRemoteDecode:
+    def test_identity_single_worker(self, server, local_result):
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            out = _collect(engine)
+        finally:
+            engine.shutdown()
+        assert out.equals(local_result)
+        snap = default_registry().snapshot()
+        assert snap.get("inputsvc.server_requests", 0) > 0
+
+    def test_identity_two_worker_fleet(self, local_result):
+        s1, s2 = DecodeServer().start(), DecodeServer().start()
+        try:
+            engine = LocalEngine(inputsvc_endpoints=[
+                f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"])
+            try:
+                out = _collect(engine)
+            finally:
+                engine.shutdown()
+        finally:
+            s1.close()
+            s2.close()
+        assert out.equals(local_result)
+
+    def test_rows_and_tasks_counted(self, server):
+        rows0 = _counter("inputsvc.rows")
+        tasks0 = _counter("inputsvc.tasks")
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            _collect(engine, n=60, parts=6)
+        finally:
+            engine.shutdown()
+        assert _counter("inputsvc.rows") == rows0 + 60
+        assert _counter("inputsvc.tasks") == tasks0 + 6
+
+    def test_unreachable_fleet_falls_back_loudly(self, local_result,
+                                                 caplog):
+        """A fleet that never answers degrades to LOCAL decode for the
+        whole stream — correct rows, counted fallback, one warning."""
+        fb0 = _counter("inputsvc.fallbacks")
+        # a port from the ephemeral range with nothing listening
+        sock = socket.create_server(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()                        # nothing listens now
+        engine = LocalEngine(inputsvc_endpoints=f"127.0.0.1:{port}")
+        try:
+            with caplog.at_level(
+                    "WARNING", logger="sparkdl_tpu.inputsvc.client"):
+                out = _collect(engine)
+        finally:
+            engine.shutdown()
+        assert out.equals(local_result)
+        assert _counter("inputsvc.fallbacks") == fb0 + 1
+
+    def test_killed_worker_fails_over_per_partition(self,
+                                                    local_result):
+        """A worker that dies MID-STREAM: every partition still lands
+        exactly once, through per-partition local failover."""
+        srv = DecodeServer().start()
+        ld0 = _counter("inputsvc.local_decodes")
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{srv.port}")
+        try:
+            srv.close()                     # dies before the stream
+            out = _collect(engine)
+        finally:
+            engine.shutdown()
+        assert out.equals(local_result)
+        snap = default_registry().snapshot()
+        assert (snap.get("inputsvc.local_decodes", 0) > ld0
+                or snap.get("inputsvc.fallbacks", 0) > 0)
+
+    def test_rpc_fault_drill_keeps_identity(self, server,
+                                            local_result):
+        """10%+ transient injection at ``inputsvc.rpc``: the shared
+        RetryPolicy re-runs the fragment, rows stay exact — zero
+        lost, zero duplicated."""
+        inj0 = _counter("faults.inputsvc.rpc.injected")
+        rfaults.inject("inputsvc.rpc", "transient", 0.3, seed=7)
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            out = _collect(engine)
+        finally:
+            engine.shutdown()
+            rfaults.disarm()
+        assert out.equals(local_result)
+        assert _counter("faults.inputsvc.rpc.injected") > inj0
+
+    def test_engine_pickles_without_sockets(self, server):
+        """H3: connections are per-stream — a pickled engine carries
+        endpoint STRINGS, never live sockets."""
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            _collect(engine)                # opens + closes conns
+            clone = pickle.loads(pickle.dumps(engine))
+            assert clone.inputsvc_endpoints == \
+                engine.inputsvc_endpoints
+        finally:
+            engine.shutdown()
+
+    def test_server_refuses_pickle(self, server):
+        with pytest.raises(TypeError):
+            pickle.dumps(server)
+
+    def test_remote_pipeline_none_without_endpoints(self):
+        assert RemotePipeline([]).stream(
+            [], [], LocalEngine(num_workers=0)) is None
+
+    def test_client_state_shape(self, server):
+        """ONE state() shape shared by /statusz, flight bundles, and
+        the bench block."""
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            _collect(engine)
+        finally:
+            engine.shutdown()
+        st = isvc_client.state()
+        for key in ("endpoints", "live_endpoints", "streams_active",
+                    "workers_live", "counters"):
+            assert key in st, key
+        assert st["streams_active"] == 0
+        assert all(k.startswith("inputsvc.")
+                   for k in st["counters"])
+
+
+# ---------------------------------------------------------------------------
+# observability integration
+# ---------------------------------------------------------------------------
+
+class TestObsIntegration:
+    def test_ledger_decode_ceiling_scales_with_fleet(self, server):
+        """The remote fleet ADDS decode lanes: a window that covers a
+        remote stream divides decode busy by (local + remote) workers
+        — the CI drill's assertion surface."""
+        from sparkdl_tpu.obs.ledger import UtilizationLedger
+        led = UtilizationLedger(window_s=1.0, history=4)
+        led.ensure_ceilings({"link_h2d_MBps": 1.0,
+                             "link_d2h_MBps": 1.0, "source": "test"})
+        led.baseline(now=0.0)               # drains stale peaks
+        other = DecodeServer().start()
+        engine = LocalEngine(inputsvc_endpoints=[
+            f"127.0.0.1:{server.port}",
+            f"127.0.0.1:{other.port}"])
+        try:
+            _collect(engine)
+        finally:
+            engine.shutdown()
+            other.close()
+        w = led.tick(now=1.0)
+        assert w is not None
+        assert w["decode_workers"] >= 2     # the remote fleet's lanes
+
+    def test_statusz_and_flight_carry_inputsvc(self, server):
+        from sparkdl_tpu.obs import export as obs_export
+        from sparkdl_tpu.obs import flight as obs_flight
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            _collect(engine)
+        finally:
+            engine.shutdown()
+        st = obs_flight.inputsvc_state()
+        assert "endpoints" in st
+        with obs_export.TelemetryServer(
+                registry=default_registry()) as tel:
+            import urllib.request
+            with urllib.request.urlopen(
+                    tel.url("/statusz"), timeout=10) as resp:
+                statusz = json.loads(resp.read())
+        assert "inputsvc" in statusz
+        assert "endpoints" in statusz["inputsvc"]
+        bundle = obs_flight.recorder().bundle(reason="test")
+        assert "inputsvc" in bundle
+        assert "endpoints" in bundle["inputsvc"]
+
+    def test_remote_telemetry_frames_ingested(self, server,
+                                              monkeypatch):
+        """With remote telemetry forced on, decode replies carry
+        TelemetryAgent frames and the worker shows up in the
+        aggregator — same plane as pool workers."""
+        from sparkdl_tpu.obs import remote as obs_remote
+        monkeypatch.setenv(obs_remote.ENV_REMOTE, "1")
+        agg = obs_remote.aggregator()
+        agg.clear()
+        engine = LocalEngine(
+            inputsvc_endpoints=f"127.0.0.1:{server.port}")
+        try:
+            _collect(engine)
+        finally:
+            engine.shutdown()
+        try:
+            assert len(agg.workers_status()) >= 1
+        finally:
+            agg.clear()
+
+    def test_disarmed_pin_new_sites(self):
+        """The two new sites ride the same <10 µs disarmed regime as
+        every other site (min over repeats — noise only adds time)."""
+        for site in ("inputsvc.rpc", "snapshot.read"):
+            assert site in rfaults.SITES
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(2000):
+                    rfaults.maybe_fail(site)
+                best = min(best, (time.perf_counter() - t0) / 2000)
+            assert best < 10e-6, \
+                f"disarmed {site} costs {best * 1e6:.2f} µs"
+
+
+# ---------------------------------------------------------------------------
+# snapshot tier
+# ---------------------------------------------------------------------------
+
+class TestSnapshot:
+    def _base(self, engine, n=100, parts=8):
+        df = DataFrame.from_table(_table(n), parts, engine)
+        return df.map_batches(_double, name="double")
+
+    def test_cold_then_warm_epoch(self, tmp_path, local_result):
+        """Epoch 1 decodes + persists; epoch 2 streams packed chunks
+        with decode busy-seconds ≈ 0 — the amortization the tier
+        exists for."""
+        engine = LocalEngine(num_workers=0)
+        reg = default_registry()
+        try:
+            base = self._base(engine)
+            m0 = _counter("inputsvc.snapshot_misses")
+            cold = base.snapshot(str(tmp_path), fingerprint="c1")
+            assert cold.collect().equals(local_result)
+            assert _counter("inputsvc.snapshot_misses") == m0 + 8
+
+            h0 = _counter("inputsvc.snapshot_hits")
+            busy0 = reg.counter("engine.busy_seconds").value
+            warm = base.snapshot(str(tmp_path), fingerprint="c1")
+            assert warm.collect().equals(local_result)
+            warm_busy = reg.counter("engine.busy_seconds").value \
+                - busy0
+            assert _counter("inputsvc.snapshot_hits") == h0 + 8
+            assert warm_busy < 0.1, warm_busy
+        finally:
+            engine.shutdown()
+
+    def test_schema_preserved(self, tmp_path):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            snapped = base.snapshot(str(tmp_path), fingerprint="c1")
+            assert snapped.schema.equals(base.schema)
+            out = snapped.collect()
+            assert out.schema.equals(base.collect().schema)
+        finally:
+            engine.shutdown()
+
+    def test_corpus_change_changes_key(self):
+        assert snapshot_key("corpus-a", "plan") != \
+            snapshot_key("corpus-b", "plan")
+
+    def test_decode_config_change_changes_key(self, tmp_path):
+        """A different stage list lands in a DIFFERENT store — the
+        old snapshot can never serve the new decode config."""
+        assert snapshot_key("c1", "double") != \
+            snapshot_key("c1", "double,resize")
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+
+            def triple(batch):
+                i = batch.schema.get_field_index("x")
+                return batch.set_column(
+                    i, "x", pc.multiply(batch.column("x"), 3.0))
+
+            df = DataFrame.from_table(_table(), 8, engine)
+            other = df.map_batches(triple, name="triple")
+            m0 = _counter("inputsvc.snapshot_misses")
+            out = other.snapshot(str(tmp_path),
+                                 fingerprint="c1").collect()
+            # a fresh key => cold decode, and the rows are the NEW
+            # plan's rows, not the stale double-plan chunks
+            assert _counter("inputsvc.snapshot_misses") == m0 + 8
+            assert out.column("x").to_pylist()[1] == 3.0
+            assert len(os.listdir(tmp_path)) == 2
+        finally:
+            engine.shutdown()
+
+    def _store_dir(self, root):
+        dirs = [d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))]
+        assert len(dirs) == 1, dirs
+        return os.path.join(root, dirs[0])
+
+    def test_corrupted_chunk_re_decodes(self, tmp_path,
+                                        local_result):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            store = self._store_dir(tmp_path)
+            chunk = sorted(f for f in os.listdir(store)
+                           if f.endswith(".snap"))[0]
+            with open(os.path.join(store, chunk), "r+b") as f:
+                f.seek(60)
+                f.write(b"\xff\xff\xff")
+            c0 = _counter("inputsvc.snapshot_corruptions")
+            out = base.snapshot(str(tmp_path),
+                                fingerprint="c1").collect()
+            assert out.equals(local_result)
+            assert _counter("inputsvc.snapshot_corruptions") == c0 + 1
+        finally:
+            engine.shutdown()
+
+    def test_truncated_chunk_re_decodes(self, tmp_path,
+                                        local_result):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            store = self._store_dir(tmp_path)
+            chunk = sorted(f for f in os.listdir(store)
+                           if f.endswith(".snap"))[0]
+            path = os.path.join(store, chunk)
+            with open(path, "r+b") as f:
+                f.truncate(20)              # mid-header truncation
+            out = base.snapshot(str(tmp_path),
+                                fingerprint="c1").collect()
+            assert out.equals(local_result)
+            # the bad chunk was replaced by a fresh, valid one
+            assert os.path.getsize(path) > 20
+        finally:
+            engine.shutdown()
+
+    def test_missing_chunk_re_decodes(self, tmp_path, local_result):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            store = self._store_dir(tmp_path)
+            chunk = sorted(f for f in os.listdir(store)
+                           if f.endswith(".snap"))[0]
+            os.remove(os.path.join(store, chunk))
+            m0 = _counter("inputsvc.snapshot_misses")
+            out = base.snapshot(str(tmp_path),
+                                fingerprint="c1").collect()
+            assert out.equals(local_result)
+            assert _counter("inputsvc.snapshot_misses") == m0 + 1
+        finally:
+            engine.shutdown()
+
+    def test_manifest_version_bump_invalidates_store(self, tmp_path,
+                                                     local_result):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            store = self._store_dir(tmp_path)
+            mpath = os.path.join(store, isvc_snapshot.MANIFEST_NAME)
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+            manifest["version"] = isvc_snapshot.SNAPSHOT_VERSION + 99
+            with open(mpath, "w", encoding="utf-8") as f:
+                json.dump(manifest, f)
+            i0 = _counter("inputsvc.snapshot_invalidations")
+            out = base.snapshot(str(tmp_path),
+                                fingerprint="c1").collect()
+            assert out.equals(local_result)
+            assert _counter("inputsvc.snapshot_invalidations") == \
+                i0 + 1
+        finally:
+            engine.shutdown()
+
+    def test_unreadable_manifest_invalidates_store(self, tmp_path,
+                                                   local_result):
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            store = self._store_dir(tmp_path)
+            mpath = os.path.join(store, isvc_snapshot.MANIFEST_NAME)
+            with open(mpath, "w", encoding="utf-8") as f:
+                f.write("{not json")
+            out = base.snapshot(str(tmp_path),
+                                fingerprint="c1").collect()
+            assert out.equals(local_result)
+        finally:
+            engine.shutdown()
+
+    def test_snapshot_read_fault_drill(self, tmp_path, local_result):
+        """``snapshot.read`` at rate 1.0: every warm read fails, every
+        partition re-decodes cleanly — identical rows, no crash."""
+        engine = LocalEngine(num_workers=0)
+        try:
+            base = self._base(engine)
+            base.snapshot(str(tmp_path), fingerprint="c1").collect()
+            c0 = _counter("inputsvc.snapshot_corruptions")
+            rfaults.inject("snapshot.read", "transient", 1.0)
+            try:
+                out = base.snapshot(str(tmp_path),
+                                    fingerprint="c1").collect()
+            finally:
+                rfaults.disarm()
+            assert out.equals(local_result)
+            assert _counter("inputsvc.snapshot_corruptions") >= \
+                c0 + 8
+        finally:
+            engine.shutdown()
+
+    def test_chunk_round_trip_and_digest(self, tmp_path):
+        blob = b"chunk-payload" * 100
+        good = tmp_path / "good.snap"
+        good.write_bytes(isvc_snapshot._encode_chunk(blob))
+        assert isvc_snapshot._read_chunk(str(good)) == blob
+        bad = bytearray(isvc_snapshot._encode_chunk(blob))
+        bad[-1] ^= 0xFF
+        flipped = tmp_path / "bad.snap"
+        flipped.write_bytes(bytes(bad))
+        with pytest.raises(isvc_snapshot.SnapshotCorruption):
+            isvc_snapshot._read_chunk(str(flipped))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_serve_ready_line_and_ping(self):
+        """``python -m sparkdl_tpu.inputsvc serve --port 0`` prints
+        the READY line with its bound endpoint and answers a ping
+        over the wire — the two-process drill's contract."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sparkdl_tpu.inputsvc", "serve",
+             "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        try:
+            deadline = time.time() + 60
+            line = ""
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "SPARKDL_TPU_INPUTSVC READY" in line:
+                    break
+            assert "SPARKDL_TPU_INPUTSVC READY" in line, line
+            endpoint = line.strip().rsplit(" ", 1)[-1]
+            host, port = isvc_transport.parse_endpoint(endpoint)
+            with socket.create_connection((host, port),
+                                          timeout=10) as sock:
+                send_msg(sock, {"op": "ping"})
+                header, _ = recv_msg(sock)
+            assert header.get("ok") is True
+            assert header.get("version") == \
+                isvc_transport.WIRE_VERSION
+        finally:
+            proc.terminate()
+            proc.wait(timeout=15)
+
+    def test_serve_rejects_bad_subcommand(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.inputsvc", "bogus"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert proc.returncode != 0

@@ -56,10 +56,13 @@ from typing import List, Optional
 #: pipeline_overlap block's mode/worker shape on the measuring host's
 #: cores and start-method support (data/pipeline.py), and the
 #: ship_ring block's ring depth / hit and byte tallies on the
-#: measuring host's corpus shape (runtime/runner.py InfeedRing)
+#: measuring host's corpus shape (runtime/runner.py InfeedRing),
+#: and the input_service block's rows/s and snapshot tallies on the
+#: measuring host's cores and disk (sparkdl_tpu/inputsvc/)
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
                 "autotune", "tails", "slo", "resilience", "bound",
-                "compile", "pipeline_overlap", "ship_ring"}
+                "compile", "pipeline_overlap", "ship_ring",
+                "input_service"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

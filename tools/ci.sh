@@ -164,6 +164,16 @@
 #      typed PipelineWorkerError, and a flight bundle whose workers[]
 #      names the dead worker; and `report --workers` must read the
 #      merged trace (with the bundle join)
+#  21. input-service gate (docs/DATA_SERVICE.md): a TWO-PROCESS
+#      localhost drill — the client process streams the corpus
+#      through one `python -m sparkdl_tpu.inputsvc serve` DecodeServer
+#      with ZERO lost/duplicated rows (exact id identity) under a 10%
+#      inputsvc.rpc transient injection; the ledger window's
+#      decode_workers must scale by the live remote fleet; killing
+#      the worker mid-run must fail over to local decode LOUDLY
+#      (counted fallback, correct rows); and a second snapshot-backed
+#      epoch must stream with pipeline decode busy-seconds ≈ 0 at
+#      throughput >= the serial-decode baseline
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -179,7 +189,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/20] native shim build =="
+echo "== [1/21] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -188,13 +198,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/20] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/21] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/20] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/21] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/20] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/21] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -203,7 +213,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/20] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/21] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -291,7 +301,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/20] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/21] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -330,11 +340,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/20] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/21] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/20] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/21] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -429,7 +439,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/20] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/21] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -539,7 +549,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/20] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/21] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -678,11 +688,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/20] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/21] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/20] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/21] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -747,7 +757,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/20] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/21] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -845,7 +855,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/20] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/21] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -937,7 +947,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/20] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/21] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1064,7 +1074,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/20] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/21] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1184,7 +1194,7 @@ python -m sparkdl_tpu.obs report --bound \
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
-echo "== [16/20] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+echo "== [16/21] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
 # (a) the bench smoke's "compile" block (step 4's result file): the
 # compile log was armed for the whole run, saw every jit compile, and
 # the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
@@ -1320,7 +1330,7 @@ grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
 grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
 grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
-echo "== [17/20] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
+echo "== [17/21] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's pipeline_overlap block: serial-vs-pooled ips
 # on one corpus + the overlap proof. On a multi-core host the pool
 # must have engaged and not lose >5% to serial; on a 1-core host the
@@ -1524,7 +1534,7 @@ print(json.dumps({"pipeline_gate": "ok", "cores": cores,
                   "bundle": path}))
 EOF
 
-echo "== [18/20] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
+echo "== [18/21] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's ship_ring block: the repeated-corpus steady
 # pass must ship ZERO bytes (every chunk a content hit off a resident
 # slab — STRICTLY below the no-ring baseline's per-pass corpus
@@ -1700,7 +1710,7 @@ print(json.dumps({"ring_serve_gate": "ok", "cores": cores,
                   "interleave_gated": cores >= 2}))
 EOF
 
-echo "== [19/20] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
+echo "== [19/21] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1864,7 +1874,7 @@ print(json.dumps({"race_gate": "ok",
                   "topology_s": t["per_rule_s"]["threads-topology"]}))
 EOF
 
-echo "== [20/20] cross-process telemetry gate (merged worker trace + scrape + fault/death drills + report --workers, docs/OBSERVABILITY.md) =="
+echo "== [20/21] cross-process telemetry gate (merged worker trace + scrape + fault/death drills + report --workers, docs/OBSERVABILITY.md) =="
 SPARKDL_TPU_PIPELINE_MPCTX=fork SPARKDL_TPU_TRACE=1 \
   SPARKDL_TPU_FLIGHT=1 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
@@ -2003,6 +2013,159 @@ print(json.dumps({
     "faults_mirrored": injected - injected0,
     "dead_workers": dead,
     "bundle": bundle_path,
+}))
+EOF
+
+echo "== [21/21] input-service gate (two-process decode fleet + snapshot tier, docs/DATA_SERVICE.md) =="
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from sparkdl_tpu.data.engine import LocalEngine
+from sparkdl_tpu.data.frame import DataFrame
+from sparkdl_tpu.inputsvc import transport as isvc_transport
+from sparkdl_tpu.inputsvc import client as isvc_client
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.obs.ledger import UtilizationLedger
+from sparkdl_tpu.resilience import faults
+
+reg = default_registry()
+N, PARTS = 4096, 8
+table = pa.table({
+    "id": pa.array(range(N), type=pa.int64()),
+    "x": pa.array([float(i % 997) for i in range(N)],
+                  type=pa.float64()),
+})
+
+
+def plan(df):
+    def work(batch):
+        i = batch.schema.get_field_index("x")
+        col = batch.column("x")
+        for _ in range(40):                # real decode-side work
+            col = pc.add(pc.multiply(col, 1.0000001), 0.5)
+        return batch.set_column(i, "x", col)
+    return df.map_batches(work, name="ci_decode")
+
+
+def collect_ids(engine):
+    out = plan(DataFrame.from_table(table, PARTS, engine)).collect()
+    return sorted(out.column("id").to_pylist()), out
+
+
+# -- (a) spawn THE OTHER PROCESS: one DecodeServer over the CLI ------
+proc = subprocess.Popen(
+    [sys.executable, "-m", "sparkdl_tpu.inputsvc", "serve",
+     "--port", "0"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+endpoint = None
+deadline = time.time() + 90
+while time.time() < deadline:
+    line = proc.stdout.readline()
+    if "SPARKDL_TPU_INPUTSVC READY" in line:
+        endpoint = line.strip().rsplit(" ", 1)[-1]
+        break
+assert endpoint, "DecodeServer CLI never printed its READY line"
+assert isvc_transport.parse_endpoint(endpoint) is not None, endpoint
+
+expected = list(range(N))
+serial_engine = LocalEngine(num_workers=0)
+ids, _ = collect_ids(serial_engine)
+assert ids == expected
+t0 = time.perf_counter()
+collect_ids(serial_engine)
+serial_ips = N / (time.perf_counter() - t0)
+serial_engine.shutdown()
+
+# -- (b) zero lost/dup rows under 10% inputsvc.rpc injection, with
+#        the ledger's decode ceiling scaled by the live remote fleet
+#        (two client lanes into the one server process) -------------
+led = UtilizationLedger(window_s=1.0, history=4)
+led.ensure_ceilings({"link_h2d_MBps": 1.0, "link_d2h_MBps": 1.0,
+                     "source": "ci"})
+led.baseline()
+# seed 2 fires twice in the first 8 draws at rate 0.1 — the drill
+# must actually inject on this corpus's 8 fragments
+faults.inject("inputsvc.rpc", "transient", 0.1, seed=2)
+engine = LocalEngine(inputsvc_endpoints=[endpoint, endpoint])
+try:
+    inj0 = reg.counter("faults.inputsvc.rpc.injected").value
+    rows0 = reg.counter("inputsvc.rows").value
+    ids, _ = collect_ids(engine)
+finally:
+    faults.disarm()
+injected = reg.counter("faults.inputsvc.rpc.injected").value - inj0
+remote_rows = reg.counter("inputsvc.rows").value - rows0
+assert ids == expected, "rows lost or duplicated under the rpc drill"
+assert injected > 0, "the 10% drill injected nothing on 8 fragments"
+assert remote_rows == N, (remote_rows, N)
+w = led.tick()
+assert w is not None
+assert w["decode_workers"] >= 2, \
+    f"ledger decode ceiling not scaled by the remote fleet: {w['decode_workers']}"
+
+# -- (c) kill the worker process: LOUD failover to local decode ------
+proc.terminate()
+proc.wait(timeout=30)
+fb0 = reg.snapshot().get("inputsvc.fallbacks", 0)
+ld0 = reg.snapshot().get("inputsvc.local_decodes", 0)
+ids, _ = collect_ids(engine)
+engine.shutdown()
+assert ids == expected, "rows wrong after worker death"
+snap = reg.snapshot()
+loud = (snap.get("inputsvc.fallbacks", 0) - fb0) + \
+    (snap.get("inputsvc.local_decodes", 0) - ld0)
+assert loud > 0, "worker death failed over silently (nothing counted)"
+
+# -- (d) snapshot tier: second epoch decodes ~nothing, streams at
+#        >= the serial-decode baseline ------------------------------
+snap_root = tempfile.mkdtemp(prefix="sparkdl_ci_snap_")
+snap_engine = LocalEngine(num_workers=0)
+try:
+    base = plan(DataFrame.from_table(table, PARTS, snap_engine))
+    cold = base.snapshot(snap_root, fingerprint="ci-corpus")
+    out = cold.collect()
+    assert sorted(out.column("id").to_pylist()) == expected
+    assert reg.snapshot().get("inputsvc.snapshot_writes", 0) >= PARTS
+
+    warm_ips = 0.0
+    busy0 = reg.counter("engine.busy_seconds").value
+    for _ in range(2):
+        warm = base.snapshot(snap_root, fingerprint="ci-corpus")
+        t0 = time.perf_counter()
+        out = warm.collect()
+        warm_ips = max(warm_ips, N / (time.perf_counter() - t0))
+    warm_busy = reg.counter("engine.busy_seconds").value - busy0
+    assert sorted(out.column("id").to_pylist()) == expected
+    assert warm_busy < 0.05, \
+        f"warm epoch still decoding: busy {warm_busy:.4f}s"
+    assert warm_ips >= serial_ips, \
+        f"warm snapshot epoch ({warm_ips:.0f} rows/s) lost to the " \
+        f"serial-decode baseline ({serial_ips:.0f} rows/s)"
+finally:
+    snap_engine.shutdown()
+    shutil.rmtree(snap_root, ignore_errors=True)
+
+print(json.dumps({
+    "input_service_gate": "ok",
+    "rows": N,
+    "rpc_faults_injected": int(injected),
+    "ledger_decode_workers": int(w["decode_workers"]),
+    "loud_failover_events": int(loud),
+    "serial_ips": round(serial_ips, 1),
+    "snapshot_warm_ips": round(warm_ips, 1),
+    "snapshot_warm_decode_busy_s": round(warm_busy, 4),
 }))
 EOF
 

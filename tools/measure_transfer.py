@@ -353,6 +353,67 @@ def _workers_sweep(counts, n_images: int = 48,
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _remote_workers_sweep(counts, n_rows: int = 4096,
+                          n_partitions: int = 8) -> list:
+    """The disaggregated input service's fleet-size axis
+    (sparkdl_tpu/inputsvc/, docs/DATA_SERVICE.md): the SAME decode
+    plan over ONE synthetic corpus collected through a remote decode
+    fleet at each worker count — in-process ``DecodeServer`` processes
+    over the real socket transport, per-config rows/s, best of 2
+    passes. 0 = local decode (no fleet); the measured priors behind
+    PipelineTarget's ``inputsvc_workers`` knob bound."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from sparkdl_tpu.data.engine import LocalEngine
+    from sparkdl_tpu.data.frame import DataFrame
+    from sparkdl_tpu.inputsvc import DecodeServer
+
+    table = pa.table({
+        "id": pa.array(range(n_rows), type=pa.int64()),
+        "x": pa.array([float(i % 997) for i in range(n_rows)],
+                      type=pa.float64()),
+    })
+
+    def work(batch):
+        i = batch.schema.get_field_index("x")
+        col = batch.column("x")
+        for _ in range(8):
+            col = pc.add(pc.multiply(col, 1.0000001), 0.5)
+        return batch.set_column(i, "x", col)
+
+    fleet_max = max([int(c) for c in counts] + [0])
+    servers = [DecodeServer().start() for _ in range(fleet_max)]
+    endpoints = [f"127.0.0.1:{s.port}" for s in servers]
+    grid = []
+    try:
+        for c in counts:
+            c = int(c)
+            engine = LocalEngine(
+                inputsvc_endpoints=endpoints[:c] if c >= 1 else [])
+            try:
+                best = 0.0
+                for _ in range(2):
+                    df = DataFrame.from_table(
+                        table, n_partitions, engine).map_batches(
+                            work, name="sweep_decode")
+                    t0 = time.perf_counter()
+                    n = df.collect().num_rows
+                    assert n == n_rows, (n, n_rows)
+                    best = max(best,
+                               n / (time.perf_counter() - t0))
+                grid.append({
+                    "remote_workers": c,
+                    "mode": "remote" if c >= 1 else "local",
+                    "rows_per_s": round(best, 1)})
+            finally:
+                engine.shutdown()
+        return grid
+    finally:
+        for s in servers:
+            s.close()
+
+
 def main() -> None:
     import argparse
 
@@ -388,6 +449,14 @@ def main() -> None:
                              "sweep with --sweep (0 = no ring; e.g. "
                              "0,2,4) — the measured priors behind "
                              "RunnerTarget's infeed_ring bound")
+    parser.add_argument("--remote-workers", default=None,
+                        help="comma-separated remote decode fleet "
+                             "sizes to sweep with --sweep (0 = local "
+                             "decode; e.g. 0,1,2) — in-process "
+                             "DecodeServers over the real socket "
+                             "transport; the measured priors behind "
+                             "PipelineTarget's inputsvc_workers knob "
+                             "(docs/DATA_SERVICE.md)")
     parser.add_argument("--interleave", default=None,
                         help="comma-separated transfer-interleave "
                              "widths to sweep with --sweep (0/1 = "
@@ -416,6 +485,12 @@ def main() -> None:
             widths = [int(tok) for tok in args.interleave.split(",")
                       if tok.strip() != ""]
             report["interleave_sweep"] = _interleave_sweep(widths)
+        if args.remote_workers is not None:
+            sizes = [int(tok)
+                     for tok in args.remote_workers.split(",")
+                     if tok.strip() != ""]
+            report["remote_workers_sweep"] = _remote_workers_sweep(
+                sizes)
         print(json.dumps(report))
         return
     batch = args.batch or (256 if on_tpu else 8)
