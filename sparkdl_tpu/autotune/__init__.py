@@ -20,7 +20,11 @@ runtime through attachable targets
 * ``PipelineTarget`` — the parallel host pipeline's worker count and
   read-ahead window (``data/pipeline.py``): deepened (trial-gated)
   while the live roofline says the decode lane binds, shed on memory
-  pressure.
+  pressure;
+* ``FleetTarget`` — a fleet-registry model's replica count
+  (``sparkdl_tpu/fleet``): grown (grow-only, warm-started from the
+  persisted AOT cache) only while the roofline says the serve lane
+  binds AND replica queues stay deep.
 
 Armed by ``SPARKDL_TPU_AUTOTUNE=1`` or ``controller().arm()``;
 disarmed, the hot-path :func:`poll` hook is a single armed-check (the
@@ -39,6 +43,7 @@ from sparkdl_tpu.autotune.core import (
     poll,
 )
 from sparkdl_tpu.autotune.targets import (
+    FleetTarget,
     PipelineTarget,
     RechunkTarget,
     RunnerTarget,
@@ -47,6 +52,7 @@ from sparkdl_tpu.autotune.targets import (
 
 __all__ = [
     "AutotuneController",
+    "FleetTarget",
     "Knob",
     "PipelineTarget",
     "Proposal",

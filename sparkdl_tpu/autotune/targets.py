@@ -674,3 +674,86 @@ class RechunkTarget(_TrialMixin):
                 "prewarmed": self.warmed,
                 "trial_open": self._trial is not None,
                 "knobs": [k.describe() for k in self.knobs()]}
+
+
+class FleetTarget(_TrialMixin):
+    """Grows a logical model's replica count through the fleet
+    registry when the live roofline says SERVING is the binding
+    ceiling and the replicas' request queues stay deep.
+
+    The knob is ``ModelRegistry.scale`` — grow-only (scale never tears
+    down a live session mid-traffic), so there is no trial/revert
+    machinery here: a replica is added only behind TWO measured gates
+    and the knob's own cooldown, never speculatively:
+
+    * the ledger's ``bound_by`` verdict must be the **serve** lane
+      (``_TrialMixin._ledger_prior()``; obs/ledger.py) — compute- or
+      decode-bound pipelines gain nothing from more serve sessions,
+      and a process that never ran the ledger never scales (no prior,
+      no growth: the expensive knob needs positive evidence);
+    * the mean queue depth per replica must exceed
+      ``grow_depth_batches`` dispatch batches — momentary bursts the
+      coalesce window absorbs do not count.
+
+    Growth is cheap precisely because of the warm-start cache: the new
+    replica deserializes the persisted AOT executable instead of
+    compiling (fleet/warmstart.py), which is why this knob is safe to
+    hand to the controller at all.
+    """
+
+    #: mean per-replica queue depth (in dispatch batches) that reads
+    #: as "persistently behind" — below it the fleet never grows
+    grow_depth_batches = 2.0
+
+    def __init__(self, registry, model: str,
+                 name: Optional[str] = None,
+                 max_replicas: int = 4):
+        self.registry = registry
+        self.model = model
+        self.name = name or f"fleet:{model}"
+        entry = registry.entry(model)     # typed KeyError surface
+        self._replicas = Knob(
+            "replicas",
+            get=lambda: len(registry.entry(model).replicas),
+            set=lambda v: registry.scale(model, int(v)),
+            lo=len(entry.replicas), hi=int(max_replicas))
+
+    def knobs(self) -> List[Knob]:
+        return [self._replicas]
+
+    def _mean_depth(self) -> Optional[float]:
+        """Mean request-queue depth across the model's live replicas
+        (``ModelSession.queue_depth()``), ``None`` when unreadable."""
+        try:
+            entry = self.registry.entry(self.model)
+            server = self.registry._server
+            depths = [server.session(r).queue_depth()
+                      for r in entry.replicas]
+        # sparkdl-lint: allow[H12] -- measurement probe: a replica mid-teardown means "no signal this window", not a controller crash
+        except Exception:
+            return None
+        return (sum(depths) / len(depths)) if depths else None
+
+    def propose(self, warming: bool) -> List[Proposal]:
+        if warming or not self._replicas.usable():
+            return []
+        cur = self._replicas.value
+        if cur >= self._replicas.hi:
+            return []
+        if self._ledger_prior() != "serve":
+            return []           # the ceiling is elsewhere — hold
+        depth = self._mean_depth()
+        batch = self.registry.entry(self.model).batch_size
+        if depth is None or depth < self.grow_depth_batches * batch:
+            return []
+        return [Proposal(
+            self._replicas, cur + 1,
+            f"serve-bound with mean queue depth {depth:.0f} rows "
+            f"(≥ {self.grow_depth_batches:g} batches of {batch}) — "
+            f"grow {self.model!r} to {cur + 1} replicas")]
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": "fleet",
+                "model": self.model,
+                "ledger_prior": self._ledger_prior(),
+                "knobs": [k.describe() for k in self.knobs()]}

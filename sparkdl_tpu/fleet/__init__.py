@@ -1,0 +1,60 @@
+"""The fleet control plane: model lifecycle OVER the serve data plane.
+
+``serve/`` executes batches; this package decides what is deployed,
+at which version, with which weights, where, and who answers each
+request (docs/SERVING.md "Fleet control plane"):
+
+* :class:`ModelRegistry` (registry.py) — versioned deployments +
+  zero-downtime weight hot-swap with retrace-checked rollback;
+* :mod:`placement <sparkdl_tpu.fleet.placement>` — HBM-aware packing
+  from measured ``hbm.d<i>.*`` gauges, typed admission refusal;
+* :class:`FleetRouter` (router.py) — least-queue-depth, circuit-aware
+  replica pick with a drillable failover seam;
+* :class:`WarmStartCache` (warmstart.py) — the persisted AOT
+  executable store: a fresh worker's first request pays zero compile.
+"""
+
+from sparkdl_tpu.fleet.placement import (
+    DeviceBudget,
+    ModelFootprint,
+    PlacementError,
+    PlacementPlan,
+    device_budgets,
+    estimate_footprint,
+    plan_placement,
+)
+from sparkdl_tpu.fleet.router import FleetRouter
+from sparkdl_tpu.fleet.warmstart import WarmStartCache, warmstart_key
+from sparkdl_tpu.fleet.registry import (
+    FleetError,
+    ModelRegistry,
+    ModelVersion,
+    RegistryEntry,
+    SwapError,
+    SwapRetraceError,
+    SwapShapeError,
+    live_registries,
+    params_fingerprint,
+)
+
+__all__ = [
+    "DeviceBudget",
+    "FleetError",
+    "FleetRouter",
+    "ModelFootprint",
+    "ModelRegistry",
+    "ModelVersion",
+    "PlacementError",
+    "PlacementPlan",
+    "RegistryEntry",
+    "SwapError",
+    "SwapRetraceError",
+    "SwapShapeError",
+    "WarmStartCache",
+    "device_budgets",
+    "estimate_footprint",
+    "live_registries",
+    "params_fingerprint",
+    "plan_placement",
+    "warmstart_key",
+]

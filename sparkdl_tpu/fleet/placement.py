@@ -1,0 +1,246 @@
+"""HBM-aware model placement: pack N small models across devices from
+what the process has actually MEASURED, refuse what cannot fit.
+
+The fleet control plane's admission question — "where do this model's
+replicas go, and do they go at all?" — is answered from two measured
+sources, never a guess:
+
+* **device budgets** come from the live ``hbm.d<i>.bytes_in_use`` /
+  ``.bytes_limit`` gauges (obs/compile_log.py ``publish_hbm``), so a
+  device already carrying resident weights or infeed slabs offers less
+  room than an empty one. Backends whose devices report no memory
+  stats (CPU) degrade to a flat per-device budget
+  (``SPARKDL_TPU_FLEET_HBM_BUDGET``, default 1 GiB) — the planner
+  still plans, the budget's ``source`` says it was assumed.
+* **model footprints** come from CompileLog ``memory_analysis()``
+  bytes when the program has compiled under an armed log (argument +
+  output + temp + generated code — what the executable actually
+  reserves), else from params bytes + a signature-derived activation
+  estimate, with ``detail["source"]`` naming which rung answered.
+
+Packing is best-fit-decreasing: models sorted by footprint, each
+replica onto the candidate device with the LEAST remaining room that
+still fits (first-fit-decreasing's classic bin-packing refinement —
+big models claim empty devices, small models fill the gaps). A model
+whose replica cannot fit anywhere raises :class:`PlacementError` — a
+typed ADMISSION REFUSAL carrying the model name, its footprint, and
+the best available headroom, counted in ``fleet.placement_refusals``.
+The dry-run CLI (tools/fleet_pack.py) prints the same plan against
+live gauges without loading anything.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from sparkdl_tpu.obs import default_registry
+
+#: per-device budget assumed when devices report no memory stats
+#: (CPU backends) and no explicit budget is passed — env-overridable,
+#: documented in docs/SERVING.md
+DEFAULT_DEVICE_BUDGET = int(os.environ.get(
+    "SPARKDL_TPU_FLEET_HBM_BUDGET", str(1 << 30)))
+
+
+class PlacementError(Exception):
+    """Typed admission refusal: a model's replica cannot fit on any
+    device under the measured budgets. Carries what the refusal was
+    computed FROM, so the caller can shed the model, shrink it, or
+    grow the fleet — counted in ``fleet.placement_refusals``."""
+
+    def __init__(self, model: str, need_bytes: int,
+                 best_free_bytes: int, devices: int):
+        self.model = model
+        self.need_bytes = int(need_bytes)
+        self.best_free_bytes = int(best_free_bytes)
+        self.devices = int(devices)
+        super().__init__(
+            f"model {model!r} needs {need_bytes} bytes but the best "
+            f"of {devices} device(s) has {best_free_bytes} free — "
+            "admission refused (shrink the model, evict a tenant, or "
+            "add devices)")
+
+
+@dataclass(frozen=True)
+class ModelFootprint:
+    """One model's projected per-replica device bytes + how the
+    number was obtained (``detail["source"]``)."""
+    name: str
+    bytes: int
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class DeviceBudget:
+    """One device's capacity picture: ``free_bytes`` is what the
+    planner spends; ``source`` says whether it was measured from hbm
+    gauges or assumed."""
+    index: int
+    limit_bytes: int
+    free_bytes: int
+    source: str = "measured"
+
+
+@dataclass
+class PlacementPlan:
+    """The packing decision: replica assignments per model, projected
+    per-device load, and a per-model mode label (``per-core`` — a
+    replica on every device; ``dedicated`` — alone on its devices;
+    ``shared`` — packed beside other tenants)."""
+    assignments: Dict[str, List[int]]
+    projected_bytes: Dict[int, int]
+    mode: Dict[str, str]
+    budgets: List[DeviceBudget]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "assignments": {m: list(d)
+                            for m, d in sorted(self.assignments.items())},
+            "projected_bytes": {str(i): int(b) for i, b
+                                in sorted(self.projected_bytes.items())},
+            "mode": dict(sorted(self.mode.items())),
+            "devices": [
+                {"index": b.index, "limit_bytes": int(b.limit_bytes),
+                 "free_bytes": int(b.free_bytes), "source": b.source}
+                for b in self.budgets],
+        }
+
+
+def _signature_bytes(signature, batch_size: int) -> int:
+    import numpy as np
+    total = 0
+    for shape, dtype in signature.values():
+        rows = 1
+        for d in shape:
+            rows *= int(d) if d is not None else 1
+        total += batch_size * rows * np.dtype(dtype).itemsize
+    return total
+
+
+def estimate_footprint(model_fn, batch_size: int,
+                       name: Optional[str] = None) -> ModelFootprint:
+    """Per-replica device bytes for ``model_fn`` served at
+    ``batch_size``: params resident bytes plus workspace. Workspace
+    prefers the CompileLog's recorded ``memory_analysis()`` for the
+    model's jitted program (what the executable actually reserves);
+    without one it falls back to a signature-derived activation
+    estimate (input + output batch bytes, doubled for temps)."""
+    import jax
+    from sparkdl_tpu.obs.compile_log import compile_log
+
+    label = name or getattr(model_fn, "name", "model")
+    leaves = jax.tree_util.tree_leaves(model_fn.params)
+    params_bytes = sum(int(getattr(v, "nbytes", 0)) for v in leaves)
+    workspace = None
+    source = "signature"
+    for event in reversed(compile_log().events_for(
+            f"{model_fn.name}.jitted")):
+        mem = event.memory
+        if isinstance(mem, dict) and mem:
+            workspace = sum(int(v) for v in mem.values()
+                            if isinstance(v, (int, float)))
+            source = "memory_analysis"
+            break
+    if workspace is None:
+        io_bytes = _signature_bytes(model_fn.input_signature,
+                                    batch_size)
+        try:
+            io_bytes += _signature_bytes(
+                model_fn.output_signature(), batch_size)
+        except Exception:
+            # an unprobeable output signature halves the estimate
+            # rather than blocking admission planning
+            pass
+        workspace = 2 * io_bytes
+    return ModelFootprint(
+        name=label, bytes=params_bytes + workspace,
+        detail={"params_bytes": params_bytes,
+                "workspace_bytes": int(workspace), "source": source,
+                "batch_size": int(batch_size)})
+
+
+def device_budgets(default_budget: Optional[int] = None
+                   ) -> List[DeviceBudget]:
+    """The live per-device capacity picture: refresh the ``hbm.*``
+    gauges (``publish_hbm``) and read each device's
+    ``bytes_limit - bytes_in_use``. Devices that report nothing (CPU)
+    get the flat assumed budget so planning still works — marked
+    ``source="assumed"``."""
+    import jax
+    from sparkdl_tpu.obs.compile_log import publish_hbm
+
+    reg = default_registry()
+    publish_hbm(reg)
+    fallback = (int(default_budget) if default_budget is not None
+                else DEFAULT_DEVICE_BUDGET)
+    budgets: List[DeviceBudget] = []
+    for i, _d in enumerate(jax.devices()):
+        limit = reg.gauge(f"hbm.d{i}.bytes_limit").value
+        in_use = reg.gauge(f"hbm.d{i}.bytes_in_use").value
+        if limit and limit > 0:
+            budgets.append(DeviceBudget(
+                index=i, limit_bytes=int(limit),
+                free_bytes=max(0, int(limit - in_use)),
+                source="measured"))
+        else:
+            budgets.append(DeviceBudget(
+                index=i, limit_bytes=fallback, free_bytes=fallback,
+                source="assumed"))
+    return budgets
+
+
+def plan_placement(footprints: Sequence[ModelFootprint],
+                   replicas: Optional[Dict[str, int]] = None,
+                   budgets: Optional[Sequence[DeviceBudget]] = None
+                   ) -> PlacementPlan:
+    """Pack every model's replicas onto devices best-fit-decreasing
+    against the measured (or assumed) budgets. ``replicas`` maps model
+    name → replica count (default 1). Raises :class:`PlacementError`
+    (typed, counted) the moment any replica cannot fit — an admission
+    decision, made BEFORE any weight bytes move."""
+    budgets = list(budgets) if budgets is not None else device_budgets()
+    if not budgets:
+        raise PlacementError("(no devices)", 0, 0, 0)
+    replicas = dict(replicas or {})
+    free = {b.index: int(b.free_bytes) for b in budgets}
+    assignments: Dict[str, List[int]] = {}
+    tenants: Dict[int, int] = {b.index: 0 for b in budgets}
+    # big models first: they need the empty devices; small models then
+    # fill remaining gaps (best-fit keeps the gaps as large as
+    # possible for as long as possible)
+    for fp in sorted(footprints, key=lambda f: -int(f.bytes)):
+        want = max(1, int(replicas.get(fp.name, 1)))
+        placed: List[int] = []
+        for _r in range(want):
+            fits = [i for i, room in free.items()
+                    if room >= int(fp.bytes)]
+            if not fits:
+                default_registry().counter(
+                    "fleet.placement_refusals").add()
+                raise PlacementError(
+                    fp.name, int(fp.bytes),
+                    max(free.values(), default=0), len(budgets))
+            # least remaining room that still fits; replicas of one
+            # model spread across distinct devices first
+            fresh = [i for i in fits if i not in placed]
+            pick = min(fresh or fits, key=lambda i: free[i])
+            free[pick] -= int(fp.bytes)
+            tenants[pick] += 1
+            placed.append(pick)
+        assignments[fp.name] = placed
+    mode: Dict[str, str] = {}
+    for fp in footprints:
+        devs = assignments[fp.name]
+        if len(set(devs)) == len(budgets):
+            mode[fp.name] = "per-core"
+        elif all(tenants[d] == 1 for d in devs):
+            mode[fp.name] = "dedicated"
+        else:
+            mode[fp.name] = "shared"
+    projected = {b.index: int(b.free_bytes) - free[b.index]
+                 for b in budgets}
+    return PlacementPlan(assignments=assignments,
+                         projected_bytes=projected, mode=mode,
+                         budgets=budgets)

@@ -1,0 +1,189 @@
+"""Replica routing in front of ModelServer sessions: least-queue-depth
+pick, circuit-aware, with a drillable failover seam.
+
+A logical model deployed at N replicas is N independent
+``ModelSession``s (each its own runner, queue, circuit breaker, and
+per-replica ``serve.*`` metrics — the replica name IS the session
+name). The router is the one place that picks among them:
+
+* candidates whose circuit breaker is OPEN sort behind every closed
+  one — a persistently failing replica stops receiving traffic the
+  moment its breaker trips, and recovers through the breaker's own
+  half-open probes when the router has nothing better;
+* among equals, the replica with the SHALLOWEST request queue wins
+  (``ModelSession.queue_depth()``, one condition-guarded read) — the
+  join-shortest-queue policy, which bounds tail latency far better
+  than round-robin under skewed request sizes;
+* every pick runs through the ``fleet.route`` fault site
+  (resilience/faults.py): an injected transient fault FAILS OVER to
+  the next candidate (counted in ``fleet.route_failovers``) instead
+  of failing the request — the drill proves a replica loss is a
+  reroute, not a drop. Injected permanent faults propagate (the
+  fail-fast drill must stay fail-fast).
+
+Pickle discipline (H3): the live server handle and lock drop; the
+replica name map and route tallies travel — an unpickled router is an
+inspectable config, re-attached via :meth:`attach`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.resilience.errors import TransientError
+from sparkdl_tpu.resilience.faults import maybe_fail
+
+
+class FleetRouter:
+    """Least-depth, circuit-aware replica pick (module docstring)."""
+
+    # sparkdl-lint H3 contract: deploys add replicas while submitters
+    # route — the replica map holds self._lock
+    _lock_guards = ("_replicas",)
+
+    #: total pick attempts per submit before the router gives up and
+    #: raises the last fault: a transient pick failure means "try the
+    #: next candidate", and one pass over a small replica set is not a
+    #: budget — at drill rate 0.5 with 2 replicas a single pass drops
+    #: ~25% of requests, 8 draws drop ~0.4% (the zero-dropped-requests
+    #: drill sets the bar). Bounded so an all-replicas-down fleet
+    #: still fails fast and typed.
+    ROUTE_ATTEMPTS = 8
+
+    def __init__(self, server=None):
+        self._server = server
+        self._replicas: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+        self.routes = 0
+        self.failovers = 0
+        self.attempts = self.ROUTE_ATTEMPTS
+
+    def attach(self, server) -> None:
+        """Re-bind a live server (the unpickle path)."""
+        self._server = server
+
+    # -- membership ----------------------------------------------------------
+
+    def add_replica(self, logical: str, session_name: str) -> None:
+        with self._lock:
+            names = self._replicas.setdefault(logical, [])
+            if session_name not in names:
+                names.append(session_name)
+            total = sum(len(v) for v in self._replicas.values())
+        default_registry().gauge("fleet.replicas").set(total)
+
+    def replicas(self, logical: str) -> List[str]:
+        with self._lock:
+            return list(self._replicas.get(logical, []))
+
+    # -- the pick ------------------------------------------------------------
+
+    def _ordered(self, logical: str) -> List[str]:
+        """Candidates in routing order: circuit-closed before open,
+        shallowest queue first within each class."""
+        if self._server is None:
+            raise RuntimeError(
+                "router is not attached to a server (unpickled "
+                "config?) — call attach(server) first")
+        names = self.replicas(logical)
+        if not names:
+            raise ValueError(
+                f"no replicas registered for model {logical!r}; "
+                f"known: {sorted(self._replicas)}")
+        scored = []
+        for name in names:
+            sess = self._server.session(name)
+            scored.append((sess.circuit.state_code == 1,
+                           sess.queue_depth(), name))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return [name for _open, _depth, name in scored]
+
+    def pick(self, logical: str) -> str:
+        """The replica the next submit would route to (exposed for
+        tests and the dry-run CLI; does not run the fault seam)."""
+        return self._ordered(logical)[0]
+
+    def submit(self, inputs, deadline: Optional[float] = None,
+               model: Optional[str] = None, priority: int = 0):
+        """Route one request to the best replica of ``model`` and
+        submit it there. A ``fleet.route`` transient fault on a
+        candidate fails over to the next (counted), cycling the
+        candidate order up to ``attempts`` total draws — a sane drill
+        rate never drops a request; an all-candidates-down fleet
+        exhausts the budget and re-raises the last fault, fast and
+        typed."""
+        if model is None:
+            with self._lock:
+                if len(self._replicas) != 1:
+                    raise ValueError(
+                        f"multiple models routed "
+                        f"({sorted(self._replicas)}); pass model=")
+                model = next(iter(self._replicas))
+        last_fault: Optional[BaseException] = None
+        drawn = 0
+        while drawn < max(1, int(self.attempts)):
+            for name in self._ordered(model):
+                if drawn >= max(1, int(self.attempts)):
+                    break
+                drawn += 1
+                try:
+                    # the failover drill's seam
+                    # (resilience/faults.py): transient = this
+                    # replica is briefly unreachable, take the next;
+                    # permanent propagates (fail-fast stays fail-fast)
+                    maybe_fail("fleet.route")
+                except TransientError as e:
+                    self.failovers += 1
+                    default_registry().counter(
+                        "fleet.route_failovers").add()
+                    last_fault = e
+                    continue
+                self.routes += 1
+                default_registry().counter("fleet.routes").add()
+                return self._server.submit(
+                    inputs, deadline=deadline, model=name,
+                    priority=priority)
+        assert last_fault is not None
+        raise last_fault
+
+    # -- readout -------------------------------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        """ONE shape shared by ``/statusz``, flight bundles, and
+        bench's ``fleet`` block: the replica map plus live per-replica
+        depth/circuit when a server is attached."""
+        with self._lock:
+            replica_map = {k: list(v)
+                           for k, v in sorted(self._replicas.items())}
+        out: Dict[str, Any] = {
+            "models": {}, "routes": self.routes,
+            "failovers": self.failovers}
+        for logical, names in replica_map.items():
+            entries = []
+            for name in names:
+                entry: Dict[str, Any] = {"replica": name}
+                if self._server is not None:
+                    try:
+                        sess = self._server.session(name)
+                        entry["depth"] = sess.queue_depth()
+                        entry["circuit"] = sess.circuit.state_code
+                    # sparkdl-lint: allow[H12] -- readout only: a replica whose session is mid-teardown renders depth=None rather than failing the whole statusz page
+                    except Exception:
+                        entry["depth"] = None
+                entries.append(entry)
+            out["models"][logical] = entries
+        return out
+
+    # -- pickle discipline (StageMetrics precedent) --------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        state["_server"] = None     # live handle never ships
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()

@@ -72,6 +72,11 @@ class ModelFunction:
         # the host object it was built from so reassigning .params
         # invalidates it
         self._params_cache: Dict[Any, Tuple[Any, Any]] = {}
+        # the put callable behind each placement key, recorded so the
+        # fleet hot-swap (stage_params) can re-place NEW params onto
+        # exactly the placements this process serves — including a
+        # device-pinned put the registry seeded for a packed replica
+        self._puts: Dict[Any, Callable] = {}
         # known output signature (set by deserialize, which reads it
         # from the exported avals); when present, output_signature()
         # returns it instead of eval_shape-probing — a fixed-batch
@@ -213,6 +218,7 @@ class ModelFunction:
     # -- execution ----------------------------------------------------------
 
     def _cached_device_params(self, key, put: Callable):
+        self._puts[key] = put
         entry = self._params_cache.get(key)
         if entry is None or entry[0] is not self.params:
             # params changed: purge EVERY stale placement, not just this
@@ -316,6 +322,71 @@ class ModelFunction:
                 config={"donate_inputs": donate_inputs},
                 arg_names=("params", "inputs"))
         return self._jit_cache[key]
+
+    # -- hot swap (the fleet registry's two-phase weight flip) --------------
+
+    def stage_params(self, new_params) -> Dict[Any, Any]:
+        """Place ``new_params`` on device for every placement this
+        function currently serves, WITHOUT making them live — the
+        hot-swap's staging half (sparkdl_tpu/fleet/registry.py). The
+        slow transfers happen here, off the dispatch path; the commit
+        (:meth:`commit_params`) is then a pointer flip under the serve
+        session's swap gate. Returns the staged placements to hand to
+        :meth:`commit_params` — or to drop, which un-stages them (the
+        rollback path frees the device copies by releasing the only
+        reference)."""
+        if self.backend != "jax":
+            raise ValueError(
+                f"cannot stage params for backend {self.backend!r}")
+        puts = dict(self._puts) or {"default": jax.device_put}
+        staged: Dict[Any, Any] = {}
+        log = compile_log()
+        for key, put in puts.items():
+            t0 = time.perf_counter()
+            staged[key] = put(new_params)
+            if log.armed:
+                leaves = jax.tree_util.tree_leaves(new_params)
+                log.record_transfer(
+                    name=f"{self.name}.stage_params", kind="device_put",
+                    wall_s=time.perf_counter() - t0,
+                    detail={"placement": (key if isinstance(key, str)
+                                          else key[0]),
+                            "leaves": len(leaves),
+                            "bytes": sum(int(getattr(v, "nbytes", 0))
+                                         for v in leaves)})
+        return staged
+
+    def commit_params(self, new_params, staged: Dict[Any, Any]) -> None:
+        """Atomically flip to pre-staged params: ``.params`` and every
+        device placement change by assignment only — no transfer, no
+        retrace (the jit cache is untouched; only argument VALUES
+        change, and the compiled shapes were validated by the caller).
+        The caller holds the serve session's swap gate so the flip
+        lands BETWEEN dispatches, never inside one."""
+        self.params = new_params
+        self._params_cache = {k: (new_params, v)
+                              for k, v in staged.items()}
+
+    def install_aot(self, compiled: Callable, *, wall_s: float = 0.0,
+                    blob_bytes: Optional[int] = None) -> Callable:
+        """Install a pre-compiled executable behind :meth:`jitted` —
+        the executable-import half of the persisted warm-start seam
+        (fleet/warmstart.py). The wrapper is the CompileLog's
+        :class:`_AotProgram`: dispatches route through it like any
+        instrumented program, but nothing it does can ever record a
+        compile, because this process only LOADED the program. Covers
+        the undonated program only (the serve dispatch path); the
+        donated ring variant still jits lazily on first engagement."""
+        if self.backend != "jax":
+            raise ValueError(
+                f"cannot install an executable for backend "
+                f"{self.backend!r}")
+        wrapper = compile_log().instrument_aot(
+            compiled, name=f"{self.name}.jitted", kind="aot",
+            wall_s=wall_s,
+            detail={"bytes": blob_bytes} if blob_bytes else None)
+        self._jit_cache[("jit", False)] = wrapper
+        return wrapper
 
     def __call__(self, inputs, params: Any = "__own__"):
         if self.backend == "host":
@@ -449,6 +520,9 @@ class ModelFunction:
         state = self.__dict__.copy()
         state["_jit_cache"] = {}
         state["_params_cache"] = {}
+        # put callables may close over meshes / pinned devices —
+        # process-local, like the placements they produce
+        state["_puts"] = {}
         return state
 
     def __repr__(self) -> str:

@@ -386,6 +386,45 @@ class _LoggedJit:
                 f"seen={len(self._seen)}, steady={self.steady})")
 
 
+class _AotProgram:
+    """The persisted-warm-start seam's wrapper (fleet/warmstart.py): a
+    pre-compiled executable DESERIALIZED into this process, installed
+    where ``jitted()`` would cache a :class:`_LoggedJit`. Dispatches
+    pass straight through; there is no signature table and no compile
+    detection because this program CANNOT compile — it was built in
+    another process, and an unseen shape fails loudly inside the
+    executable instead of silently retracing. ``mark_steady`` /
+    ``last_flops`` keep the warmup and ledger bookkeeping uniform with
+    instrumented jits."""
+
+    _lock_guards = ()
+
+    def __init__(self, fn: Callable, name: str, kind: str,
+                 log: "CompileLog"):
+        self._fn = fn
+        self._name = name
+        self._kind = kind
+        self._log = log
+        self.steady = False
+        #: no cost_analysis travels with a deserialized executable —
+        #: the ledger's compute feed degrades to None, never a guess
+        self.last_flops: Optional[float] = None
+
+    @property
+    def __wrapped__(self) -> Callable:
+        return self._fn
+
+    def mark_steady(self) -> None:
+        self.steady = True
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return (f"_AotProgram({self._name}, kind={self._kind}, "
+                f"steady={self.steady})")
+
+
 # -- the log ------------------------------------------------------------------
 
 class CompileLog:
@@ -451,6 +490,24 @@ class CompileLog:
         cache it exactly where they cached the raw jit."""
         return _LoggedJit(fn, name, kind, config, arg_names, self)
 
+    def instrument_aot(self, fn: Callable, name: str, kind: str = "aot",
+                       wall_s: float = 0.0,
+                       detail: Optional[dict] = None) -> "_AotProgram":
+        """The executable-import half of the warm-start seam
+        (fleet/warmstart.py → ModelFunction.install_aot): wrap a
+        DESERIALIZED pre-compiled executable so dispatches route
+        through the log's bookkeeping without ever being able to
+        record a compile. The load itself lands as an armed-gated
+        ``aot_load`` transfer event under ``<name>.aot_load`` — never
+        under ``<name>`` itself, because ``compiles_of(<name>)`` is
+        the scale-out drill's zero-compile proof and a load must not
+        pollute it."""
+        if self.armed:
+            self.record_transfer(name=f"{name}.aot_load",
+                                 kind="aot_load", wall_s=wall_s,
+                                 detail=detail or {})
+        return _AotProgram(fn, name, kind, self)
+
     def mark_model_steady(self, model_fn, reason: str = "warmup") -> int:
         """Mark every instrumented program cached on ``model_fn``
         steady (the ``warmup_runner`` / ``RechunkTarget.prewarm``
@@ -458,7 +515,7 @@ class CompileLog:
         unexpected retrace. Returns how many programs were marked."""
         marked = 0
         for fn in getattr(model_fn, "_jit_cache", {}).values():
-            if isinstance(fn, _LoggedJit):
+            if isinstance(fn, (_LoggedJit, _AotProgram)):
                 fn.mark_steady()
                 marked += 1
                 with self._lock:

@@ -401,6 +401,11 @@ class TelemetryServer:
             # (sparkdl_tpu/inputsvc, docs/DATA_SERVICE.md) — same
             # shape as the flight bundle's section
             "inputsvc": _flight.inputsvc_state(),
+            # the fleet control plane's deployments/swap/warm-start
+            # picture (sparkdl_tpu/fleet, docs/SERVING.md "Fleet
+            # control plane") — same shape as the flight bundle's
+            # section, so a curl and a postmortem never disagree
+            "fleet": _flight.fleet_state(),
             # the cross-process telemetry plane's per-worker view
             # (obs/remote.py) — same shape as the flight bundle's
             # workers[] section, so a curl and a postmortem never

@@ -272,6 +272,26 @@ def inputsvc_state() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def fleet_state() -> dict:
+    """The fleet control plane's live state — every
+    :class:`~sparkdl_tpu.fleet.registry.ModelRegistry` in this process
+    (deployed models/versions, swap tallies, router replica map,
+    warm-start cache hits/corruptions; sparkdl_tpu/fleet,
+    docs/SERVING.md "Fleet control plane") — ONE shape shared by the
+    flight bundle, ``/statusz``, and bench's ``fleet`` block. A
+    process that never imported the fleet package renders
+    ``registries: []``; degrades like every probe."""
+    try:
+        import sys
+        mod = sys.modules.get("sparkdl_tpu.fleet.registry")
+        if mod is None:     # fleet never imported: nothing to report
+            return {"registries": []}
+        return {"registries": [r.state()
+                               for r in mod.live_registries()]}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def workers_state() -> list:
     """The per-worker telemetry plane's ``workers[]`` section — agent
     state, last spans, counter snapshot, fault config for every
@@ -412,6 +432,7 @@ class FlightRecorder:
             "ledger": ledger_state(),
             "pipeline": pipeline_state(),
             "inputsvc": inputsvc_state(),
+            "fleet": fleet_state(),
             "workers": workers_state(),
             "slo": _slo_state(),
             "requests": _request_state(),

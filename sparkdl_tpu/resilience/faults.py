@@ -23,6 +23,10 @@ site                      where it fires
 ``pipeline.worker_death``   kills a live pipeline worker process outright
 ``inputsvc.rpc``          the decode fleet's per-fragment RPC (client side)
 ``snapshot.read``         a snapshot chunk's warm read (corrupt/missing drill)
+``fleet.swap``            the registry's hot-swap flip, after staging,
+                          before commit (mid-swap rollback drill)
+``fleet.route``           the fleet router's per-replica pick (failover
+                          drill)
 ========================  ==================================================
 
 The two ``pipeline.worker_*`` sites fire inside pool worker
@@ -89,6 +93,8 @@ SITES = (
     "pipeline.worker_death",
     "inputsvc.rpc",
     "snapshot.read",
+    "fleet.swap",
+    "fleet.route",
 )
 
 _KINDS = ("transient", "permanent")

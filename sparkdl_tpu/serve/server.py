@@ -140,8 +140,21 @@ class ModelSession:
         self._staging = PadStaging()
         self._worker: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # the fleet hot-swap's serialization point (fleet/registry.py):
+        # the dispatcher holds it across each runner call, the registry
+        # holds it for the params pointer flip — so a swap lands
+        # BETWEEN dispatches, never inside one. Uncontended cost is one
+        # lock acquire per micro-batch, not per row.
+        self._swap_gate = threading.Lock()
 
     # -- introspection -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Requests waiting in this session's bounded queue right now
+        — the fleet router's least-depth routing signal
+        (fleet/router.py). One condition-guarded read; safe from any
+        thread."""
+        return self._queue.depth()
 
     @property
     def collective(self) -> bool:
@@ -563,7 +576,10 @@ class ModelSession:
             if getattr(self.runner, "supports_phases", False):
                 phases = ChunkPhases()
         t2 = time.perf_counter() if track else 0.0
-        with span("dispatch", lane="serve", **attrs):
+        # the swap gate: a registry weight flip (fleet/registry.py)
+        # waits for this dispatch to finish and lands before the next
+        # one starts — the zero-downtime hot-swap's atomicity seam
+        with self._swap_gate, span("dispatch", lane="serve", **attrs):
             if phases is not None:
                 out = self.runner.run(inputs, phases=phases)
             else:
@@ -672,6 +688,7 @@ class ModelSession:
         del state["_lock"]
         del state["_worker"]
         del state["_staging"]
+        del state["_swap_gate"]
         return state
 
     def __setstate__(self, state):
@@ -679,6 +696,7 @@ class ModelSession:
         self._lock = threading.Lock()
         self._worker = None     # restarts lazily on first submit
         self._staging = PadStaging()
+        self._swap_gate = threading.Lock()
 
 
 class ModelServer:

@@ -1,0 +1,694 @@
+"""Fleet control plane tests (sparkdl_tpu/fleet/, docs/SERVING.md
+"Fleet control plane").
+
+The contracts pinned here, in ISSUE order:
+
+* **hot-swap** — new same-shape weights stage off the dispatch path,
+  flip atomically under the session swap gate, and the post-flip
+  probe proves ZERO compiles and zero ``unexpected_retraces``
+  (the PR-13 steady-state invariant applied to a weight update);
+  concurrent submitters never drop a request and only ever see
+  old-weights or new-weights outputs, never garbage;
+* **typed swap failure** — a shape-changing swap refuses
+  (``SwapShapeError``, counted) before any bytes move; a mid-swap
+  injected fault (``fleet.swap`` site) rolls every flipped replica
+  back — the old weights keep serving;
+* **warm-start** — the persisted AOT cache replays a compiled
+  executable into a fresh model with ``compiles_of == 0``, and the
+  FULL invalidation matrix lands cold, never stale: changed
+  signature / batch / params shape / backend → different key (miss);
+  corrupt or truncated blob → counted corruption, blob deleted, cold
+  fallback; mismatched manifest → counted invalidation + wipe;
+* **placement** — best-fit-decreasing packing against measured (or
+  assumed, on CPU) budgets; replicas spread; refusal is typed AND
+  counted;
+* **routing** — least-depth circuit-aware pick; an injected
+  ``fleet.route`` transient fails over (counted), never drops;
+  permanent faults propagate;
+* **pickle (H3)** — registry and router drop the live server and
+  locks, carry the deployment record, and re-attach;
+* **observability** — ``fleet_state()`` is one shape across
+  ``/statusz`` and flight bundles; the ``FleetTarget`` autotune knob
+  grows replicas only behind the serve-lane ledger gate.
+"""
+
+import json
+import os
+import pickle
+import threading
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from sparkdl_tpu import resilience
+from sparkdl_tpu.fleet import (
+    DeviceBudget,
+    FleetRouter,
+    ModelFootprint,
+    ModelRegistry,
+    PlacementError,
+    SwapError,
+    SwapShapeError,
+    WarmStartCache,
+    device_budgets,
+    estimate_footprint,
+    params_fingerprint,
+    plan_placement,
+    warmstart_key,
+)
+from sparkdl_tpu.fleet import warmstart as warmstart_mod
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.obs.compile_log import compile_log
+from sparkdl_tpu.resilience import faults as rfaults
+from sparkdl_tpu.serve import (ModelServer, ServeConfig,
+                               ServerOverloaded)
+
+DIM = 4
+
+
+def _apply(params, inputs):
+    return {"y": inputs["x"] @ params["w"]}
+
+
+def _mf(name="m", scale=2.0, dim=DIM):
+    params = {"w": (scale * np.eye(dim)).astype(np.float32)}
+    return ModelFunction(_apply, params,
+                         {"x": ((dim,), np.float32)}, ["y"],
+                         name=name)
+
+
+def _x(rows=8, dim=DIM):
+    return np.ones((rows, dim), np.float32)
+
+
+def _counter(name):
+    return default_registry().counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    rfaults.disarm()
+    yield
+    rfaults.disarm()
+
+
+@pytest.fixture()
+def global_log():
+    """The process-wide compile log, armed for the test and restored
+    (the fleet layer routes through the singleton by construction)."""
+    log = compile_log()
+    saved = log._override
+    log.arm()
+    yield log
+    log._override = saved
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    """A live server + cache-backed registry, torn down after."""
+    server = ModelServer(ServeConfig(max_wait_s=0.0))
+    cache = WarmStartCache(str(tmp_path / "aotcache"))
+    registry = ModelRegistry(server, warmstart=cache)
+    yield registry, server, cache
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# placement
+
+
+class TestPlacement:
+    def test_budgets_on_cpu_are_assumed_flat(self, monkeypatch):
+        # a FRESH registry: earlier tests in the suite may have
+        # published synthetic hbm.d*.bytes_limit gauges into the
+        # process singleton, which would flip these CPU devices to
+        # "measured"
+        from sparkdl_tpu.fleet import placement as placement_mod
+        from sparkdl_tpu.obs.registry import MetricsRegistry
+        fresh = MetricsRegistry()
+        monkeypatch.setattr(placement_mod, "default_registry",
+                            lambda: fresh)
+        budgets = device_budgets(default_budget=1000)
+        assert len(budgets) == 8   # conftest forces 8 virtual devices
+        assert all(b.source == "assumed" for b in budgets)
+        assert all(b.free_bytes == 1000 for b in budgets)
+
+    def test_pack_spreads_replicas_and_labels_modes(self):
+        budgets = [DeviceBudget(i, 1000, 1000, "assumed")
+                   for i in range(3)]
+        plan = plan_placement(
+            [ModelFootprint("big", 600),
+             ModelFootprint("small", 200)],
+            replicas={"big": 2, "small": 1}, budgets=budgets)
+        # two big replicas land on DISTINCT devices
+        assert len(set(plan.assignments["big"])) == 2
+        # best-fit: small fills a gap beside big -> both shared
+        assert plan.mode["big"] == "shared"
+        assert set(plan.assignments["small"]) <= set(
+            plan.assignments["big"])
+        d = plan.as_dict()
+        assert d["assignments"]["big"] == plan.assignments["big"]
+        assert len(d["devices"]) == 3
+
+    def test_dedicated_and_per_core_modes(self):
+        budgets = [DeviceBudget(i, 1000, 1000, "assumed")
+                   for i in range(2)]
+        plan = plan_placement(
+            [ModelFootprint("a", 600), ModelFootprint("b", 600)],
+            budgets=budgets)
+        assert plan.mode == {"a": "dedicated", "b": "dedicated"}
+        plan2 = plan_placement([ModelFootprint("a", 400)],
+                               replicas={"a": 2}, budgets=budgets)
+        assert plan2.mode["a"] == "per-core"
+
+    def test_refusal_is_typed_and_counted(self):
+        before = _counter("fleet.placement_refusals")
+        budgets = [DeviceBudget(0, 100, 100, "assumed")]
+        with pytest.raises(PlacementError) as ei:
+            plan_placement([ModelFootprint("huge", 500)],
+                           budgets=budgets)
+        assert ei.value.model == "huge"
+        assert ei.value.need_bytes == 500
+        assert ei.value.best_free_bytes == 100
+        assert _counter("fleet.placement_refusals") == before + 1
+
+    def test_footprint_signature_fallback(self):
+        mf = _mf("fp_probe")
+        fp = estimate_footprint(mf, batch_size=16)
+        assert fp.detail["source"] == "signature"
+        # params: DIM x DIM float32
+        assert fp.detail["params_bytes"] == DIM * DIM * 4
+        # workspace: 2 * (input + output) batch bytes
+        assert fp.detail["workspace_bytes"] == 2 * 2 * 16 * DIM * 4
+        assert fp.bytes == (fp.detail["params_bytes"]
+                            + fp.detail["workspace_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# warm-start: persisted AOT, full invalidation matrix
+
+
+class TestWarmStart:
+    def test_disabled_without_root(self, monkeypatch):
+        monkeypatch.delenv("SPARKDL_TPU_FLEET_CACHE", raising=False)
+        cache = WarmStartCache()
+        assert not cache.enabled
+        mf = _mf("nocache")
+        assert cache.save(mf, 8) is False
+        assert cache.load(mf, 8) is False
+        assert cache.state()["entries"] == 0
+
+    def test_root_from_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("SPARKDL_TPU_FLEET_CACHE",
+                           str(tmp_path / "envcache"))
+        assert WarmStartCache().enabled
+
+    def test_hit_installs_executable_with_zero_compiles(
+            self, tmp_path, global_log):
+        cache = WarmStartCache(str(tmp_path))
+        assert cache.save(_mf("ws_writer", 3.0), 8) is True
+        fresh = _mf("ws_reader", 3.0)
+        assert cache.load(fresh, 8) is True
+        y = fresh.jitted()(fresh.device_params(), {"x": _x(8)})["y"]
+        np.testing.assert_allclose(np.asarray(y), 3.0 * _x(8))
+        # THE zero-compile proof: the jitted program came off disk
+        assert global_log.compiles_of("ws_reader.jitted") == 0
+        # ... and the load is visible as an aot_load event, which
+        # never masquerades as a compile of the jitted program
+        assert global_log.compiles_of("ws_reader.jitted.aot_load") == 1
+        assert cache.state()["hits"] == 1
+
+    def test_invalidation_matrix_lands_cold_never_stale(
+            self, tmp_path):
+        """Changed batch / signature / params shape / backend each
+        land in a DIFFERENT content address — a miss, not a stale
+        hit (and never a corruption)."""
+        cache = WarmStartCache(str(tmp_path))
+        base = _mf("matrix", 2.0)
+        assert cache.save(base, 8)
+        key0 = warmstart_key(base, 8)
+
+        # batch change
+        assert warmstart_key(base, 16) != key0
+        assert cache.load(_mf("matrix"), 16) is False
+
+        # input signature change (wider rows)
+        wider = _mf("matrix", dim=DIM * 2)
+        assert warmstart_key(wider, 8) != key0
+        assert cache.load(wider, 8) is False
+
+        # params SHAPE change at same signature (extra bias leaf)
+        rebiased = _mf("matrix")
+        rebiased.params = dict(rebiased.params,
+                               b=np.zeros((DIM,), np.float32))
+        assert warmstart_key(rebiased, 8) != key0
+        assert cache.load(rebiased, 8) is False
+
+        # backend/ABI change
+        real_backend = warmstart_mod.backend_key
+        try:
+            warmstart_mod.backend_key = lambda: "tpu|v5e|4|jax9.9.9"
+            assert warmstart_key(_mf("matrix"), 8) != key0
+            assert cache.load(_mf("matrix"), 8) is False
+        finally:
+            warmstart_mod.backend_key = real_backend
+
+        assert cache.misses == 4
+        assert cache.corruptions == 0
+        # the original entry is still warm
+        assert cache.load(_mf("matrix"), 8) is True
+
+    def test_params_values_do_not_invalidate(self, tmp_path):
+        """The hot-swap contract: same shapes + new values must REUSE
+        the executable (values are excluded from the key)."""
+        cache = WarmStartCache(str(tmp_path))
+        assert cache.save(_mf("vals", 2.0), 8)
+        assert cache.load(_mf("vals", 7.5), 8) is True
+
+    @pytest.mark.parametrize("damage", ["flip", "truncate", "magic"])
+    def test_corrupt_blob_fails_closed(self, tmp_path, damage):
+        before = _counter("fleet.warmstart_corruptions")
+        cache = WarmStartCache(str(tmp_path))
+        mf = _mf("corrupt", 2.0)
+        assert cache.save(mf, 8)
+        blob = os.path.join(str(tmp_path), warmstart_key(mf, 8),
+                            warmstart_mod.BLOB_NAME)
+        raw = open(blob, "rb").read()
+        if damage == "flip":
+            mid = len(raw) // 2
+            raw = raw[:mid] + bytes([raw[mid] ^ 0xFF]) + raw[mid + 1:]
+        elif damage == "truncate":
+            raw = raw[:len(raw) // 2]
+        else:
+            raw = b"NOPE" + raw[4:]
+        with open(blob, "wb") as f:
+            f.write(raw)
+        fresh = _mf("corrupt_reader", 2.0)
+        assert cache.load(fresh, 8) is False      # cold, not stale
+        assert cache.corruptions == 1
+        assert _counter("fleet.warmstart_corruptions") == before + 1
+        assert not os.path.exists(blob)           # bad blob dropped
+        # the store self-heals: next save + load are warm again
+        assert cache.save(mf, 8)
+        assert cache.load(_mf("corrupt_again", 2.0), 8) is True
+
+    def test_manifest_mismatch_wipes_and_counts(self, tmp_path):
+        cache = WarmStartCache(str(tmp_path))
+        mf = _mf("manifest", 2.0)
+        assert cache.save(mf, 8)
+        directory = os.path.join(str(tmp_path), warmstart_key(mf, 8))
+        mpath = os.path.join(directory, warmstart_mod.MANIFEST_NAME)
+        doc = json.load(open(mpath))
+        doc["backend"] = "somewhere-else"
+        with open(mpath, "w") as f:
+            json.dump(doc, f)
+        before = _counter("fleet.warmstart_invalidations")
+        assert cache.load(_mf("manifest", 2.0), 8) is False
+        assert cache.invalidations == 1
+        assert _counter("fleet.warmstart_invalidations") == before + 1
+        # the wipe took the blob: the entry rebuilds from a save
+        assert not os.path.exists(
+            os.path.join(directory, warmstart_mod.BLOB_NAME))
+
+    def test_cache_pickles_as_config(self, tmp_path):
+        cache = WarmStartCache(str(tmp_path))
+        cache.save(_mf("pkl", 2.0), 8)
+        clone = pickle.loads(pickle.dumps(cache))
+        assert clone.root == cache.root
+        assert clone.writes == 1
+
+
+# ---------------------------------------------------------------------------
+# registry: deploy, hot-swap, rollback
+
+
+class TestRegistry:
+    def test_deploy_and_serve(self, rig):
+        registry, server, cache = rig
+        entry = registry.deploy("m", _mf("m", 2.0), batch_size=8,
+                                replicas=2)
+        assert entry.version == 1
+        assert entry.replicas == ["m@r0", "m@r1"]
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        np.testing.assert_allclose(np.asarray(y), 2.0 * _x())
+        st = registry.state()
+        assert st["models"]["m"]["version"] == 1
+        assert st["models"]["m"]["replicas"] == ["m@r0", "m@r1"]
+        assert len(st["models"]["m"]["fingerprint"]) == 32
+
+    def test_duplicate_deploy_refused(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m"), batch_size=8)
+        with pytest.raises(ValueError, match="already deployed"):
+            registry.deploy("m", _mf("m"), batch_size=8)
+
+    def test_hot_swap_under_concurrent_load(self, rig, global_log):
+        """THE zero-downtime drill: submitters hammer the fleet while
+        the weights flip. Every request resolves; every output is
+        old-weights or new-weights, never a mixture; the steady
+        programs record zero compiles and zero unexpected
+        retraces."""
+        registry, server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        retraces0 = global_log.unexpected_retraces
+        compiles0 = (global_log.compiles_of("m@r0.jitted")
+                     + global_log.compiles_of("m@r1.jitted"))
+        results, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def fire():
+            while not stop.is_set():
+                try:
+                    f = registry.submit({"x": _x()}, model="m")
+                except ServerOverloaded:
+                    time.sleep(0.001)   # admission backpressure —
+                    continue            # typed, never a dropped future
+                with lock:
+                    results.append(f)
+
+        workers = [threading.Thread(target=fire) for _ in range(4)]
+        for w in workers:
+            w.start()
+        try:
+            version = registry.swap_weights(
+                "m", {"w": (3.0 * np.eye(DIM)).astype(np.float32)},
+                note="under load")
+        finally:
+            stop.set()
+            for w in workers:
+                w.join()
+        assert version.version == 2
+        assert len(results) > 0
+        seen = set()
+        for f in results:            # ZERO dropped requests
+            y = np.asarray(f.result()["y"])
+            v = float(y[0, 0])
+            assert v in (2.0, 3.0), v
+            np.testing.assert_allclose(y, v * _x())   # never mixed
+            seen.add(v)
+        # after the swap the fleet serves ONLY new weights
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 3.0
+        assert global_log.unexpected_retraces == retraces0
+        assert (global_log.compiles_of("m@r0.jitted")
+                + global_log.compiles_of("m@r1.jitted")) == compiles0
+        assert registry.state()["last_swap_ms"] is not None
+
+    def test_swap_shape_refusal_is_typed_and_counted(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        before = _counter("fleet.swap_failures")
+        with pytest.raises(SwapShapeError, match="leaf 0 changed"):
+            registry.swap_weights(
+                "m", {"w": np.eye(DIM + 1, dtype=np.float32)})
+        with pytest.raises(SwapShapeError, match="structure changed"):
+            registry.swap_weights(
+                "m", {"w": np.eye(DIM, dtype=np.float32),
+                      "extra": np.zeros(2, np.float32)})
+        assert _counter("fleet.swap_failures") == before + 2
+        # nothing moved: still version 1, still old weights
+        assert registry.entry("m").version == 1
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 2.0
+
+    def test_mid_swap_fault_rolls_back_old_weights_serve(self, rig):
+        """The fleet.swap drill: a fault between staging and commit
+        is a typed, counted failure — and the fleet still answers
+        with the OLD weights afterwards."""
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        fails0 = _counter("fleet.swap_failures")
+        resilience.inject("fleet.swap", kind="transient", rate=1.0)
+        with pytest.raises(SwapError):
+            registry.swap_weights(
+                "m", {"w": (9.0 * np.eye(DIM)).astype(np.float32)})
+        rfaults.disarm()
+        assert _counter("fleet.swap_failures") == fails0 + 1
+        assert registry.entry("m").version == 1
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 2.0   # old weights live
+        # the seam heals: the same swap succeeds disarmed
+        assert registry.swap_weights(
+            "m", {"w": (9.0 * np.eye(DIM)).astype(np.float32)}
+        ).version == 2
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 9.0
+
+    def test_swap_history_is_versioned(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        registry.swap_weights(
+            "m", {"w": (3.0 * np.eye(DIM)).astype(np.float32)})
+        registry.swap_weights(
+            "m", {"w": (4.0 * np.eye(DIM)).astype(np.float32)})
+        entry = registry.entry("m")
+        assert entry.version == 3
+        fps = [v.fingerprint for v in entry.versions]
+        assert len(set(fps)) == 3
+        assert fps[-1] == params_fingerprint(
+            {"w": (4.0 * np.eye(DIM)).astype(np.float32)})
+
+    def test_scale_warm_starts_from_deploys_blob(self, rig,
+                                                 global_log):
+        registry, _server, cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        assert cache.writes == 1      # first deployer persisted
+        assert registry.scale("m", 3) == 3
+        assert registry.entry("m").warm_hits == 2
+        # the scaled-out replicas compiled NOTHING
+        assert global_log.compiles_of("m@r1.jitted") == 0
+        assert global_log.compiles_of("m@r2.jitted") == 0
+        y = registry.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# router
+
+
+class _FakeSession:
+    def __init__(self, depth, open_=False):
+        class _C:
+            state_code = 1 if open_ else 0
+        self.circuit = _C()
+        self._depth = depth
+
+    def queue_depth(self):
+        return self._depth
+
+
+class _FakeServer:
+    def __init__(self, sessions):
+        self._sessions = sessions
+        self.submitted = []
+
+    def session(self, name):
+        return self._sessions[name]
+
+    def submit(self, inputs, deadline=None, model=None, priority=0):
+        self.submitted.append(model)
+        return ("future", model)
+
+
+class TestRouter:
+    def test_least_depth_pick(self):
+        server = _FakeServer({"m@r0": _FakeSession(5),
+                              "m@r1": _FakeSession(1)})
+        router = FleetRouter(server)
+        router.add_replica("m", "m@r0")
+        router.add_replica("m", "m@r1")
+        assert router.pick("m") == "m@r1"
+
+    def test_open_circuit_sorts_behind_closed(self):
+        server = _FakeServer({"m@r0": _FakeSession(5),
+                              "m@r1": _FakeSession(0, open_=True)})
+        router = FleetRouter(server)
+        router.add_replica("m", "m@r0")
+        router.add_replica("m", "m@r1")
+        # deeper queue but CLOSED breaker beats empty-but-open
+        assert router.pick("m") == "m@r0"
+
+    def test_unknown_model_and_unattached_are_typed(self):
+        router = FleetRouter()
+        with pytest.raises(RuntimeError, match="not attached"):
+            router.pick("m")
+        router.attach(_FakeServer({}))
+        with pytest.raises(ValueError, match="no replicas"):
+            router.pick("m")
+
+    def test_failover_drill_zero_dropped(self, rig):
+        """fleet.route at rate 0.5: every request resolves through
+        failover — counted, never dropped."""
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        fails0 = _counter("fleet.route_failovers")
+        resilience.inject("fleet.route", kind="transient", rate=0.5,
+                          seed=7)
+        futures = [registry.submit({"x": _x()}, model="m")
+                   for _ in range(20)]
+        rfaults.disarm()
+        for f in futures:            # ZERO dropped
+            y = f.result()["y"]
+            assert float(np.asarray(y)[0, 0]) == 2.0
+        assert _counter("fleet.route_failovers") > fails0
+
+    def test_all_replicas_down_exhausts_typed(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        resilience.inject("fleet.route", kind="transient", rate=1.0)
+        from sparkdl_tpu.resilience.faults import InjectedFault
+        with pytest.raises(InjectedFault):
+            registry.submit({"x": _x()}, model="m")
+
+    def test_permanent_fault_propagates(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        resilience.inject("fleet.route", kind="permanent", rate=1.0)
+        from sparkdl_tpu.resilience.faults import (
+            InjectedPermanentFault)
+        with pytest.raises(InjectedPermanentFault):
+            registry.submit({"x": _x()}, model="m")
+
+
+# ---------------------------------------------------------------------------
+# pickle discipline (H3)
+
+
+class TestPickle:
+    def test_registry_pickles_as_deployment_record(self, rig):
+        registry, server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        registry.swap_weights(
+            "m", {"w": (3.0 * np.eye(DIM)).astype(np.float32)})
+        clone = cloudpickle.loads(cloudpickle.dumps(registry))
+        assert clone._server is None          # live handle dropped
+        assert clone.router._server is None
+        entry = clone.entry("m")
+        assert entry.version == 2
+        assert entry.replicas == ["m@r0", "m@r1"]
+        assert clone.swaps == 1
+        # re-attached, the record routes against the live fleet again
+        clone.attach(server)
+        y = clone.submit({"x": _x()}, model="m").result()["y"]
+        assert float(np.asarray(y)[0, 0]) == 3.0
+
+    def test_router_pickle_drops_lock_and_server(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        router = registry.router
+        router.submit({"x": _x()}, model="m").result()
+        clone = cloudpickle.loads(cloudpickle.dumps(router))
+        assert clone._server is None
+        assert clone.replicas("m") == ["m@r0", "m@r1"]
+        assert clone.routes == router.routes
+        assert isinstance(clone._lock, type(threading.Lock()))
+
+    def test_lock_guards_declared(self):
+        # the H3 static contract: guarded attrs are declared
+        assert ModelRegistry._lock_guards == ("_entries",)
+        assert FleetRouter._lock_guards == ("_replicas",)
+
+
+# ---------------------------------------------------------------------------
+# observability + autotune
+
+
+class TestObservability:
+    def test_fleet_state_one_shape_everywhere(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        from sparkdl_tpu.obs import flight
+        st = flight.fleet_state()
+        ours = [r for r in st["registries"]
+                if "m" in r.get("models", {})]
+        assert ours, st
+        assert ours[-1]["models"]["m"]["version"] == 1
+        # the flight bundle carries the same section
+        bundle = flight.recorder().bundle(reason="test")
+        assert "fleet" in bundle
+        assert bundle["fleet"]["registries"]
+
+    def test_statusz_carries_fleet(self, rig):
+        import urllib.request
+
+        from sparkdl_tpu.obs.export import start_telemetry
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        tel = start_telemetry()
+        try:
+            with urllib.request.urlopen(tel.url("/statusz"),
+                                        timeout=5) as r:
+                st = json.load(r)
+        finally:
+            tel.close()
+        assert "fleet" in st
+        assert any("m" in reg.get("models", {})
+                   for reg in st["fleet"]["registries"])
+
+    def test_fleet_gauges_and_counters_update(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8, replicas=2)
+        reg = default_registry()
+        assert reg.gauge("fleet.models").value >= 1
+        assert reg.gauge("fleet.replicas").value >= 2
+        routes0 = _counter("fleet.routes")
+        registry.submit({"x": _x()}, model="m").result()
+        assert _counter("fleet.routes") == routes0 + 1
+        registry.swap_weights(
+            "m", {"w": (3.0 * np.eye(DIM)).astype(np.float32)})
+        assert _counter("fleet.swaps") >= 1
+        assert reg.gauge("fleet.swap_latency_ms").value > 0
+
+
+class TestFleetTarget:
+    def _target(self, registry, **kw):
+        from sparkdl_tpu.autotune import FleetTarget
+        return FleetTarget(registry, "m", **kw)
+
+    def test_no_growth_without_serve_prior(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        target = self._target(registry)
+        # CPU test process: no ledger window -> no prior -> hold
+        assert target.propose(warming=False) == []
+        assert target.propose(warming=True) == []
+
+    def test_grows_one_step_when_serve_bound_and_deep(
+            self, rig, monkeypatch):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        target = self._target(registry, max_replicas=2)
+        monkeypatch.setattr(type(target), "_ledger_prior",
+                            lambda self: "serve")
+        monkeypatch.setattr(type(target), "_mean_depth",
+                            lambda self: 1000.0)
+        proposals = target.propose(warming=False)
+        assert len(proposals) == 1
+        assert proposals[0].value == 2
+        # applying the proposal IS a scale-out
+        proposals[0].knob.set(proposals[0].value)
+        assert len(registry.entry("m").replicas) == 2
+        # at the cap, nothing more is proposed
+        assert target.propose(warming=False) == []
+
+    def test_shallow_queue_holds_even_when_serve_bound(
+            self, rig, monkeypatch):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        target = self._target(registry)
+        monkeypatch.setattr(type(target), "_ledger_prior",
+                            lambda self: "serve")
+        monkeypatch.setattr(type(target), "_mean_depth",
+                            lambda self: 0.0)
+        assert target.propose(warming=False) == []
+
+    def test_describe(self, rig):
+        registry, _server, _cache = rig
+        registry.deploy("m", _mf("m", 2.0), batch_size=8)
+        d = self._target(registry).describe()
+        assert d["kind"] == "fleet"
+        assert d["model"] == "m"
+        assert d["knobs"][0]["name"] == "replicas"

@@ -58,11 +58,14 @@ from typing import List, Optional
 #: ship_ring block's ring depth / hit and byte tallies on the
 #: measuring host's corpus shape (runtime/runner.py InfeedRing),
 #: and the input_service block's rows/s and snapshot tallies on the
-#: measuring host's cores and disk (sparkdl_tpu/inputsvc/)
+#: measuring host's cores and disk (sparkdl_tpu/inputsvc/),
+#: and the fleet block's swap/warm-start/packing numbers on the
+#: measuring host's devices and whether the backend can serialize
+#: executables at all (sparkdl_tpu/fleet/)
 DYNAMIC_KEYS = {"registry", "memory_stats", "active_sources",
                 "autotune", "tails", "slo", "resilience", "bound",
                 "compile", "pipeline_overlap", "ship_ring",
-                "input_service"}
+                "input_service", "fleet"}
 
 
 def _from_lines(text: str) -> Optional[dict]:

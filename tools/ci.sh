@@ -174,6 +174,21 @@
 #      (counted fallback, correct rows); and a second snapshot-backed
 #      epoch must stream with pipeline decode busy-seconds ≈ 0 at
 #      throughput >= the serial-decode baseline
+#  22. fleet gate (docs/SERVING.md "Fleet control plane"): three
+#      drills on one registry-managed model. (a) hot-swap under
+#      concurrent submit load — every in-flight future resolves
+#      (ZERO dropped), every output is old-weights or new-weights
+#      (never mixed), post-swap outputs flip to the new weights, and
+#      the steady replicas record zero compiles and zero
+#      unexpected_retraces across the swap; (b) corrupt-cache
+#      fail-closed — a byte-flipped warm-start blob must be COUNTED
+#      (fleet.warmstart_corruptions), deleted, and fallen back to a
+#      cold compile that still answers correctly; (c) scale-out
+#      proof — TWO fresh child processes, identical but for the
+#      cache env: the one reading the persisted
+#      SPARKDL_TPU_FLEET_CACHE must record ZERO jit compiles (AOT
+#      deserialize only) and land its first request far under the
+#      cache-less child's (same fixed costs, minus the compile)
 #
 # Usage: tools/ci.sh [pytest args...]
 #   e.g. tools/ci.sh -x -k "not multiproc"   # narrow during dev
@@ -189,7 +204,7 @@ export TF_CPP_MIN_LOG_LEVEL=3
 export CUDA_VISIBLE_DEVICES=-1
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "== [1/21] native shim build =="
+echo "== [1/22] native shim build =="
 python - <<'EOF'
 from sparkdl_tpu import native
 ok = native.available()
@@ -198,13 +213,13 @@ print(f"native shim: {'built' if ok else 'UNAVAILABLE (PIL fallback)'}"
 EOF
 
 if [ "${SPARKDL_TPU_CI_SKIP_SUITE:-0}" != "1" ]; then
-  echo "== [2/21] test suite (8-virtual-device CPU mesh) =="
+  echo "== [2/22] test suite (8-virtual-device CPU mesh) =="
   python -m pytest tests/ -q "$@"
 else
-  echo "== [2/21] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
+  echo "== [2/22] SKIPPED (SPARKDL_TPU_CI_SKIP_SUITE=1) =="
 fi
 
-echo "== [3/21] multi-chip dryrun (8 virtual devices) =="
+echo "== [3/22] multi-chip dryrun (8 virtual devices) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -213,7 +228,7 @@ dryrun_multichip(8)
 print("dryrun_multichip(8): ok")
 EOF
 
-echo "== [4/21] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
+echo "== [4/22] bench smoke (real bench.py, tiny shape, schema gate, sanitized) =="
 SPARKDL_TPU_SANITIZE=1 SPARKDL_TPU_BENCH_TINY=1 \
   SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_smoke.json \
   python bench.py > /tmp/sparkdl_bench_smoke_stdout.txt
@@ -301,7 +316,7 @@ print(json.dumps({"metric": d["metric"], "value": d["value"],
                   "schema": "ok"}))
 EOF
 
-echo "== [5/21] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
+echo "== [5/22] autotune gate (schema + convergence, docs/PERFORMANCE.md) =="
 python - <<'EOF'
 import json
 
@@ -340,11 +355,11 @@ print(json.dumps({"autotune_gate": "ok",
                   "converged": at["converged"]}))
 EOF
 
-echo "== [6/21] bench schema-trajectory gate (tools/bench_compare.py) =="
+echo "== [6/22] bench schema-trajectory gate (tools/bench_compare.py) =="
 python tools/bench_compare.py /tmp/sparkdl_bench_smoke.json \
   BENCH_r05.json BENCH_r04.json BENCH_r03.json
 
-echo "== [7/21] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
+echo "== [7/22] obs gate (armed tiny bench + e2e Perfetto trace schema) =="
 SPARKDL_TPU_TRACE=1 SPARKDL_TPU_TRACE_EXPORT=/tmp/sparkdl_obs_bench_trace.json \
   SPARKDL_TPU_BENCH_TINY=1 SPARKDL_TPU_BENCH_RESULT=/tmp/sparkdl_bench_obs.json \
   python bench.py > /tmp/sparkdl_bench_obs_stdout.txt
@@ -439,7 +454,7 @@ print(f"obs e2e trace: ok, {n_spans} spans, lanes {sorted(lanes)}")
 EOF
 python -m sparkdl_tpu.obs report /tmp/sparkdl_obs_e2e_trace.json
 
-echo "== [8/21] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
+echo "== [8/22] per-request tails + SLO gate (docs/OBSERVABILITY.md) =="
 python - <<'EOF'
 import json
 
@@ -549,7 +564,7 @@ print(json.dumps({"slo_gate": "ok", "deadline_misses": missed,
                   "availability_burn_rate": burn}))
 EOF
 
-echo "== [9/21] watchdog + flight recorder + telemetry gate (injected stall) =="
+echo "== [9/22] watchdog + flight recorder + telemetry gate (injected stall) =="
 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
 import re
@@ -688,11 +703,11 @@ print(json.dumps({"stall_gate": "ok", "prom_samples": n,
                   "stalls_fired": wd.stalls_fired}))
 EOF
 
-echo "== [10/21] static analysis (sparkdl-lint + ruff baseline) =="
+echo "== [10/22] static analysis (sparkdl-lint + ruff baseline) =="
 # no targets: lint.sh's default sweep = sparkdl_tpu + tools + examples
 tools/lint.sh
 
-echo "== [11/21] analyzer machine contract (--json schema + cache correctness) =="
+echo "== [11/22] analyzer machine contract (--json schema + cache correctness) =="
 rm -f /tmp/sparkdl_lint_ci_cache.json
 SPARKDL_TPU_LINT_CACHE=/tmp/sparkdl_lint_ci_cache.json python - <<'EOF'
 import json
@@ -757,7 +772,7 @@ print(json.dumps({"analyzer_gate": "ok",
                               if v["suppressed"]}}))
 EOF
 
-echo "== [12/21] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
+echo "== [12/22] effect-system gate (H10/H11/H12 fixtures + SARIF + --changed-only) =="
 python - <<'EOF'
 import json
 import os
@@ -855,7 +870,7 @@ print(json.dumps({"sarif_gate": "ok",
 EOF
 tools/lint.sh --fast
 
-echo "== [13/21] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
+echo "== [13/22] fault-drill gate (injected serve-dispatch faults, docs/RESILIENCE.md) =="
 SPARKDL_TPU_SLO_WINDOW_S=2 \
   SPARKDL_TPU_FAULTS=serve.dispatch:transient:0.1:1234 \
   python - <<'EOF'
@@ -947,7 +962,7 @@ print(json.dumps({
     "availability_burn_after": burn}))
 EOF
 
-echo "== [14/21] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
+echo "== [14/22] throughput-hazard gate (H14/H15/H16 fixtures + analyzer cost, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1074,7 +1089,7 @@ print(json.dumps({"analyzer_cost_gate": "ok",
                   "h16_s": t["per_rule_s"]["H16"]}))
 EOF
 
-echo "== [15/21] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
+echo "== [15/22] live-roofline ledger gate (bound schema + scrape + bundle + report --bound) =="
 # (a) the ARMED tiny bench (step 7) must emit a "bound" block whose
 # verdict is computed by obs/ledger.py — fractions in [0,1], verdict
 # equal to the max-utilization stage, and the SAME fractions on the
@@ -1194,7 +1209,7 @@ python -m sparkdl_tpu.obs report --bound \
 grep -q "live roofline" /tmp/sparkdl_bound_report.txt
 grep -q "bound by:" /tmp/sparkdl_bound_report.txt
 
-echo "== [16/21] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
+echo "== [16/22] compile-forensics gate (compile block + injected retrace drill + report --compile) =="
 # (a) the bench smoke's "compile" block (step 4's result file): the
 # compile log was armed for the whole run, saw every jit compile, and
 # the CLEAN warmed pass reports ZERO unexpected retraces; the ledger
@@ -1330,7 +1345,7 @@ grep -q "compile forensics" /tmp/sparkdl_compile_report.txt
 grep -q "UNEXPECTED" /tmp/sparkdl_compile_report.txt
 grep -q "ci_drill.jitted" /tmp/sparkdl_compile_report.txt
 
-echo "== [17/21] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
+echo "== [17/22] parallel host pipeline gate (pooled bench block + ordered re-merge + watchdog, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's pipeline_overlap block: serial-vs-pooled ips
 # on one corpus + the overlap proof. On a multi-core host the pool
 # must have engaged and not lose >5% to serial; on a 1-core host the
@@ -1534,7 +1549,7 @@ print(json.dumps({"pipeline_gate": "ok", "cores": cores,
                   "bundle": path}))
 EOF
 
-echo "== [18/21] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
+echo "== [18/22] infeed-ring gate (zero-re-ship steady pass + serve surfaces + interleave drill, docs/PERFORMANCE.md) =="
 # (a) the bench smoke's ship_ring block: the repeated-corpus steady
 # pass must ship ZERO bytes (every chunk a content hit off a resident
 # slab — STRICTLY below the no-ring baseline's per-pass corpus
@@ -1710,7 +1725,7 @@ print(json.dumps({"ring_serve_gate": "ok", "cores": cores,
                   "interleave_gated": cores >= 2}))
 EOF
 
-echo "== [19/21] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
+echo "== [19/22] static-race gate (H17/H18/H19 fixtures + witness content + nineteen-rule SARIF, docs/LINT.md) =="
 python - <<'EOF'
 import json
 import os
@@ -1874,7 +1889,7 @@ print(json.dumps({"race_gate": "ok",
                   "topology_s": t["per_rule_s"]["threads-topology"]}))
 EOF
 
-echo "== [20/21] cross-process telemetry gate (merged worker trace + scrape + fault/death drills + report --workers, docs/OBSERVABILITY.md) =="
+echo "== [20/22] cross-process telemetry gate (merged worker trace + scrape + fault/death drills + report --workers, docs/OBSERVABILITY.md) =="
 SPARKDL_TPU_PIPELINE_MPCTX=fork SPARKDL_TPU_TRACE=1 \
   SPARKDL_TPU_FLIGHT=1 SPARKDL_TPU_FLIGHT_DIR=/tmp python - <<'EOF'
 import json
@@ -2016,7 +2031,7 @@ print(json.dumps({
 }))
 EOF
 
-echo "== [21/21] input-service gate (two-process decode fleet + snapshot tier, docs/DATA_SERVICE.md) =="
+echo "== [21/22] input-service gate (two-process decode fleet + snapshot tier, docs/DATA_SERVICE.md) =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -2168,5 +2183,246 @@ print(json.dumps({
     "snapshot_warm_decode_busy_s": round(warm_busy, 4),
 }))
 EOF
+
+echo "== [22/22] fleet gate (hot-swap under load + corrupt-cache fail-closed + cross-process scale-out, docs/SERVING.md) =="
+FLEET_CACHE="$(mktemp -d /tmp/sparkdl_ci_fleet.XXXXXX)"
+trap 'rm -rf "$FLEET_CACHE"' EXIT
+SPARKDL_TPU_FLEET_CACHE="$FLEET_CACHE" python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from sparkdl_tpu.fleet import ModelRegistry, WarmStartCache
+from sparkdl_tpu.fleet.warmstart import BLOB_NAME
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs import default_registry
+from sparkdl_tpu.obs.compile_log import compile_log
+from sparkdl_tpu.serve import (ModelServer, ServeConfig,
+                               ServerOverloaded)
+
+reg_obs = default_registry()
+clog = compile_log()
+clog.arm()
+cache_root = os.environ["SPARKDL_TPU_FLEET_CACHE"]
+DIM, BATCH = 8, 16
+x = np.ones((BATCH, DIM), np.float32)
+
+
+def apply(params, inputs):
+    return {"y": inputs["x"] @ params["w"]}
+
+
+def fresh_mf(name, scale):
+    return ModelFunction(
+        apply, {"w": (scale * np.eye(DIM)).astype(np.float32)},
+        {"x": ((DIM,), np.float32)}, ["y"], name=name)
+
+
+# -- (a) hot-swap under concurrent submit load ----------------------
+# cold deploy first (no warmup, empty cache): the first request pays
+# the jit compile — that wall is the band the scale-out proof in (c)
+# must beat — and the deploy persists the AOT blob for (b) and (c)
+cache = WarmStartCache(cache_root)
+server = ModelServer(ServeConfig(max_wait_s=0.0))
+registry = ModelRegistry(server, warmstart=cache)
+registry.deploy("cigate", fresh_mf("cigate", 2.0),
+                batch_size=BATCH, replicas=1, warmup=False)
+t0 = time.perf_counter()
+y = np.asarray(registry.submit({"x": x}, model="cigate"
+                               ).result()["y"])
+cold_ms = (time.perf_counter() - t0) * 1000.0
+assert float(y[0, 0]) == 2.0, y[0, 0]
+assert cache.writes >= 1, "cold deploy persisted no AOT blob"
+# replica r1 warm-starts from the blob the deploy just wrote
+registry.scale("cigate", 2)
+
+retraces0 = clog.unexpected_retraces
+compiles0 = (clog.compiles_of("cigate@r0.jitted")
+             + clog.compiles_of("cigate@r1.jitted"))
+results, lock = [], threading.Lock()
+stop = threading.Event()
+
+
+def fire():
+    while not stop.is_set():
+        try:
+            f = registry.submit({"x": x}, model="cigate")
+        except ServerOverloaded:
+            time.sleep(0.001)   # admission backpressure — typed,
+            continue            # never a dropped future
+        with lock:
+            results.append(f)
+
+
+threads = [threading.Thread(target=fire) for _ in range(4)]
+for t in threads:
+    t.start()
+try:
+    version = registry.swap_weights(
+        "cigate", {"w": (3.0 * np.eye(DIM)).astype(np.float32)},
+        note="ci step 22 under load")
+finally:
+    stop.set()
+    for t in threads:
+        t.join()
+assert version.version == 2
+assert results, "the load threads submitted nothing"
+for f in results:                    # ZERO dropped: every future resolves
+    out = np.asarray(f.result()["y"])
+    v = float(out[0, 0])
+    assert v in (2.0, 3.0), f"torn output {v}"
+    np.testing.assert_allclose(out, v * x)   # never a mixed batch
+y = np.asarray(registry.submit({"x": x}, model="cigate"
+                               ).result()["y"])
+assert float(y[0, 0]) == 3.0, \
+    "fleet still serving OLD weights after the swap"
+swap_retraces = clog.unexpected_retraces - retraces0
+steady_compiles = (clog.compiles_of("cigate@r0.jitted")
+                   + clog.compiles_of("cigate@r1.jitted")) - compiles0
+assert swap_retraces == 0, f"swap retraced: {swap_retraces}"
+assert steady_compiles == 0, \
+    f"swap recompiled the steady replicas: {steady_compiles}"
+swap_ms = registry.state()["last_swap_ms"]
+server.close()
+
+# -- (b) corrupt-cache fail-closed ----------------------------------
+# flip the last payload byte of the persisted blob: the next deploy
+# must COUNT the corruption, delete the bad blob, compile cold, and
+# still answer correctly (then re-persist a healthy blob for (c))
+blobs = [os.path.join(cache_root, d, BLOB_NAME)
+         for d in os.listdir(cache_root)
+         if os.path.exists(os.path.join(cache_root, d, BLOB_NAME))]
+assert blobs, f"no AOT blob under {cache_root}"
+with open(blobs[0], "r+b") as f:
+    f.seek(-1, os.SEEK_END)
+    last = f.read(1)[0]
+    f.seek(-1, os.SEEK_END)
+    f.write(bytes([last ^ 0xFF]))
+corrupt0 = reg_obs.counter("fleet.warmstart_corruptions").value
+cache2 = WarmStartCache(cache_root)
+server2 = ModelServer(ServeConfig(max_wait_s=0.0))
+registry2 = ModelRegistry(server2, warmstart=cache2)
+registry2.deploy("cigate2", fresh_mf("cigate2", 4.0),
+                 batch_size=BATCH, replicas=1, warmup=False)
+y = np.asarray(registry2.submit({"x": x}, model="cigate2"
+                                ).result()["y"])
+assert float(y[0, 0]) == 4.0, \
+    "wrong output after the corrupt-cache cold fallback"
+corruptions = (reg_obs.counter("fleet.warmstart_corruptions").value
+               - corrupt0)
+assert corruptions >= 1, "corrupt blob went uncounted"
+assert cache2.hits == 0, "corrupt blob counted as a warm HIT"
+# fail-CLOSED: the corrupt executable must never be installed — zero
+# aot_load events for the fallback replica (it went through the
+# normal jit path instead; XLA may dedupe the actual recompile
+# against this process's identical earlier program, so the INSTALL
+# count, not the compile count, is the load-bearing proof)
+assert clog.compiles_of("cigate2@r0.jitted.aot_load") == 0, \
+    "a corrupt blob was INSTALLED as an executable"
+# the fallback deploy re-persisted a healthy blob — self-healed
+assert cache2.writes >= 1, "store did not self-heal after corruption"
+server2.close()
+
+# -- (c) scale-out proof: a FRESH process starts warm ---------------
+# TWO children, identical but for the cache env: both pay the same
+# fresh-process fixed costs (backend init, first dispatch, params
+# device_put), so their first-request delta isolates exactly what
+# the persisted cache is supposed to delete — the compile
+child_src = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import time
+
+import numpy as np
+
+from sparkdl_tpu.fleet import ModelRegistry, WarmStartCache
+from sparkdl_tpu.graph.function import ModelFunction
+from sparkdl_tpu.obs.compile_log import compile_log
+from sparkdl_tpu.serve import ModelServer, ServeConfig
+
+clog = compile_log()
+clog.arm()
+DIM, BATCH = 8, 16
+
+
+def apply(params, inputs):
+    return {"y": inputs["x"] @ params["w"]}
+
+
+mf = ModelFunction(
+    apply, {"w": (7.0 * np.eye(DIM)).astype(np.float32)},
+    {"x": ((DIM,), np.float32)}, ["y"], name="scaleout")
+server = ModelServer(ServeConfig(max_wait_s=0.0))
+cache = WarmStartCache()        # root from SPARKDL_TPU_FLEET_CACHE
+registry = ModelRegistry(server, warmstart=cache)
+registry.deploy("scaleout", mf, batch_size=BATCH, replicas=1,
+                warmup=False)
+x = np.ones((BATCH, DIM), np.float32)
+t0 = time.perf_counter()
+y = np.asarray(registry.submit({"x": x}).result()["y"])
+first_ms = (time.perf_counter() - t0) * 1000.0
+assert float(y[0, 0]) == 7.0, y[0, 0]
+print(json.dumps({
+    "compiles": clog.compiles_of("scaleout@r0.jitted"),
+    "aot_loads": clog.compiles_of("scaleout@r0.jitted.aot_load"),
+    "warm_hits": cache.hits,
+    "first_request_ms": round(first_ms, 3),
+}))
+server.close()
+"""
+def run_child(with_cache):
+    env = {k: v for k, v in os.environ.items()
+           if k != "SPARKDL_TPU_FLEET_CACHE"}
+    if with_cache:
+        env["SPARKDL_TPU_FLEET_CACHE"] = cache_root
+    r = subprocess.run([sys.executable, "-c", child_src],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, \
+        f"scale-out child failed:\n{r.stdout}\n{r.stderr}"
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+cold_child = run_child(with_cache=False)
+warm_child = run_child(with_cache=True)
+assert cold_child["compiles"] == 1, cold_child
+assert cold_child["warm_hits"] == 0, cold_child
+assert warm_child["compiles"] == 0, \
+    f"fresh process COMPILED despite the persisted cache: {warm_child}"
+assert warm_child["aot_loads"] == 1, warm_child
+assert warm_child["warm_hits"] == 1, warm_child
+# the band: the warm child's first request must sit well under the
+# cold child's (same fixed costs, minus the compile; measured ~2x on
+# this tiny model — the 25% margin absorbs 1-core CI scheduler
+# jitter; the model is small on purpose, so the gate stays fast)
+assert warm_child["first_request_ms"] < \
+    cold_child["first_request_ms"] * 0.75, \
+    (f"warm first request {warm_child['first_request_ms']:.1f}ms "
+     f"not in band vs cold child "
+     f"{cold_child['first_request_ms']:.1f}ms")
+
+print(json.dumps({
+    "fleet_gate": "ok",
+    "swap_ms": swap_ms,
+    "swap_futures_resolved": len(results),
+    "swap_retraces": int(swap_retraces),
+    "swap_steady_compiles": int(steady_compiles),
+    "corruptions_counted": int(corruptions),
+    "parent_cold_first_request_ms": round(cold_ms, 2),
+    "cold_child_first_request_ms": cold_child["first_request_ms"],
+    "warm_child_first_request_ms": warm_child["first_request_ms"],
+    "warm_child_compiles": warm_child["compiles"],
+}))
+EOF
+rm -rf "$FLEET_CACHE"
+trap - EXIT
 
 echo "== ci.sh: ALL GREEN =="

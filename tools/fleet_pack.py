@@ -1,0 +1,130 @@
+"""Fleet placement dry-run: print the packing decision for K models
+against the LIVE hbm gauges without loading a single weight byte.
+
+The admission question the fleet control plane answers at deploy time
+("where do these replicas go, and do they go at all?" —
+sparkdl_tpu/fleet/placement.py) is worth answering BEFORE deploying:
+an operator about to add a tenant wants the refusal, the device
+spread, and the projected per-device bytes as a decision aid, not as
+a production incident. This tool runs exactly the planner the
+registry runs — same best-fit-decreasing pack, same measured
+``hbm.d<i>.*`` budgets (assumed flat budget on backends that report
+no memory stats, marked as such) — against synthetic model
+footprints, and prints the plan or the typed refusal as JSON.
+
+Models are described on the command line, one ``--model`` per tenant:
+
+    python tools/fleet_pack.py \
+        --model resnet:512MiB:2 --model bert:1.5GiB \
+        --devices 4 --budget 8GiB
+
+``name:bytes[:replicas]`` — bytes accept k/M/G/Ki/Mi/Gi suffixes.
+``--devices N`` overrides the probed device count (planning for a
+target fleet from a dev box); ``--budget`` overrides the assumed
+per-device budget for devices that report no memory stats. With no
+``--model`` args a demonstration trio is packed so the tool is
+runnable bare. Exit 0 on a feasible plan, 3 on admission refusal
+(the refusal detail still prints — that IS the answer), 2 on usage
+errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import List, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_SUFFIX = {
+    "": 1, "k": 10**3, "m": 10**6, "g": 10**9,
+    "ki": 2**10, "mi": 2**20, "gi": 2**30,
+}
+
+
+def parse_bytes(text: str) -> int:
+    m = re.fullmatch(r"\s*([0-9.]+)\s*([kKmMgG][iI]?|)[bB]?\s*", text)
+    if not m:
+        raise argparse.ArgumentTypeError(
+            f"unparseable byte count {text!r} (want e.g. 512MiB, 1.5G)")
+    return int(float(m.group(1)) * _SUFFIX[m.group(2).lower()])
+
+
+def parse_model(text: str) -> Tuple[str, int, int]:
+    parts = text.split(":")
+    if len(parts) not in (2, 3):
+        raise argparse.ArgumentTypeError(
+            f"model spec {text!r} is not name:bytes[:replicas]")
+    name, size = parts[0], parse_bytes(parts[1])
+    replicas = int(parts[2]) if len(parts) == 3 else 1
+    if not name or replicas < 1:
+        raise argparse.ArgumentTypeError(
+            f"model spec {text!r}: empty name or replicas < 1")
+    return name, size, replicas
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dry-run the fleet placement planner against "
+                    "live hbm gauges")
+    ap.add_argument("--model", action="append", type=parse_model,
+                    default=[], metavar="NAME:BYTES[:REPLICAS]",
+                    help="one synthetic tenant (repeatable)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="plan for this many devices instead of the "
+                         "probed fleet (each gets the assumed budget)")
+    ap.add_argument("--budget", type=parse_bytes, default=None,
+                    help="per-device budget for devices reporting no "
+                         "memory stats (default "
+                         "SPARKDL_TPU_FLEET_HBM_BUDGET or 1GiB)")
+    args = ap.parse_args(argv)
+
+    from sparkdl_tpu.fleet.placement import (
+        DEFAULT_DEVICE_BUDGET, DeviceBudget, ModelFootprint,
+        PlacementError, device_budgets, plan_placement)
+
+    models = args.model or [
+        ("demo-large", 256 << 20, 1),
+        ("demo-medium", 128 << 20, 2),
+        ("demo-small", 64 << 20, 1),
+    ]
+    footprints = [ModelFootprint(name=n, bytes=b,
+                                 detail={"source": "cli"})
+                  for n, b, _r in models]
+    replicas = {n: r for n, b, r in models}
+
+    if args.devices is not None:
+        flat = (args.budget if args.budget is not None
+                else DEFAULT_DEVICE_BUDGET)
+        budgets = [DeviceBudget(index=i, limit_bytes=flat,
+                                free_bytes=flat, source="assumed")
+                   for i in range(args.devices)]
+    else:
+        budgets = device_budgets(default_budget=args.budget)
+
+    try:
+        plan = plan_placement(footprints, replicas=replicas,
+                              budgets=budgets)
+    except PlacementError as e:
+        print(json.dumps({
+            "feasible": False,
+            "refusal": {"model": e.model, "need_bytes": e.need_bytes,
+                        "best_free_bytes": e.best_free_bytes,
+                        "devices": e.devices},
+            "models": {n: {"bytes": b, "replicas": r}
+                       for n, b, r in models},
+        }, indent=2))
+        return 3
+    out = plan.as_dict()
+    out["feasible"] = True
+    out["models"] = {n: {"bytes": b, "replicas": r}
+                     for n, b, r in models}
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
